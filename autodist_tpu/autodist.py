@@ -1,0 +1,97 @@
+"""The user-facing facade (≙ reference ``autodist/autodist.py``).
+
+Flow parity with the reference build path (``autodist.py:139-150``):
+build-or-load strategy (chief builds + serializes; workers load by ID —
+``autodist.py:100-109``) → compile against the resolved devices → lower →
+runner.  On TPU every host runs the same SPMD program, so "workers" are
+processes in a ``jax.distributed`` job; the chief/worker strategy handoff
+is kept so heterogeneous strategy builders stay deterministic across hosts.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Union
+
+from autodist_tpu import const
+from autodist_tpu.capture import Trainable
+from autodist_tpu.kernel.lowering import Lowered, lower
+from autodist_tpu.resource import ResourceSpec
+from autodist_tpu.runner import DistributedRunner
+from autodist_tpu.strategy import builders as _builders
+from autodist_tpu.strategy.base import StrategyBuilder
+from autodist_tpu.strategy.ir import Strategy
+from autodist_tpu.utils import logging
+
+IS_CHIEF = not const.ENV.AUTODIST_TPU_WORKER.val
+
+
+class AutoDist:
+    """Entry object: ``AutoDist(resource_spec, strategy_builder)`` then
+    ``build(trainable)`` → runner (≙ ``create_distributed_session``)."""
+
+    def __init__(self,
+                 resource_spec: Union[ResourceSpec, dict, str, None] = None,
+                 strategy_builder: Union[StrategyBuilder, str, None] = None,
+                 **builder_kwargs):
+        if not isinstance(resource_spec, ResourceSpec):
+            resource_spec = ResourceSpec(resource_spec)
+        if strategy_builder is None:
+            # Reference default: PSLoadBalancing (autodist.py:70).
+            strategy_builder = _builders.PSLoadBalancing()
+        elif isinstance(strategy_builder, str):
+            strategy_builder = _builders.create(strategy_builder,
+                                                **builder_kwargs)
+        self.resource_spec = resource_spec
+        self.strategy_builder = strategy_builder
+        self._mesh = None
+        resource_spec.bootstrap()
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            self._mesh = self.resource_spec.make_mesh()
+        return self._mesh
+
+    # ------------------------------------------------------------------ #
+    def build_or_load_strategy(self, trainable: Trainable) -> Strategy:
+        """Chief builds + serializes; workers deserialize by ID
+        (≙ reference ``_build_or_load_strategy``, ``autodist.py:100-109``)."""
+        strategy_id = const.ENV.AUTODIST_TPU_STRATEGY_ID.val
+        if not IS_CHIEF and strategy_id:
+            return Strategy.deserialize(strategy_id)
+        strategy = self.strategy_builder.build(trainable, self.resource_spec)
+        if IS_CHIEF:
+            try:
+                path = strategy.serialize()
+                logging.debug("strategy serialized to %s", path)
+            except OSError as e:
+                logging.warning(
+                    "chief could not serialize strategy %s (%s); workers "
+                    "loading by AUTODIST_TPU_STRATEGY_ID will not find it",
+                    strategy.id, e)
+        logging.info("strategy:\n%s", strategy)
+        return strategy
+
+    def lower(self, trainable: Trainable,
+              strategy: Optional[Strategy] = None) -> Lowered:
+        strategy = strategy or self.build_or_load_strategy(trainable)
+        return lower(trainable, strategy, self.mesh)
+
+    def build(self, trainable: Trainable,
+              strategy: Optional[Strategy] = None, *,
+              rng: Any = None) -> DistributedRunner:
+        """Lower + instantiate the runner (≙ building the distributed
+        session, reference ``autodist.py:139-150``)."""
+        return DistributedRunner(trainable, self.lower(trainable, strategy),
+                                 rng=rng)
+
+    # Convenience one-shot (≙ the experimental ``autodist.function``,
+    # reference ``autodist.py:252-289``).
+    def function(self, trainable: Trainable):
+        runner = self.build(trainable)
+
+        def run_fn(batch):
+            return runner.step(batch)
+
+        run_fn.runner = runner
+        return run_fn

@@ -1,0 +1,159 @@
+"""Capture layer: the model/optimizer structure strategies are built from.
+
+TPU-native counterpart of the reference's ``GraphItem``
+(``autodist/graph_item.py``): where the reference *scraped* the
+grad→target→update-op structure out of a ``tf.Graph`` via monkey-patched
+optimizers (``graph_item.py:73-109``, ``patch.py:80-88``), here the user
+*declares* it: a ``Trainable`` bundles the pure loss function, the initial
+parameter pytree, and an optax optimizer.  The per-variable inventory the
+strategy builders consume (``graph_item.prepare``/``trainable_var_op_to_var``,
+``graph_item.py:494-497``) becomes :meth:`Trainable.var_infos`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def path_to_name(path) -> str:
+    """Canonical variable name for a pytree path (≙ TF variable name)."""
+    parts = []
+    for k in path:
+        if isinstance(k, jax.tree_util.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jax.tree_util.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, jax.tree_util.GetAttrKey):
+            parts.append(str(k.name))
+        elif isinstance(k, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(k.key))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class VarInfo:
+    """Per-variable facts for strategy building (≙ the reference's
+    ``Info`` variable protos, ``graph_item.py:112-215``)."""
+
+    name: str
+    shape: tuple[int, ...]
+    dtype: Any
+    is_sparse: bool  # embedding-style access pattern (≙ IndexedSlices grads)
+
+    @property
+    def size(self) -> int:
+        return math.prod(self.shape) if self.shape else 1
+
+    @property
+    def byte_size(self) -> int:
+        return self.size * np.dtype(self.dtype).itemsize
+
+
+# Heuristic for sparse/embedding detection.  The reference detected sparsity
+# from the gradient type (IndexedSlices, ``graph_item.py:301-311``); JAX
+# grads are dense, so sparsity here means "embedding-style row access" —
+# declared explicitly or matched by name/shape.
+_SPARSE_NAME_RE = re.compile(r"(embed|embedding|lookup|vocab)", re.IGNORECASE)
+_SPARSE_MIN_ROWS = 8192
+
+
+class Trainable:
+    """The unit strategies are built for and lowering consumes.
+
+    Canonical step semantics: ``loss(params, extra, batch, rng) ->
+    (loss, new_extra, metrics)`` where ``extra`` is non-trained state
+    (e.g. batch-norm statistics) and ``metrics`` a dict of scalars.
+    Use the factories for simpler signatures.
+    """
+
+    def __init__(
+        self,
+        loss: Callable[[Any, Any, Any, Any], tuple[Any, Any, dict]],
+        params: Any,
+        optimizer: Any,  # optax.GradientTransformation
+        *,
+        extra: Any = None,
+        sparse_params: Sequence[str] = (),
+        detect_sparse: bool = True,
+        name: str = "trainable",
+    ):
+        self.loss = loss
+        self.params = params
+        self.optimizer = optimizer
+        self.extra = extra
+        self.name = name
+        self._explicit_sparse = set(sparse_params)
+        self._detect_sparse = detect_sparse
+
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def from_loss_fn(cls, loss_fn, params, optimizer, *, with_rng=False, **kw):
+        """Wrap ``loss_fn(params, batch)`` (or ``(params, batch, rng)``)
+        returning a scalar loss or ``(loss, metrics_dict)``."""
+
+        def canonical(p, extra, batch, rng):
+            out = loss_fn(p, batch, rng) if with_rng else loss_fn(p, batch)
+            loss, metrics = out if isinstance(out, tuple) else (out, {})
+            return loss, extra, dict(metrics, loss=loss)
+
+        return cls(canonical, params, optimizer, **kw)
+
+    @classmethod
+    def from_flax(cls, module, loss_head, variables, optimizer, *,
+                  train_kwargs: Optional[dict] = None, rngs_keys=("dropout",),
+                  mutable=("batch_stats",), **kw):
+        """Wrap a flax ``module``: ``loss_head(logits, batch) -> (loss,
+        metrics)``; mutable collections become ``extra`` state."""
+        variables = dict(variables)
+        params = variables.pop("params")
+        extra = {k: v for k, v in variables.items()} or None
+        mutable = [m for m in mutable if extra and m in extra]
+        train_kwargs = dict(train_kwargs or {})
+
+        def canonical(p, ex, batch, rng):
+            inputs = batch["x"] if isinstance(batch, dict) and "x" in batch else batch[0]
+            rngs = {k: jax.random.fold_in(rng, i) for i, k in enumerate(rngs_keys)}
+            vars_in = {"params": p, **(ex or {})}
+            if mutable:
+                logits, updates = module.apply(
+                    vars_in, inputs, rngs=rngs, mutable=mutable, **train_kwargs)
+                new_ex = {**(ex or {}), **updates}
+            else:
+                logits = module.apply(vars_in, inputs, rngs=rngs, **train_kwargs)
+                new_ex = ex
+            loss, metrics = loss_head(logits, batch)
+            return loss, new_ex, dict(metrics, loss=loss)
+
+        return cls(canonical, params, optimizer, extra=extra, **kw)
+
+    # ------------------------------------------------------------------ #
+    def var_infos(self) -> list[VarInfo]:
+        leaves = jax.tree_util.tree_flatten_with_path(self.params)[0]
+        infos = []
+        for path, leaf in leaves:
+            name = path_to_name(path)
+            sparse = name in self._explicit_sparse
+            if not sparse and self._detect_sparse:
+                sparse = bool(
+                    _SPARSE_NAME_RE.search(name)
+                    and getattr(leaf, "ndim", 0) == 2
+                    and leaf.shape[0] >= _SPARSE_MIN_ROWS
+                )
+            infos.append(VarInfo(
+                name=name,
+                shape=tuple(getattr(leaf, "shape", ())),
+                dtype=getattr(leaf, "dtype", jnp.float32),
+                is_sparse=sparse,
+            ))
+        return infos
+
+    def var_names(self) -> list[str]:
+        return [v.name for v in self.var_infos()]

@@ -1,0 +1,49 @@
+"""Constants and environment-variable config plane.
+
+TPU-native counterpart of the reference's ``autodist/const.py`` (env flags +
+name-scope constants, reference ``const.py:31-89``).  Env vars remain the
+config plane because they must propagate across multi-host launches
+(reference ``coordinator.py:70-82``); here they propagate to every TPU-VM
+host process.
+"""
+import enum
+import os
+
+# Working directories (reference const.py:31-38).
+DEFAULT_WORKING_DIR = "/tmp/autodist_tpu"
+DEFAULT_STRATEGY_DIR = os.path.join(DEFAULT_WORKING_DIR, "strategies")
+DEFAULT_TRACE_DIR = os.path.join(DEFAULT_WORKING_DIR, "traces")
+DEFAULT_LOG_DIR = os.path.join(DEFAULT_WORKING_DIR, "logs")
+
+# Canonical mesh-axis names.  The reference had a single implicit axis
+# (the replica list, strategy.proto:66-68); the TPU build names its mesh
+# axes so strategies can target them.
+DATA_AXIS = "data"       # data parallelism (≙ reference replicas)
+MODEL_AXIS = "model"     # tensor/model parallelism (beyond reference parity)
+SEQ_AXIS = "seq"         # sequence/context parallelism (ring attention)
+PIPE_AXIS = "pipe"       # pipeline parallelism
+EXPERT_AXIS = "expert"   # expert parallelism (MoE)
+
+ALL_AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS)
+
+
+class ENV(enum.Enum):
+    """Typed environment flags (reference ``const.py:55-89`` ENV enum).
+
+    Each member's value is a lambda producing the typed default.
+    """
+
+    AUTODIST_TPU_WORKER = (lambda v: v or "",)          # non-chief host marker
+    AUTODIST_TPU_STRATEGY_ID = (lambda v: v or "",)     # strategy to load
+    AUTODIST_TPU_MIN_LOG_LEVEL = (lambda v: v or "INFO",)
+    AUTODIST_TPU_IS_TESTING = (lambda v: v == "True" or v == "1",)
+    AUTODIST_TPU_WORKING_DIR = (lambda v: v or DEFAULT_WORKING_DIR,)
+    AUTODIST_TPU_COORDINATOR = (lambda v: v or "",)     # host:port for jax.distributed
+    AUTODIST_TPU_NUM_PROCESSES = (lambda v: int(v) if v else 1,)
+    AUTODIST_TPU_PROCESS_ID = (lambda v: int(v) if v else 0,)
+    AUTODIST_TPU_DUMP_HLO = (lambda v: v == "True" or v == "1",)  # per-stage HLO dumps
+
+    @property
+    def val(self):
+        """Return the typed value of this env var."""
+        return self.value[0](os.environ.get(self.name))

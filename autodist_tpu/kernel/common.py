@@ -1,0 +1,113 @@
+"""Shared shard-math utilities for synchronizer lowering.
+
+Counterpart of the reference's graph-surgery utilities
+(``autodist/kernel/common/utils.py``) — except there is no graph surgery on
+TPU: these are pure shape/padding/collective helpers used inside
+``shard_map``-traced step functions.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def padded_flat_size(size: int, n: int) -> int:
+    """Smallest multiple of ``n`` ≥ size (flat-shard padding)."""
+    return ceil_div(max(size, 1), n) * n
+
+
+def pad_axis_to(x, axis: int, target: int):
+    """Zero-pad ``x`` along ``axis`` up to length ``target``."""
+    if x.shape[axis] == target:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, target - x.shape[axis])
+    return jnp.pad(x, pads)
+
+
+def padded_shape(shape: tuple[int, ...], axis: int, n: int) -> tuple[int, ...]:
+    s = list(shape)
+    s[axis] = padded_flat_size(s[axis], n)
+    return tuple(s)
+
+
+# --------------------------------------------------------------------------- #
+# Inside-shard_map collectives (the synchronizer primitive vocabulary:
+# ≙ reference CollectiveReduce/Gather/accumulator ops, SURVEY.md §2.9)
+# --------------------------------------------------------------------------- #
+def reduce_scatter_flat(x, axis_name: str, n: int, mean: bool = True):
+    """Flatten, pad, and reduce-scatter: each device receives the summed
+    (or averaged) 1/n flat chunk.  ≙ the PS conditional accumulator —
+    every device acts as the PS for its chunk
+    (reference ``ps_synchronizer.py:556-633``)."""
+    flat = x.reshape(-1)
+    flat = pad_axis_to(flat, 0, padded_flat_size(flat.size, n))
+    out = lax.psum_scatter(flat, axis_name, scatter_dimension=0, tiled=True)
+    return out / n if mean else out
+
+
+def all_gather_flat(shard, axis_name: str, shape: tuple[int, ...]):
+    """Inverse of :func:`reduce_scatter_flat`: gather flat chunks and
+    restore the original shape (≙ workers pulling updated values from the
+    PS, reference ``proxy_variable.py:96-114``)."""
+    full = lax.all_gather(shard, axis_name, tiled=True)
+    size = math.prod(shape) if shape else 1
+    return full[:size].reshape(shape)
+
+
+def local_flat_shard(x, axis_name: str, n: int):
+    """This device's flat 1/n chunk of a replicated tensor."""
+    flat = x.reshape(-1)
+    flat = pad_axis_to(flat, 0, padded_flat_size(flat.size, n))
+    k = flat.size // n
+    i = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(flat, i * k, k, axis=0)
+
+
+def reduce_scatter_axis(x, axis_name: str, n: int, axis: int, mean: bool = True):
+    """Pad ``axis`` to a multiple of n and reduce-scatter along it
+    (≙ PartitionedAR: allreduce of axis-0 shards,
+    reference ``partitioned_all_reduce_strategy.py:25-130``)."""
+    x = pad_axis_to(x, axis, padded_flat_size(x.shape[axis], n))
+    out = lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+    return out / n if mean else out
+
+
+def all_gather_axis(shard, axis_name: str, axis: int, orig_dim: int):
+    """Gather axis shards and trim padding back to ``orig_dim``."""
+    full = lax.all_gather(shard, axis_name, axis=axis, tiled=True)
+    if full.shape[axis] != orig_dim:
+        full = lax.slice_in_dim(full, 0, orig_dim, axis=axis)
+    return full
+
+
+def local_axis_shard(x, axis_name: str, n: int, axis: int):
+    """This device's 1/n chunk of ``x`` along ``axis`` (padded)."""
+    x = pad_axis_to(x, axis, padded_flat_size(x.shape[axis], n))
+    k = x.shape[axis] // n
+    i = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(x, i * k, k, axis=axis)
+
+
+# --------------------------------------------------------------------------- #
+# Pytree path helpers
+# --------------------------------------------------------------------------- #
+def flatten_with_names(tree):
+    """[(name, leaf)] using the same naming as ``capture.path_to_name``."""
+    from autodist_tpu.capture import path_to_name
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(path_to_name(p), l) for p, l in leaves]
+
+
+def tree_from_names(tree, fn):
+    """Map ``leaf -> fn(name, leaf)`` preserving structure."""
+    from autodist_tpu.capture import path_to_name
+    return jax.tree_util.tree_map_with_path(
+        lambda p, l: fn(path_to_name(p), l), tree)

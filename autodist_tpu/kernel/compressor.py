@@ -1,0 +1,134 @@
+"""Gradient compressors for allreduce.
+
+Counterpart of the reference ``Compressor`` hierarchy
+(``autodist/kernel/synchronization/compressor.py``): ``NoneCompressor``
+(identity, ``compressor.py:146-166``), ``HorovodCompressor`` (fp-cast,
+``compressor.py:169-201``), ``HorovodCompressorEF`` (error feedback,
+``compressor.py:120-143``).  The reference's commented-out PowerSGD
+(``compressor.py:208-284``) is realized here as an int8 shared-scale
+quantized allreduce (EQuARX-style, PAPERS.md 2506.17615) — a strictly
+stronger replacement that works on ICI.
+
+Compressors run *inside* ``shard_map``: ``allreduce(grad, state, axis)``
+returns the averaged gradient and new per-device compressor state (error
+residual for EF variants).  State leaves live in the TrainState so the
+residual persists across steps (≙ the reference's error-feedback mixin
+instance state).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Compressor:
+    """Base: mean-allreduce ``grad`` over ``axis_name``."""
+
+    name = "none"
+    stateful = False
+
+    def init_state(self, leaf):
+        return None
+
+    def allreduce(self, grad, state, axis_name):
+        return lax.pmean(grad, axis_name), state
+
+    # Registry (≙ reference ``Compressor.create`` reflection,
+    # ``compressor.py:42-55``).
+    _registry: dict[str, type] = {}
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if getattr(cls, "name", None):
+            Compressor._registry[cls.name] = cls
+
+    @classmethod
+    def create(cls, name: str, **kw) -> "Compressor":
+        if name in ("", "none", None):
+            return Compressor()
+        if name not in cls._registry:
+            raise ValueError(
+                f"unknown compressor {name!r}; have {sorted(cls._registry)}")
+        return cls._registry[name](**kw)
+
+
+class CastCompressor(Compressor):
+    """Cast to a lower-precision wire dtype before the allreduce
+    (≙ HorovodCompressor, reference ``compressor.py:169-201``)."""
+
+    name = "fp16"
+    wire_dtype = jnp.float16
+
+    def allreduce(self, grad, state, axis_name):
+        # The psum itself runs in the wire dtype — that is the bandwidth
+        # saving; the mean is taken after, in f32.
+        summed = lax.psum(grad.astype(self.wire_dtype), axis_name)
+        n = lax.psum(jnp.ones((), jnp.float32), axis_name)
+        return (summed.astype(jnp.float32) / n).astype(grad.dtype), state
+
+
+class BF16CastCompressor(CastCompressor):
+    name = "bf16"
+    wire_dtype = jnp.bfloat16
+
+
+class _ErrorFeedback(Compressor):
+    """Error-feedback mixin (≙ reference ``CompressorEF``,
+    ``compressor.py:120-143``): compress (grad + residual), keep the
+    quantization error as next step's residual."""
+
+    name = None  # abstract mixin — not a registry entry
+    stateful = True
+
+    def init_state(self, leaf):
+        return jnp.zeros(leaf.shape, jnp.float32)
+
+    def _wire(self, x):
+        raise NotImplementedError
+
+    def allreduce(self, grad, state, axis_name):
+        corrected = grad.astype(jnp.float32) + state
+        wire = self._wire(corrected)
+        new_state = corrected - wire.astype(jnp.float32)
+        summed = lax.psum(wire, axis_name)  # collective at wire width
+        n = lax.psum(jnp.ones((), jnp.float32), axis_name)
+        return (summed.astype(jnp.float32) / n).astype(grad.dtype), new_state
+
+
+class FP16EFCompressor(_ErrorFeedback):
+    name = "fp16_ef"
+
+    def _wire(self, x):
+        return x.astype(jnp.float16)
+
+
+class BF16EFCompressor(_ErrorFeedback):
+    name = "bf16_ef"
+
+    def _wire(self, x):
+        return x.astype(jnp.bfloat16)
+
+
+class Int8EFCompressor(_ErrorFeedback):
+    """Shared-scale int8 quantized allreduce with error feedback.
+
+    All devices agree on a scale via ``pmax`` so the quantized payloads are
+    summable.  The psum wire dtype is fp16: integer levels in [-127, 127]
+    are exact in fp16, and sums stay exact up to 2048 — i.e. ≥16 replicas —
+    at half the fp32 wire width.  (EQuARX-style, PAPERS.md 2506.17615;
+    replaces the reference's dead PowerSGD code path.  A true int8-wire
+    ring allreduce is a Pallas-kernel follow-up.)
+    """
+
+    name = "int8_ef"
+
+    def allreduce(self, grad, state, axis_name):
+        corrected = grad.astype(jnp.float32) + state
+        scale = lax.pmax(jnp.max(jnp.abs(corrected)), axis_name) / 127.0
+        scale = jnp.maximum(scale, 1e-20)
+        q = jnp.clip(jnp.round(corrected / scale), -127, 127)
+        new_state = corrected - q * scale
+        summed = lax.psum(q.astype(jnp.float16), axis_name).astype(jnp.float32) * scale
+        n = lax.psum(jnp.ones((), jnp.float32), axis_name)
+        return (summed / n).astype(grad.dtype), new_state

@@ -1,0 +1,447 @@
+"""Strategy lowering: Strategy IR → one compiled SPMD train step.
+
+TPU-native counterpart of the reference's whole backend stack —
+``StrategyCompiler`` (device resolution, ``strategy/base.py:120-168``),
+``GraphTransformer`` (pass orchestration, ``kernel/graph_transformer.py:55-92``),
+``VariablePartitioner`` (``kernel/partitioner.py``), ``Replicator``
+(``kernel/replicator.py``) and the synchronizers
+(``kernel/synchronization/``).  There is no graph surgery: the "transform"
+is a function transformation.  The per-variable synchronizer choice lowers
+to explicit XLA collectives inside a single ``shard_map``-traced step:
+
+* AllReduce synchronizer      → ``lax.pmean`` (optionally compressed /
+  bucketed — bucketing ≙ ScopedAllocator merging, ``runner.py:40-46``)
+* PS synchronizer (flat)      → flatten + ``psum_scatter`` (grad shard ≙
+  the PS accumulator), sharded optimizer update (≙ apply op on the PS),
+  ``all_gather`` of updated params (≙ proxy refresh).  ZeRO-style
+  weight-update sharding (PAPERS.md 2004.13336).
+* PS + partitioner (axis)     → parameters *stored* sharded along the
+  partition axis (≙ PartitionedPS shards living on PS devices), gathered
+  on use, gradients reduce-scattered: FSDP semantics.
+* AllReduce + partitioner     → params replicated, gradient
+  reduce-scatter along the partition axis + sharded update + all-gather
+  (≙ PartitionedAR).
+
+Replication (the reference Replicator's per-GPU graph copies) is the
+``shard_map`` over the data axis itself; in-graph vs between-graph
+synchronization both collapse into ICI collectives in one XLA program.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu import const
+from autodist_tpu.capture import Trainable
+from autodist_tpu.kernel import common
+from autodist_tpu.kernel.compressor import Compressor
+from autodist_tpu.strategy.ir import (AllReduceSynchronizer, PSSynchronizer,
+                                      Strategy)
+from autodist_tpu.utils import logging
+
+# Update-space kinds: where the optimizer update for a variable runs.
+U_REPLICATED = "replicated"   # full copy on every device (pure DP)
+U_FLAT = "flat"               # 1/N flat chunk per device (ZeRO / PS)
+U_AXIS = "axis"               # 1/N chunk along a tensor axis
+
+
+@dataclasses.dataclass
+class VarPlan:
+    """Resolved per-variable lowering decision (≙ one compiled strategy
+    node after device resolution)."""
+
+    name: str
+    shape: tuple[int, ...]
+    dtype: Any
+    stored_sharded: bool          # params stored sharded (FSDP) vs replicated
+    split_axis: int               # tensor axis for U_AXIS / storage sharding
+    update: str                   # U_REPLICATED | U_FLAT | U_AXIS
+    bucket: Optional[str]         # allreduce bucket key (None = unsynced path)
+    compressor: str = "none"
+
+    @property
+    def param_spec(self) -> P:
+        if not self.stored_sharded:
+            return P()
+        spec = [None] * len(self.shape)
+        spec[self.split_axis] = const.DATA_AXIS
+        return P(*spec)
+
+    def stored_shape(self, n: int) -> tuple[int, ...]:
+        if not self.stored_sharded:
+            return self.shape
+        return common.padded_shape(self.shape, self.split_axis, n)
+
+    def update_spec(self) -> P:
+        if self.update == U_REPLICATED:
+            return P()
+        if self.update == U_FLAT:
+            return P(const.DATA_AXIS)
+        spec = [None] * len(self.shape)
+        spec[self.split_axis] = const.DATA_AXIS
+        return P(*spec)
+
+    def update_shape(self, n: int) -> tuple[int, ...]:
+        if self.update == U_REPLICATED:
+            return self.shape
+        if self.update == U_FLAT:
+            return (common.padded_flat_size(math.prod(self.shape) or 1, n),)
+        return common.padded_shape(self.shape, self.split_axis, n)
+
+
+@dataclasses.dataclass
+class Plan:
+    """The compiled strategy: per-var plans + global state layout."""
+
+    var_plans: dict[str, VarPlan]
+    num_replicas: int
+    buckets: dict[str, list[str]]          # bucket key -> ordered var names
+    bucket_compressor: dict[str, str]      # bucket key -> compressor name
+
+
+def make_plan(trainable: Trainable, strategy: Strategy, mesh) -> Plan:
+    """Resolve a Strategy against a mesh (≙ StrategyCompiler.compile:
+    device resolution + node pruning, reference ``strategy/base.py:120-168``).
+    """
+    n = mesh.shape[const.DATA_AXIS]
+    if strategy.graph_config.replicas not in (0, n):
+        raise ValueError(
+            f"strategy built for {strategy.graph_config.replicas} replicas; "
+            f"mesh data axis has {n}")
+    var_plans: dict[str, VarPlan] = {}
+    buckets: dict[str, list[str]] = {}
+    bucket_comp: dict[str, str] = {}
+    for info in trainable.var_infos():
+        node = strategy.node_config_for(info.name)
+        sync = node.synchronizer if node else AllReduceSynchronizer()
+        part = node.partitioner if node else None
+        split_axis = -1
+        if part is not None and part.num_shards > 1:
+            split_axis = max(part.split_axis, 0)
+            if part.num_shards != n:
+                # Mesh resolution overrides shard-count hints the same way
+                # the reference's compiler overrode device strings
+                # (strategy/base.py:120-168): shards must map 1:1 onto the
+                # mesh axis.
+                logging.warning(
+                    "%s: partitioner requests %d shards; lowering over the "
+                    "%d-way %s axis instead", info.name, part.num_shards, n,
+                    const.DATA_AXIS)
+        if isinstance(sync, PSSynchronizer):
+            if sync.staleness > 0:
+                logging.warning(
+                    "staleness=%d on %s: SSP fights SPMD lockstep; lowering "
+                    "as fully synchronous (documented gap, SURVEY.md §7)",
+                    sync.staleness, info.name)
+            if split_axis >= 0 and info.shape:
+                plan = VarPlan(info.name, info.shape, info.dtype,
+                               stored_sharded=True, split_axis=split_axis,
+                               update=U_AXIS, bucket=None)
+            else:
+                plan = VarPlan(info.name, info.shape, info.dtype,
+                               stored_sharded=False, split_axis=-1,
+                               update=U_FLAT, bucket=None)
+        else:  # AllReduce
+            if split_axis >= 0 and info.shape:
+                plan = VarPlan(info.name, info.shape, info.dtype,
+                               stored_sharded=False, split_axis=split_axis,
+                               update=U_AXIS, bucket=None,
+                               compressor=sync.compressor)
+            else:
+                key = f"g{sync.group}:{sync.compressor}"
+                plan = VarPlan(info.name, info.shape, info.dtype,
+                               stored_sharded=False, split_axis=-1,
+                               update=U_REPLICATED, bucket=key,
+                               compressor=sync.compressor)
+                buckets.setdefault(key, []).append(info.name)
+                bucket_comp[key] = sync.compressor
+        var_plans[info.name] = plan
+    return Plan(var_plans=var_plans, num_replicas=n, buckets=buckets,
+                bucket_compressor=bucket_comp)
+
+
+# --------------------------------------------------------------------------- #
+# Spec/shape trees
+# --------------------------------------------------------------------------- #
+def _params_specs(plan: Plan, params):
+    return common.tree_from_names(
+        params, lambda name, _: plan.var_plans[name].param_spec)
+
+
+def _update_space(plan: Plan, params, n):
+    """Global update-space view of params (full/flat/axis, zero-padded to
+    divisibility; padding lanes carry zero grads so leaf-wise optimizer
+    transforms leave them at zero)."""
+
+    def view(name, p):
+        vp = plan.var_plans[name]
+        if vp.update == U_REPLICATED:
+            return p
+        if vp.update == U_FLAT:
+            flat = p.reshape(-1)
+            return common.pad_axis_to(flat, 0, vp.update_shape(n)[0])
+        return common.pad_axis_to(p, vp.split_axis,
+                                  vp.update_shape(n)[vp.split_axis])
+
+    return common.tree_from_names(params, view)
+
+
+def _opt_state_specs(plan: Plan, trainable: Trainable, n: int):
+    """PartitionSpec tree for the optimizer state.
+
+    Optax states embed param-shaped subtrees under the same key paths
+    (e.g. ``ScaleByAdamState.mu[...]``); every optimizer-state leaf whose
+    path ends with a variable's path inherits that variable's update-space
+    spec, scalars and unmatched leaves replicate.  (The reference instead
+    re-instantiated the optimizer over rewritten variables,
+    ``partitioner.py:570-573`` — declarative matching replaces graph
+    rewriting.)
+    """
+    u_shapes = jax.eval_shape(
+        lambda p: _update_space(plan, p, n),
+        jax.tree.map(lambda l: jax.ShapeDtypeStruct(np.shape(l), jnp.result_type(l)),
+                     trainable.params))
+    opt_shapes = jax.eval_shape(trainable.optimizer.init, u_shapes)
+    var_names = list(plan.var_plans)
+
+    def spec_for(path, leaf):
+        from autodist_tpu.capture import path_to_name
+        name = path_to_name(path)
+        candidates = [v for v in var_names
+                      if name == v or name.endswith("/" + v)]
+        if candidates:
+            vp = plan.var_plans[max(candidates, key=len)]
+            if tuple(leaf.shape) == vp.update_shape(n):
+                return vp.update_spec()
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, opt_shapes), opt_shapes
+
+
+def _sync_state_shapes(plan: Plan, trainable: Trainable, n: int):
+    """Global shapes for compressor (error-feedback) state: one residual
+    per bucket with a leading device axis (per-device local state)."""
+    sizes = {}
+    by_name = {v.name: v for v in trainable.var_infos()}
+    for key, names in plan.buckets.items():
+        comp = Compressor.create(plan.bucket_compressor.get(key, "none"))
+        if comp.stateful:
+            total = sum(by_name[nm].size for nm in names)
+            sizes[key] = (n, total)
+    return sizes
+
+
+# --------------------------------------------------------------------------- #
+# The lowered program
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass
+class Lowered:
+    """Compiled artifacts: jitted init and train-step functions plus the
+    state layout (≙ the transformed graph + session of the reference)."""
+
+    plan: Plan
+    mesh: Any
+    init_fn: Any          # (params, extra) -> state
+    step_fn: Any          # (state, batch, rng) -> (state, metrics)
+    state_specs: Any      # pytree of PartitionSpec
+    state_shardings: Any  # pytree of NamedSharding
+    batch_spec: Any
+
+    def init_state(self, params=None, extra=None, trainable=None):
+        params = params if params is not None else trainable.params
+        extra = extra if extra is not None else (
+            trainable.extra if trainable else None)
+        return self.init_fn(params, extra)
+
+    def unpad_params(self, params):
+        """Strip storage padding: fetch params at their original shapes
+        (≙ reference checkpoints looking unpartitioned, ``saver.py:50-58``)."""
+
+        def unpad(name, p):
+            vp = self.plan.var_plans[name]
+            if vp.stored_sharded and p.shape != vp.shape:
+                return lax.slice_in_dim(
+                    p, 0, vp.shape[vp.split_axis], axis=vp.split_axis)
+            return p
+
+        return common.tree_from_names(params, unpad)
+
+
+def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
+    """Build the SPMD program for (trainable, strategy, mesh)."""
+    plan = make_plan(trainable, strategy, mesh)
+    n = plan.num_replicas
+    data_axis = const.DATA_AXIS
+    opt = trainable.optimizer
+
+    p_specs = _params_specs(plan, trainable.params)
+    o_specs, _ = _opt_state_specs(plan, trainable, n)
+    sync_shapes = _sync_state_shapes(plan, trainable, n)
+    extra_specs = jax.tree.map(lambda _: P(), trainable.extra)
+    state_specs = {
+        "step": P(),
+        "params": p_specs,
+        "opt_state": o_specs,
+        "extra": extra_specs,
+        "sync_state": {k: P(data_axis) for k in sync_shapes},
+    }
+    state_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), state_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    batch_spec = P(data_axis)
+
+    var_order = list(plan.var_plans)
+
+    # ---------------- init ------------------------------------------------ #
+    def _init(params, extra):
+        def store(name, p):
+            vp = plan.var_plans[name]
+            if vp.stored_sharded:
+                return common.pad_axis_to(
+                    jnp.asarray(p), vp.split_axis, vp.stored_shape(n)[vp.split_axis])
+            return jnp.asarray(p)
+
+        params_store = common.tree_from_names(params, store)
+        u_params = _update_space(plan, jax.tree.map(jnp.asarray, params), n)
+        opt_state = opt.init(u_params)
+        sync_state = {k: jnp.zeros(shp, jnp.float32)
+                      for k, shp in sync_shapes.items()}
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "params": params_store,
+            "opt_state": opt_state,
+            "extra": extra,
+            "sync_state": sync_state,
+        }
+
+    init_fn = jax.jit(_init, out_shardings=state_shardings)
+
+    # ---------------- train step ------------------------------------------ #
+    def _local_step(state, batch, rng):
+        params_store = state["params"]
+
+        def to_full(stored):
+            def full(name, p):
+                vp = plan.var_plans[name]
+                if vp.stored_sharded:
+                    return common.all_gather_axis(
+                        p, data_axis, vp.split_axis, vp.shape[vp.split_axis])
+                return p
+            return common.tree_from_names(stored, full)
+
+        local_rng = jax.random.fold_in(rng, lax.axis_index(data_axis))
+
+        def stored_loss(stored):
+            loss, new_extra, metrics = trainable.loss(
+                to_full(stored), state["extra"], batch, local_rng)
+            return loss, (new_extra, metrics)
+
+        grad_fn = jax.value_and_grad(stored_loss, has_aux=True)
+        (loss, (new_extra, metrics)), grads_stored = grad_fn(params_store)
+
+        g_by_name = dict(common.flatten_with_names(grads_stored))
+        p_by_name = dict(common.flatten_with_names(params_store))
+
+        # --- per-bucket compressed allreduce (≙ AllReduceSynchronizer +
+        # ScopedAllocator merging) ---------------------------------------- #
+        synced: dict[str, Any] = {}
+        new_sync_state: dict[str, Any] = {}
+        for key, names in plan.buckets.items():
+            comp = Compressor.create(plan.bucket_compressor.get(key, "none"))
+            flats = [g_by_name[nm].reshape(-1).astype(jnp.float32)
+                     for nm in names]
+            concat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+            comp_state = (state["sync_state"][key][0]
+                          if comp.stateful else None)
+            reduced, comp_state = comp.allreduce(concat, comp_state, data_axis)
+            if comp.stateful:
+                new_sync_state[key] = comp_state[None]
+            offset = 0
+            for nm in names:
+                vp = plan.var_plans[nm]
+                sz = math.prod(vp.shape) or 1
+                synced[nm] = lax.slice_in_dim(reduced, offset, offset + sz)\
+                    .reshape(vp.shape).astype(g_by_name[nm].dtype)
+                offset += sz
+
+        # --- update-space grads and param views --------------------------- #
+        def u_grad(name, _p):
+            vp = plan.var_plans[name]
+            g = g_by_name[name]
+            if vp.update == U_REPLICATED:
+                return synced[name]
+            if vp.update == U_FLAT:
+                return common.reduce_scatter_flat(g, data_axis, n, mean=True)
+            if vp.stored_sharded:
+                # AD through all_gather already psum_scatter'ed (summed);
+                # convert to mean to match the DP objective.
+                return g / n
+            return common.reduce_scatter_axis(
+                g, data_axis, n, vp.split_axis, mean=True)
+
+        def u_param(name, p):
+            vp = plan.var_plans[name]
+            if vp.update == U_REPLICATED or vp.stored_sharded:
+                return p
+            if vp.update == U_FLAT:
+                return common.local_flat_shard(p, data_axis, n)
+            return common.local_axis_shard(p, data_axis, n, vp.split_axis)
+
+        u_grads = common.tree_from_names(params_store, lambda nm, p: u_grad(nm, p))
+        u_params = common.tree_from_names(params_store, u_param)
+
+        updates, new_opt_state = opt.update(u_grads, state["opt_state"], u_params)
+        u_new = optax.apply_updates(u_params, updates)
+
+        # --- back to storage space ---------------------------------------- #
+        def to_store(name, un):
+            vp = plan.var_plans[name]
+            if vp.update == U_REPLICATED or vp.stored_sharded:
+                return un
+            if vp.update == U_FLAT:
+                return common.all_gather_flat(un, data_axis, vp.shape)
+            return common.all_gather_axis(
+                un, data_axis, vp.split_axis, vp.shape[vp.split_axis])
+
+        new_params = common.tree_from_names(u_new, to_store)
+
+        pmean_f = lambda t: jax.tree.map(
+            lambda x: lax.pmean(x, data_axis)
+            if jnp.issubdtype(jnp.result_type(x), jnp.inexact) else x, t)
+        metrics = pmean_f(dict(metrics))
+        new_extra = pmean_f(new_extra)
+
+        full_sync_state = dict(state["sync_state"])
+        full_sync_state.update(new_sync_state)
+        new_state = {
+            "step": state["step"] + 1,
+            "params": new_params,
+            "opt_state": new_opt_state,
+            "extra": new_extra,
+            "sync_state": full_sync_state,
+        }
+        return new_state, metrics
+
+    def _step(state, batch, rng):
+        sm = jax.shard_map(
+            _local_step, mesh=mesh,
+            in_specs=(state_specs, batch_spec, P()),
+            out_specs=(state_specs, P()),
+            check_vma=False,
+        )
+        return sm(state, batch, rng)
+
+    step_fn = jax.jit(_step, donate_argnums=(0,))
+
+    return Lowered(plan=plan, mesh=mesh, init_fn=init_fn, step_fn=step_fn,
+                   state_specs=state_specs, state_shardings=state_shardings,
+                   batch_spec=batch_spec)

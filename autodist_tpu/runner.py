@@ -1,0 +1,102 @@
+"""Distributed runner: owns the compiled step and the data contract.
+
+Counterpart of the reference's ``WrappedSession`` (``runner.py:78-132``)
+and ``Remapper`` (``remapper.py``): the feed contract — a host batch with a
+leading batch dimension is *split* across replicas
+(``remapper.py:109-123``) — becomes placement with a
+``NamedSharding(P('data'))``; the fetch contract — scalars/metrics fetched
+once (``remapper.py:125-185``) — becomes replicated outputs pulled from any
+shard.  Initializers-on-construction (``runner.py:97-100``) becomes
+``init_state`` at construction.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Iterable, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu import const
+from autodist_tpu.kernel.lowering import Lowered
+from autodist_tpu.utils import logging
+
+
+class DistributedRunner:
+    """Owns (mesh, compiled step fns, state); the training session."""
+
+    def __init__(self, trainable, lowered: Lowered, *, rng: Optional[Any] = None):
+        self.trainable = trainable
+        self.lowered = lowered
+        self.mesh = lowered.mesh
+        self._batch_sharding = NamedSharding(self.mesh, lowered.batch_spec)
+        self.state = lowered.init_state(trainable=trainable)
+        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._step_times: list[float] = []
+
+    # ---------------- feed/fetch (≙ Remapper) -------------------------- #
+    def _place_batch(self, batch):
+        """Split the host batch across the data axis (feed contract,
+        reference ``remapper.py:109-123``).  Already-placed global arrays
+        pass through."""
+        sharding = self._batch_sharding
+
+        def place(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                return x  # already a global array (multi-host path)
+            x = np.asarray(x)
+            n = self.mesh.shape[const.DATA_AXIS]
+            if x.ndim == 0 or x.shape[0] % n:
+                raise ValueError(
+                    f"batch leading dim {x.shape} must be divisible by the "
+                    f"data-axis size {n}")
+            return jax.device_put(x, sharding)
+
+        return jax.tree.map(place, batch)
+
+    # ---------------- the hot loop (≙ WrappedSession.run) --------------- #
+    def step(self, batch, *, rng=None):
+        """One optimizer step; returns the metrics dict (fetch contract)."""
+        batch = self._place_batch(batch)
+        if rng is None:
+            self.rng, rng = jax.random.split(self.rng)
+        self.state, metrics = self.lowered.step_fn(self.state, batch, rng)
+        return metrics
+
+    def run(self, data: Iterable, num_steps: Optional[int] = None,
+            log_every: int = 0):
+        """Drive ``num_steps`` steps from an iterable of host batches."""
+        metrics = {}
+        it = iter(data)
+        i = 0
+        while num_steps is None or i < num_steps:
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            t0 = time.perf_counter()
+            metrics = self.step(batch)
+            if log_every and (i + 1) % log_every == 0:
+                jax.block_until_ready(metrics)
+                dt = time.perf_counter() - t0
+                self._step_times.append(dt)
+                logging.info("step %d %s (%.1f ms/step)",
+                             int(self.state["step"]),
+                             {k: float(v) for k, v in metrics.items()}, dt * 1e3)
+            i += 1
+        return metrics
+
+    # ---------------- fetches ------------------------------------------- #
+    @property
+    def step_count(self) -> int:
+        return int(self.state["step"])
+
+    def get_params(self):
+        """Parameters at their original (unpadded) shapes — the
+        'checkpoints look unpartitioned' contract
+        (reference ``saver.py:50-58``)."""
+        return jax.device_get(self.lowered.unpad_params(self.state["params"]))
+
+    def get_extra(self):
+        return jax.device_get(self.state["extra"])

@@ -1,0 +1,57 @@
+"""StrategyBuilder base (≙ reference ``autodist/strategy/base.py``).
+
+``StrategyBuilder.build(trainable, resource_spec) -> Strategy`` mirrors
+``StrategyBuilder.build(graph_item, resource_spec)`` (reference
+``strategy/base.py:102-117``).  Compilation (device resolution) lives in
+``kernel.lowering.make_plan`` — the mesh is the resolved device set.
+"""
+from __future__ import annotations
+
+import abc
+
+from autodist_tpu import const
+from autodist_tpu.capture import Trainable
+from autodist_tpu.resource import ResourceSpec
+from autodist_tpu.strategy.ir import GraphConfig, Strategy
+
+
+class StrategyBuilder(abc.ABC):
+    """Base for all strategy builders."""
+
+    @abc.abstractmethod
+    def build(self, trainable: Trainable, resource_spec: ResourceSpec) -> Strategy:
+        ...
+
+    @staticmethod
+    def num_replicas(resource_spec: ResourceSpec) -> int:
+        shape = resource_spec.resolved_mesh_shape()
+        return shape.get(const.DATA_AXIS, 1)
+
+    def _graph_config(self, resource_spec: ResourceSpec) -> GraphConfig:
+        shape = resource_spec.resolved_mesh_shape()
+        return GraphConfig(replicas=shape.get(const.DATA_AXIS, 1),
+                           mesh_axes=dict(shape))
+
+
+def byte_size_load_fn(var_info) -> int:
+    """Load function for greedy placement: variable byte size.
+
+    Port of the pure planning logic of the reference
+    (``ps_lb_strategy.py:96-117`` — itself adapted from TF's
+    ``byte_size_load_fn``); unknown dims charged at 64 bytes/element is
+    irrelevant here since JAX shapes are static.
+    """
+    return max(var_info.byte_size, 1)
+
+
+def greedy_assign(infos, num_bins: int, load_fn=byte_size_load_fn):
+    """Greedy bin packing: largest first onto least-loaded bin
+    (≙ the reference's PS load balancer loop, ``ps_lb_strategy.py:42-62``).
+    Returns {var_name: bin_index}."""
+    loads = [0] * max(num_bins, 1)
+    assignment = {}
+    for info in sorted(infos, key=load_fn, reverse=True):
+        i = loads.index(min(loads))
+        assignment[info.name] = i
+        loads[i] += load_fn(info)
+    return assignment

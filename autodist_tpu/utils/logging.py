@@ -1,0 +1,62 @@
+"""Project logger: console + timestamped file.
+
+Counterpart of reference ``autodist/utils/logging.py:33-106`` (own logger,
+stderr + file under a working dir, level from env).
+"""
+import logging as _logging
+import os
+import sys
+import time
+
+from autodist_tpu import const
+
+_LOGGER_NAME = "autodist_tpu"
+_logger = None
+
+
+def get_logger():
+    """Return the singleton framework logger (console + file handler)."""
+    global _logger
+    if _logger is not None:
+        return _logger
+    logger = _logging.getLogger(_LOGGER_NAME)
+    logger.propagate = False
+    level = const.ENV.AUTODIST_TPU_MIN_LOG_LEVEL.val.upper()
+    logger.setLevel(getattr(_logging, level, _logging.INFO))
+    fmt = _logging.Formatter(
+        "%(asctime)s %(levelname).1s %(process)d %(filename)s:%(lineno)d] %(message)s"
+    )
+    sh = _logging.StreamHandler(sys.stderr)
+    sh.setFormatter(fmt)
+    logger.addHandler(sh)
+    try:
+        os.makedirs(const.DEFAULT_LOG_DIR, exist_ok=True)
+        fh = _logging.FileHandler(
+            os.path.join(const.DEFAULT_LOG_DIR, f"{int(time.time())}.log")
+        )
+        fh.setFormatter(fmt)
+        logger.addHandler(fh)
+    except OSError:  # read-only fs etc. — console-only logging is fine
+        pass
+    _logger = logger
+    return logger
+
+
+def set_verbosity(level):
+    get_logger().setLevel(level)
+
+
+def debug(msg, *a):
+    get_logger().debug(msg, *a, stacklevel=2)
+
+
+def info(msg, *a):
+    get_logger().info(msg, *a, stacklevel=2)
+
+
+def warning(msg, *a):
+    get_logger().warning(msg, *a, stacklevel=2)
+
+
+def error(msg, *a):
+    get_logger().error(msg, *a, stacklevel=2)

@@ -1,0 +1,66 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Measured on whatever devices are visible (the driver runs this on real TPU
+hardware).  Metric: training-step throughput (examples/sec) plus model FLOP
+utilization on the flagship model, in the style of the reference's
+``TimeHistory`` examples/sec meter (``examples/benchmark/imagenet.py:84-140``).
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def main():
+    from autodist_tpu import AllReduce, AutoDist, Trainable
+    from autodist_tpu.resource import ResourceSpec
+
+    dim, hidden, out, batch = 1024, 4096, 1024, 4096
+    rng = np.random.RandomState(0)
+    params = {
+        "l1": {"w": jnp.asarray(rng.randn(dim, hidden) * 0.02, jnp.bfloat16)},
+        "l2": {"w": jnp.asarray(rng.randn(hidden, hidden) * 0.02, jnp.bfloat16)},
+        "l3": {"w": jnp.asarray(rng.randn(hidden, out) * 0.02, jnp.bfloat16)},
+    }
+
+    def loss_fn(p, b):
+        h = jax.nn.relu(b["x"] @ p["l1"]["w"])
+        h = jax.nn.relu(h @ p["l2"]["w"])
+        pred = h @ p["l3"]["w"]
+        return jnp.mean((pred.astype(jnp.float32) - b["y"]) ** 2)
+
+    trainable = Trainable.from_loss_fn(loss_fn, params, optax.adam(1e-3))
+    rs = ResourceSpec({})
+    ad = AutoDist(rs, AllReduce(chunk_size=8))
+    runner = ad.build(trainable)
+    n = rs.num_devices()
+    data = {"x": rng.randn(batch, dim).astype(np.float32),
+            "y": rng.randn(batch, out).astype(np.float32)}
+
+    runner.step(data)  # compile
+    jax.block_until_ready(runner.state)
+    steps = 20
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        runner.step(data)
+    jax.block_until_ready(runner.state)
+    dt = time.perf_counter() - t0
+
+    examples_per_sec = batch * steps / dt
+    # fwd+bwd matmul FLOPs: 3 matmuls * 2 mn k * 3 (fwd + 2x bwd)
+    flops_per_example = 6 * (dim * hidden + hidden * hidden + hidden * out)
+    mfu = (examples_per_sec * flops_per_example
+           / (rs.chip.peak_bf16_tflops * 1e12 * n))
+    print(json.dumps({
+        "metric": "mlp_train_examples_per_sec",
+        "value": round(examples_per_sec, 1),
+        "unit": "examples/sec",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

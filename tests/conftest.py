@@ -1,0 +1,18 @@
+"""Test harness: a simulated 8-device CPU mesh.
+
+The reference tested multi-worker semantics against real TF servers over
+SSH (SURVEY.md §4); this build exploits what the reference lacked — a
+simulated mesh — so multi-"host" semantics are unit-testable without
+hardware.
+"""
+import os
+
+# Must run before the first jax backend initialization.
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")

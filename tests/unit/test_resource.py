@@ -1,0 +1,69 @@
+"""Resource model tests (≙ reference ``test_resource_spec.py`` /
+``test_device_spec.py``: YAML parsing, defaults, validation)."""
+import jax
+import pytest
+
+from autodist_tpu import ResourceSpec
+from autodist_tpu import const
+
+
+def test_default_spec_uses_all_devices():
+    rs = ResourceSpec({})
+    assert rs.num_devices() == 8
+    assert rs.resolved_mesh_shape() == {const.DATA_AXIS: 8}
+    mesh = rs.make_mesh()
+    assert mesh.shape[const.DATA_AXIS] == 8
+
+
+def test_explicit_mesh_shape():
+    rs = ResourceSpec({"mesh": {"data": 4, "model": 2}})
+    assert rs.resolved_mesh_shape() == {"data": 4, "model": 2}
+    mesh = rs.make_mesh()
+    assert mesh.shape == {"data": 4, "model": 2}
+
+
+def test_wildcard_axis():
+    rs = ResourceSpec({"mesh": {"data": -1, "model": 2}})
+    assert rs.resolved_mesh_shape() == {"data": 4, "model": 2}
+
+
+def test_num_devices_subset():
+    rs = ResourceSpec({"topology": {"num_devices": 4}})
+    assert rs.num_devices() == 4
+    assert rs.resolved_mesh_shape() == {"data": 4}
+
+
+def test_mismatched_mesh_raises():
+    with pytest.raises(ValueError):
+        ResourceSpec({"mesh": {"data": 3}}).resolved_mesh_shape()
+
+
+def test_unknown_axis_raises():
+    with pytest.raises(ValueError):
+        ResourceSpec({"mesh": {"bogus": 8}})
+
+
+def test_too_many_devices_raises():
+    with pytest.raises(ValueError):
+        ResourceSpec({"topology": {"num_devices": 64}}).devices()
+
+
+def test_device_order_deterministic():
+    # ≙ reference sorted node list (cluster.py:78-81)
+    a = [d.id for d in ResourceSpec({}).devices()]
+    b = [d.id for d in ResourceSpec({}).devices()]
+    assert a == b == sorted(a)
+
+
+def test_yaml_roundtrip(tmp_path):
+    p = tmp_path / "spec.yml"
+    p.write_text("topology:\n  platform: cpu\nmesh:\n  data: 8\n")
+    rs = ResourceSpec(str(p))
+    assert rs.platform == "cpu"
+    assert rs.resolved_mesh_shape() == {"data": 8}
+
+
+def test_chip_spec_lookup():
+    rs = ResourceSpec({"topology": {"generation": "v5e"}})
+    assert rs.chip.name == "v5e"
+    assert rs.chip.peak_bf16_tflops > 0
