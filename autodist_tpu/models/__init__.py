@@ -1,0 +1,18 @@
+"""Model zoo: parity with the reference's examples + benchmark models
+(SURVEY.md §2.8): linear regression, MNIST CNN, ImageNet CNNs (ResNet
+family), BERT MLM, lm1b word LM with sampled softmax, NCF/NeuMF."""
+
+from autodist_tpu.models.bert import (BertModel, bert_base, bert_large,
+                                      make_mlm_trainable, mlm_loss_head,
+                                      synthetic_mlm_batch)
+from autodist_tpu.models.cnn import (MnistCNN, make_cnn_trainable,
+                                     make_linear_regression_trainable)
+from autodist_tpu.models.lm1b import (LSTMWordLM, make_lm1b_trainable,
+                                      sampled_softmax_loss)
+from autodist_tpu.models.ncf import NeuMF, make_ncf_trainable
+from autodist_tpu.models.resnet import (ResNet18, ResNet34, ResNet50,
+                                        ResNet101, ResNet152,
+                                        classification_loss_head,
+                                        make_resnet_trainable)
+from autodist_tpu.models.transformer import (Encoder, TransformerConfig,
+                                             TransformerLM, lm_loss_head)

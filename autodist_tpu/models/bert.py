@@ -1,0 +1,125 @@
+"""BERT for masked-LM pretraining.
+
+Counterpart of the reference's bundled BERT stack
+(``examples/benchmark/utils/bert_modeling.py`` 963 LoC,
+``bert_models.py`` 393 LoC, driven by ``examples/benchmark/bert.py``) —
+rebuilt in flax on the shared :mod:`transformer` encoder.  Masked
+positions are a *static-count* gather (TPU-friendly static shapes) as in
+standard MLM pretraining batches.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from autodist_tpu.models.transformer import Encoder, TransformerConfig
+
+
+def bert_base(**kw) -> TransformerConfig:
+    return TransformerConfig(vocab_size=30522, hidden_size=768, num_layers=12,
+                             num_heads=12, mlp_dim=3072, max_len=512, **kw)
+
+
+def bert_large(**kw) -> TransformerConfig:
+    return TransformerConfig(vocab_size=30522, hidden_size=1024,
+                             num_layers=24, num_heads=16, mlp_dim=4096,
+                             max_len=512, **kw)
+
+
+class BertModel(nn.Module):
+    """Embeddings + encoder + MLM transform head."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, batch, *, deterministic: bool = True):
+        cfg = self.cfg
+        tokens = batch["input_ids"]          # [B, L]
+        segments = batch.get("segment_ids")  # [B, L]
+        mask = batch.get("input_mask")       # [B, L] 1 = real token
+        masked_pos = batch["masked_positions"]  # [B, P] static P
+
+        B, L = tokens.shape
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         name="token_embed")
+        x = embed(tokens)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (cfg.max_len, cfg.hidden_size), jnp.float32)
+        x = x + pos[None, :L].astype(cfg.dtype)
+        if segments is not None:
+            x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                             dtype=cfg.dtype, name="segment_embed")(segments)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_embed")(x)
+        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
+
+        attn_mask = None
+        if mask is not None:
+            attn_mask = (mask[:, None, None, :] > 0)
+        x = Encoder(cfg, name="encoder")(x, attn_mask, deterministic)
+
+        # MLM head: gather masked positions (static count), transform,
+        # decode against the tied embedding table.
+        gathered = jnp.take_along_axis(
+            x, masked_pos[..., None], axis=1)         # [B, P, H]
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_dense")(gathered)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(dtype=cfg.dtype, name="mlm_ln")(h)
+        logits = embed.attend(h.astype(jnp.float32))  # [B, P, V]
+        logits = logits + self.param(
+            "mlm_bias", nn.initializers.zeros, (cfg.vocab_size,), jnp.float32)
+        return logits
+
+
+def mlm_loss_head(logits, batch):
+    """Masked-LM cross entropy over the static masked positions."""
+    labels = batch["masked_ids"]       # [B, P]
+    weights = batch["masked_weights"]  # [B, P] 0 for padding predictions
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(weights.sum(), 1.0)
+    loss = -(ll * weights).sum() / denom
+    acc = ((logits.argmax(-1) == labels) * weights).sum() / denom
+    return loss, {"mlm_accuracy": acc}
+
+
+def make_mlm_trainable(cfg: TransformerConfig, optimizer, rng,
+                       *, batch_size=8, seq_len=128, num_masked=20):
+    """Build a Trainable for BERT MLM (init on synthetic shapes)."""
+    from autodist_tpu.capture import Trainable
+
+    model = BertModel(cfg)
+    sample = synthetic_mlm_batch(rng, batch_size, seq_len, num_masked,
+                                 cfg.vocab_size)
+    variables = model.init({"params": rng, "dropout": rng}, sample,
+                           deterministic=True)
+
+    def loss(params, extra, batch, step_rng):
+        logits = model.apply({"params": params}, batch,
+                             deterministic=False,
+                             rngs={"dropout": step_rng})
+        l, metrics = mlm_loss_head(logits, batch)
+        return l, extra, dict(metrics, loss=l)
+
+    return Trainable(loss, variables["params"], optimizer,
+                     sparse_params=("token_embed/embedding",),
+                     name="bert_mlm")
+
+
+def synthetic_mlm_batch(rng, batch_size, seq_len, num_masked, vocab_size):
+    """Random MLM batch with the exact structure of a real one."""
+    import numpy as np
+    r = np.random.RandomState(int(jax.random.randint(rng, (), 0, 2**31 - 1))
+                              if hasattr(rng, "dtype") else rng)
+    return {
+        "input_ids": r.randint(0, vocab_size, (batch_size, seq_len)).astype(np.int32),
+        "segment_ids": r.randint(0, 2, (batch_size, seq_len)).astype(np.int32),
+        "input_mask": np.ones((batch_size, seq_len), np.int32),
+        "masked_positions": np.sort(
+            r.randint(0, seq_len, (batch_size, num_masked)), axis=-1).astype(np.int32),
+        "masked_ids": r.randint(0, vocab_size, (batch_size, num_masked)).astype(np.int32),
+        "masked_weights": np.ones((batch_size, num_masked), np.float32),
+    }

@@ -1,0 +1,65 @@
+"""Small models: linear regression and the MNIST CNN.
+
+Counterparts of the reference's minimal examples
+(``examples/linear_regression.py:14-76`` and the Keras MNIST CNN in
+``examples/image_classifier.py``).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu.models.resnet import classification_loss_head
+
+
+class MnistCNN(nn.Module):
+    """Conv-pool-conv-pool-dense (the reference's Keras example shape)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(32, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3))(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def make_cnn_trainable(optimizer, rng, *, image_size=28, channels=1,
+                       num_classes=10, batch_size=8):
+    from autodist_tpu.capture import Trainable
+
+    model = MnistCNN(num_classes=num_classes)
+    sample = jnp.zeros((batch_size, image_size, image_size, channels))
+    params = model.init(rng, sample)["params"]
+
+    def loss(p, extra, batch, step_rng):
+        logits = model.apply({"params": p}, batch["x"])
+        l, metrics = classification_loss_head(logits, batch)
+        return l, extra, dict(metrics, loss=l)
+
+    return Trainable(loss, params, optimizer, name="mnist_cnn")
+
+
+def make_linear_regression_trainable(optimizer, *, dim=13, seed=0):
+    """≙ reference ``examples/linear_regression.py`` (the smoke test)."""
+    from autodist_tpu.capture import Trainable
+
+    rng = np.random.RandomState(seed)
+    params = {"w": jnp.asarray(rng.randn(dim, 1) * 0.01, jnp.float32),
+              "b": jnp.zeros((1,), jnp.float32)}
+
+    def loss_fn(p, batch):
+        pred = batch["x"] @ p["w"] + p["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    return Trainable.from_loss_fn(loss_fn, params, optimizer,
+                                  name="linear_regression")

@@ -1,0 +1,107 @@
+"""lm1b-style word language model with sampled softmax.
+
+Counterpart of the reference's lm1b example
+(``examples/lm1b/language_model.py`` — LSTM word LM with tf sampled
+softmax over an 800k vocab, trained with PartitionedPS embedding
+sharding).  TPU-first: the recurrence is an ``nn.scan``-compiled LSTM
+(static-shape, MXU-batched gates); the sampled softmax re-derives TF's
+log-uniform (Zipf) candidate sampler in pure JAX.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def log_uniform_sample(rng, num_samples: int, vocab_size: int):
+    """Log-uniform (Zipfian) candidate ids + expected-count corrections,
+    matching the sampler the reference's sampled softmax relied on."""
+    u = jax.random.uniform(rng, (num_samples,))
+    ids = (jnp.exp(u * jnp.log(vocab_size + 1.0)) - 1.0).astype(jnp.int32)
+    ids = jnp.clip(ids, 0, vocab_size - 1)
+    probs = jnp.log1p(1.0 / (ids.astype(jnp.float32) + 1.0)) \
+        / jnp.log(vocab_size + 1.0)
+    return ids, probs
+
+
+def sampled_softmax_loss(rng, weights, biases, hidden, labels,
+                         num_samples: int, vocab_size: int):
+    """Sampled-softmax cross entropy.
+
+    ``weights``: [V, H] output embedding, ``hidden``: [B, H],
+    ``labels``: [B].  Negatives are shared across the batch (standard
+    TF behavior).
+    """
+    neg_ids, neg_q = log_uniform_sample(rng, num_samples, vocab_size)
+    true_w = weights[labels]                     # [B, H]
+    true_b = biases[labels]
+    neg_w = weights[neg_ids]                     # [S, H]
+    neg_b = biases[neg_ids]
+
+    true_logit = jnp.einsum("bh,bh->b", hidden, true_w) + true_b
+    neg_logit = hidden @ neg_w.T + neg_b[None]   # [B, S]
+
+    # subtract log expected counts (sampled-softmax correction)
+    true_q = jnp.log1p(1.0 / (labels.astype(jnp.float32) + 1.0)) \
+        / jnp.log(vocab_size + 1.0)
+    true_logit = true_logit - jnp.log(jnp.maximum(true_q, 1e-20))
+    neg_logit = neg_logit - jnp.log(jnp.maximum(neg_q, 1e-20))[None]
+    # mask accidental hits of the true label among negatives
+    hit = neg_ids[None, :] == labels[:, None]
+    neg_logit = jnp.where(hit, jnp.finfo(jnp.float32).min, neg_logit)
+
+    logits = jnp.concatenate([true_logit[:, None], neg_logit], axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -logp[:, 0].mean()
+
+
+class LSTMWordLM(nn.Module):
+    """Embedding → stacked LSTM (scan) → projection; sampled softmax."""
+
+    vocab_size: int = 800_000
+    embed_dim: int = 512
+    hidden_dim: int = 1024
+    num_layers: int = 2
+
+    @nn.compact
+    def __call__(self, tokens):
+        x = nn.Embed(self.vocab_size, self.embed_dim, name="embedding")(tokens)
+        B = tokens.shape[0]
+        for i in range(self.num_layers):
+            cell = nn.OptimizedLSTMCell(self.hidden_dim, name=f"lstm_{i}")
+            scan = nn.RNN(cell, name=f"rnn_{i}")
+            x = scan(x)
+        return nn.Dense(self.embed_dim, name="proj")(x)
+
+
+def make_lm1b_trainable(optimizer, rng, *, vocab_size=10_000, embed_dim=128,
+                        hidden_dim=256, num_layers=1, seq_len=20,
+                        batch_size=8, num_samples=64):
+    from autodist_tpu.capture import Trainable
+
+    model = LSTMWordLM(vocab_size=vocab_size, embed_dim=embed_dim,
+                       hidden_dim=hidden_dim, num_layers=num_layers)
+    sample = jnp.zeros((batch_size, seq_len), jnp.int32)
+    params = model.init(rng, sample)["params"]
+    # output softmax table (sharded under Parallax/PartitionedPS like the
+    # input embedding)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(17))
+    params = dict(params)
+    params["softmax_w"] = jax.random.normal(k1, (vocab_size, embed_dim)) * 0.05
+    params["softmax_b"] = jnp.zeros((vocab_size,))
+
+    def loss(p, extra, batch, step_rng):
+        p = dict(p)
+        sw, sb = p.pop("softmax_w"), p.pop("softmax_b")
+        hidden = model.apply({"params": p}, batch["x"])   # [B, L, E]
+        hidden = hidden.reshape(-1, hidden.shape[-1])
+        labels = batch["y"].reshape(-1)
+        l = sampled_softmax_loss(step_rng, sw, sb, hidden, labels,
+                                 num_samples, vocab_size)
+        return l, extra, {"loss": l}
+
+    return Trainable(loss, params, optimizer,
+                     sparse_params=("embedding/embedding", "softmax_w"),
+                     name="lm1b")

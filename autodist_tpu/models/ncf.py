@@ -1,0 +1,66 @@
+"""NCF / NeuMF recommendation model.
+
+Counterpart of the reference's NCF benchmark (``examples/benchmark/ncf.py``
+with the MovieLens pipeline under ``utils/recommendation/``): NeuMF =
+GMF + MLP towers over user/item embeddings, binary cross entropy, LazyAdam
+— on TPU plain Adam over the sharded tables (the lazy/sparse distinction
+vanishes under SPMD dense updates).
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class NeuMF(nn.Module):
+    num_users: int = 138_000
+    num_items: int = 27_000
+    mf_dim: int = 64
+    mlp_dims: tuple[int, ...] = (256, 128, 64)
+
+    @nn.compact
+    def __call__(self, users, items):
+        mf_u = nn.Embed(self.num_users, self.mf_dim, name="mf_user_embedding")(users)
+        mf_i = nn.Embed(self.num_items, self.mf_dim, name="mf_item_embedding")(items)
+        mlp_u = nn.Embed(self.num_users, self.mlp_dims[0] // 2,
+                         name="mlp_user_embedding")(users)
+        mlp_i = nn.Embed(self.num_items, self.mlp_dims[0] // 2,
+                         name="mlp_item_embedding")(items)
+
+        gmf = mf_u * mf_i
+        mlp = jnp.concatenate([mlp_u, mlp_i], axis=-1)
+        for i, d in enumerate(self.mlp_dims[1:]):
+            mlp = nn.relu(nn.Dense(d, name=f"mlp_{i}")(mlp))
+        x = jnp.concatenate([gmf, mlp], axis=-1)
+        return nn.Dense(1, name="prediction")(x)[..., 0]
+
+
+def make_ncf_trainable(optimizer, rng, *, num_users=1000, num_items=500,
+                       mf_dim=8, mlp_dims=(32, 16, 8)):
+    from autodist_tpu.capture import Trainable
+
+    model = NeuMF(num_users=num_users, num_items=num_items, mf_dim=mf_dim,
+                  mlp_dims=mlp_dims)
+    params = model.init(rng, jnp.zeros((2,), jnp.int32),
+                        jnp.zeros((2,), jnp.int32))["params"]
+
+    def loss(p, extra, batch, step_rng):
+        logits = model.apply({"params": p}, batch["users"], batch["items"])
+        labels = batch["labels"].astype(jnp.float32)
+        l = optax_sigmoid_ce(logits, labels).mean()
+        acc = ((logits > 0) == (labels > 0.5)).mean()
+        return l, extra, {"loss": l, "accuracy": acc}
+
+    sparse = tuple(f"{t}/embedding" for t in
+                   ("mf_user_embedding", "mf_item_embedding",
+                    "mlp_user_embedding", "mlp_item_embedding"))
+    return Trainable(loss, params, optimizer, sparse_params=sparse,
+                     name="ncf")
+
+
+def optax_sigmoid_ce(logits, labels):
+    log_p = jax.nn.log_sigmoid(logits)
+    log_not_p = jax.nn.log_sigmoid(-logits)
+    return -labels * log_p - (1.0 - labels) * log_not_p

@@ -1,0 +1,162 @@
+"""Transformer encoder/decoder blocks — the shared modeling stack.
+
+Counterpart of the reference's bundled transformer layers
+(``examples/benchmark/utils/modeling/layers/`` ~1,000 LoC on
+TF/Keras), rebuilt TPU-first in flax:
+
+* bfloat16 activations by default (MXU-native), fp32 params + softmax
+* ``jax.checkpoint`` (remat) per layer to trade FLOPs for HBM
+* attention pluggable: local einsum attention here; Pallas flash /
+  ring attention live in ``autodist_tpu.ops`` and slot in via
+  ``attention_fn``
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class TransformerConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    attention_dropout_rate: float = 0.1
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    attention_fn: Optional[Callable] = None  # (q, k, v, mask, dropout_rng) -> out
+    causal: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+def dot_product_attention(q, k, v, mask, *, dropout_rate=0.0,
+                          dropout_rng=None, dtype=jnp.bfloat16):
+    """Plain einsum attention (softmax in fp32 for stability)."""
+    depth = q.shape[-1]
+    scores = jnp.einsum("...qhd,...khd->...hqk", q, k) / np.sqrt(depth)
+    scores = scores.astype(jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
+    return jnp.einsum("...hqk,...khd->...qhd", probs, v)
+
+
+class SelfAttention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        B, L, _ = x.shape
+        qkv = nn.DenseGeneral(
+            features=(3, cfg.num_heads, cfg.head_dim), axis=-1,
+            dtype=cfg.dtype, name="qkv")(x)
+        q, k, v = jnp.moveaxis(qkv, -3, 0)
+        dropout_rng = (None if deterministic or cfg.attention_dropout_rate == 0
+                       else self.make_rng("dropout"))
+        if cfg.attention_fn is not None:
+            out = cfg.attention_fn(q, k, v, mask, dropout_rng)
+        else:
+            out = dot_product_attention(
+                q, k, v, mask, dropout_rate=(0.0 if deterministic
+                                             else cfg.attention_dropout_rate),
+                dropout_rng=dropout_rng, dtype=cfg.dtype)
+        return nn.DenseGeneral(features=cfg.hidden_size, axis=(-2, -1),
+                               dtype=cfg.dtype, name="out")(out)
+
+
+class MlpBlock(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        cfg = self.cfg
+        h = nn.Dense(cfg.mlp_dim, dtype=cfg.dtype, name="wi")(x)
+        h = nn.gelu(h)
+        h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        return nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="wo")(h)
+
+
+class EncoderLayer(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        a = SelfAttention(cfg, name="attention")(x, mask, deterministic)
+        a = nn.Dropout(cfg.dropout_rate)(a, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_attention")(x + a)
+        m = MlpBlock(cfg, name="mlp")(x, deterministic)
+        m = nn.Dropout(cfg.dropout_rate)(m, deterministic=deterministic)
+        return nn.LayerNorm(dtype=cfg.dtype, name="ln_mlp")(x + m)
+
+
+class Encoder(nn.Module):
+    """Stack of encoder layers, optionally rematerialized per layer."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic: bool):
+        cfg = self.cfg
+        layer_cls = EncoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, mask, deterministic)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only causal LM (the flagship model for benchmarking)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, deterministic: bool = True):
+        cfg = self.cfg
+        B, L = tokens.shape
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                         dtype=cfg.dtype, name="token_embed")
+        pos_embed = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (cfg.max_len, cfg.hidden_size), jnp.float32)
+        x = embed(tokens) + pos_embed[None, :L].astype(cfg.dtype)
+        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
+        causal = nn.make_causal_mask(tokens, dtype=jnp.bool_)
+        x = Encoder(cfg, name="encoder")(x, causal, deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_final")(x)
+        # weight-tied readout
+        logits = embed.attend(x.astype(jnp.float32))
+        return logits
+
+
+def lm_loss_head(logits, batch):
+    """Next-token cross entropy with optional per-token weights."""
+    targets = batch["y"]
+    weights = batch.get("w")
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if weights is None:
+        weights = jnp.ones_like(ll)
+    loss = -(ll * weights).sum() / jnp.maximum(weights.sum(), 1.0)
+    acc = ((logits.argmax(-1) == targets) * weights).sum() \
+        / jnp.maximum(weights.sum(), 1.0)
+    return loss, {"accuracy": acc}
