@@ -75,6 +75,13 @@ class AutoDist:
     def lower(self, trainable: Trainable,
               strategy: Optional[Strategy] = None) -> Lowered:
         strategy = strategy or self.build_or_load_strategy(trainable)
+        kind = strategy.graph_config.lowering
+        if kind == "gspmd":
+            from autodist_tpu.kernel.gspmd import lower_gspmd
+            return lower_gspmd(trainable, strategy, self.mesh)
+        if kind != "collective":
+            raise ValueError(
+                f"unknown lowering {kind!r}; expected 'collective' or 'gspmd'")
         return lower(trainable, strategy, self.mesh)
 
     def build(self, trainable: Trainable,

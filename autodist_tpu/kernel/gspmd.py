@@ -1,0 +1,190 @@
+"""GSPMD lowering path: jit + NamedSharding, XLA inserts collectives.
+
+The second backend beside :mod:`autodist_tpu.kernel.lowering`'s explicit
+shard_map collectives.  Where the reference's synchronizers hand-rewired
+the graph per variable, GSPMD (PAPERS.md 2105.04663) lets XLA derive the
+communication from sharding annotations — the idiomatic TPU path for
+tensor/model parallelism and mixed-axis layouts the reference never had
+(``docs/design/architecture.rst:49-51`` lists op-level model parallelism
+as unimplemented future work).
+
+Chosen when ``Strategy.graph_config.lowering == "gspmd"`` (e.g. the
+``Sharded``/``TensorParallel`` builders).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu import const
+from autodist_tpu.capture import Trainable, path_to_name
+from autodist_tpu.kernel import common
+from autodist_tpu.strategy.ir import Strategy
+from autodist_tpu.utils import logging
+
+
+def _node_spec(node, ndim: int) -> P:
+    """PartitionSpec for one variable from its node config."""
+    part = node.partitioner if node else None
+    if part is None:
+        return P()
+    if part.spec is not None:
+        if len(part.spec) != ndim:
+            raise ValueError(
+                f"{node.var_name}: sharding spec {part.spec} has "
+                f"{len(part.spec)} entries for a rank-{ndim} tensor")
+        return P(*[tuple(a) if isinstance(a, list) else a
+                   for a in part.spec])
+    if part.num_shards > 1 and ndim > 0:
+        spec = [None] * ndim
+        spec[max(part.split_axis, 0)] = part.mesh_axis
+        return P(*spec)
+    return P()
+
+
+@dataclasses.dataclass
+class GspmdLowered:
+    """Same contract as :class:`autodist_tpu.kernel.lowering.Lowered`."""
+
+    mesh: Any
+    init_fn: Any
+    step_fn: Any
+    state_specs: Any
+    state_shardings: Any
+    batch_spec: Any
+    plan: Any = None
+
+    def init_state(self, params=None, extra=None, trainable=None):
+        params = params if params is not None else trainable.params
+        extra = extra if extra is not None else (
+            trainable.extra if trainable else None)
+        return self.init_fn(params, extra)
+
+    def unpad_params(self, params):
+        return params  # GSPMD shards unevenly without padding
+
+
+def lower_gspmd(trainable: Trainable, strategy: Strategy, mesh) -> GspmdLowered:
+    opt = trainable.optimizer
+    nodes = {n.var_name: n for n in strategy.node_configs}
+
+    # The gspmd path delegates all communication to XLA: per-variable
+    # synchronizer knobs (compressors, PS semantics) have no effect here.
+    ignored = sorted({
+        n.var_name for n in strategy.node_configs
+        if getattr(n.synchronizer, "compressor", "none") not in ("", "none")
+        or getattr(n.synchronizer, "kind", "allreduce") == "ps"})
+    if ignored:
+        logging.warning(
+            "gspmd lowering ignores synchronizer config (compressor/PS) on "
+            "%d variable(s), e.g. %s — use the collective lowering for "
+            "those features", len(ignored), ignored[0])
+
+    def axis_size(axis) -> int:
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        size = 1
+        for a in axes:
+            size *= mesh.shape[a]
+        return size
+
+    def param_spec(name, leaf):
+        spec = _node_spec(nodes.get(name), getattr(leaf, "ndim", 0))
+        # jit out_shardings require even divisibility; drop assignments
+        # that don't divide (≙ compiler overriding strategy hints).
+        shape = getattr(leaf, "shape", ())
+        fixed = []
+        for d, axis in enumerate(spec):
+            if axis is not None and shape[d] % axis_size(axis):
+                logging.warning(
+                    "%s: dim %d (size %d) not divisible by mesh axis %r "
+                    "(size %d); replicating that dim", name, d, shape[d],
+                    axis, axis_size(axis))
+                axis = None
+            fixed.append(axis)
+        return P(*fixed) if fixed else P()
+
+    p_specs = common.tree_from_names(trainable.params, param_spec)
+
+    # Optimizer-state specs: path-suffix matching against param specs (same
+    # scheme as the collective path, lowering.py _opt_state_specs).
+    p_spec_list = list(zip([v.name for v in trainable.var_infos()],
+                           jax.tree.leaves(p_specs,
+                                           is_leaf=lambda x: isinstance(x, P))))
+    by_name = dict(p_spec_list)
+    shapes_by_name = {v.name: v.shape for v in trainable.var_infos()}
+
+    opt_shapes = jax.eval_shape(
+        opt.init,
+        jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(
+                tuple(np.shape(l)), jnp.result_type(l)),
+            trainable.params))
+
+    def opt_spec_for(path, leaf):
+        name = path_to_name(path)
+        candidates = [v for v in by_name
+                      if name == v or name.endswith("/" + v)]
+        if candidates:
+            var = max(candidates, key=len)
+            if tuple(leaf.shape) == tuple(shapes_by_name[var]):
+                return by_name[var]
+        return P()
+
+    o_specs = jax.tree_util.tree_map_with_path(opt_spec_for, opt_shapes)
+    extra_specs = jax.tree.map(lambda _: P(), trainable.extra)
+    state_specs = {"step": P(), "params": p_specs, "opt_state": o_specs,
+                   "extra": extra_specs, "sync_state": {}}
+    state_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), state_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    batch_spec = P(const.DATA_AXIS)
+    batch_sharding = NamedSharding(mesh, batch_spec)
+
+    def _init(params, extra):
+        return {"step": jnp.zeros((), jnp.int32),
+                "params": jax.tree.map(jnp.asarray, params),
+                "opt_state": opt.init(jax.tree.map(jnp.asarray, params)),
+                "extra": extra, "sync_state": {}}
+
+    init_fn = jax.jit(_init, out_shardings=state_shardings)
+
+    def constrain(tree, specs):
+        return jax.tree.map(
+            lambda x, s: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, s)),
+            tree, specs, is_leaf=lambda x: isinstance(x, P))
+
+    def _step(state, batch, rng):
+        def loss_of(params):
+            loss, new_extra, metrics = trainable.loss(
+                params, state["extra"], batch, rng)
+            return loss, (new_extra, metrics)
+
+        (loss, (new_extra, metrics)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(state["params"])
+        grads = constrain(grads, p_specs)
+        updates, new_opt = opt.update(grads, state["opt_state"],
+                                      state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return ({"step": state["step"] + 1,
+                 "params": new_params,
+                 "opt_state": new_opt,
+                 "extra": new_extra,
+                 "sync_state": {}},
+                dict(metrics))
+
+    step_fn = jax.jit(
+        _step, donate_argnums=(0,),
+        in_shardings=(state_shardings, batch_sharding, None),
+        out_shardings=(state_shardings, None))
+
+    return GspmdLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
+                        state_specs=state_specs,
+                        state_shardings=state_shardings,
+                        batch_spec=batch_spec)

@@ -1,0 +1,108 @@
+"""Mixture-of-Experts with expert parallelism over the ``expert`` mesh axis.
+
+Beyond reference parity (SURVEY.md §2.10 lists expert parallelism as
+absent): top-2 gated MoE FFN where experts are sharded across devices and
+tokens travel by ``lax.all_to_all`` — the TPU-idiomatic dispatch
+(einsum-based one-hot dispatch/combine, capacity-bounded static shapes;
+the Mesh-TensorFlow / GShard formulation).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from autodist_tpu import const
+
+
+def top2_gating(gate_logits, capacity: int):
+    """GShard-style top-2 gating with capacity.
+
+    gate_logits: [G, E] (per local token, all experts).
+    Returns (dispatch [G, E, C] bool, combine [G, E, C] float, aux_loss).
+    """
+    G, E = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+    top1 = probs.argmax(-1)                             # [G]
+    mask1 = jax.nn.one_hot(top1, E, dtype=jnp.float32)
+    probs_wo1 = probs * (1.0 - mask1)
+    top2 = probs_wo1.argmax(-1)
+    mask2 = jax.nn.one_hot(top2, E, dtype=jnp.float32)
+
+    # load-balancing auxiliary loss (GShard eq. (4))
+    density = mask1.mean(0)                             # fraction routed
+    density_proxy = probs.mean(0)
+    aux_loss = (density * density_proxy).sum() * E
+
+    # positions within each expert's capacity, first-come order
+    pos1 = (jnp.cumsum(mask1, axis=0) - 1.0) * mask1    # [G, E]
+    mask1 = mask1 * (pos1 < capacity)
+    pos2 = (jnp.cumsum(mask2, axis=0) - 1.0 + mask1.sum(0)[None]) * mask2
+    mask2 = mask2 * (pos2 < capacity)
+
+    w1 = (probs * mask1).sum(-1)                        # [G]
+    w2 = (probs * mask2).sum(-1)
+    denom = jnp.maximum(w1 + w2, 1e-9)
+    w1, w2 = w1 / denom, w2 / denom
+
+    def onehot_pos(mask, pos, w):
+        # [G, E, C]: token g → (expert e, slot c) with weight w
+        slot = jax.nn.one_hot((pos * mask).sum(-1).astype(jnp.int32),
+                              capacity, dtype=jnp.float32)  # [G, C]
+        return mask[:, :, None] * slot[:, None, :] * w[:, None, None]
+
+    combine = onehot_pos(mask1, pos1, w1) + onehot_pos(mask2, pos2, w2)
+    dispatch = combine > 0.0
+    return dispatch, combine, aux_loss
+
+
+def expert_parallel_ffn(tokens, gate_w, expert_wi, expert_wo, *,
+                        axis_name: str = const.EXPERT_AXIS,
+                        capacity_factor: float = 2.0):
+    """MoE FFN (call inside ``shard_map``).
+
+    tokens: [G, M] local tokens;  gate_w: [M, E] replicated;
+    expert_wi: [E_local, M, H], expert_wo: [E_local, H, M] — this device's
+    experts.  Returns ([G, M], aux_loss).
+    """
+    P = lax.axis_size(axis_name)
+    G, M = tokens.shape
+    E_local = expert_wi.shape[0]
+    E = E_local * P
+    capacity = max(int(np.ceil(2 * G * capacity_factor / E)), 4)
+
+    gate_logits = tokens @ gate_w                        # [G, E]
+    dispatch, combine, aux = top2_gating(gate_logits, capacity)
+
+    # local dispatch: [E, C, M]
+    xs = jnp.einsum("gm,gec->ecm", tokens.astype(jnp.float32),
+                    dispatch.astype(jnp.float32))
+    # all_to_all (tiled): every device keeps its E_local experts, gathering
+    # those experts' slots from all P devices → [E_local, P*C, M]
+    xs = lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=1,
+                        tiled=True)
+    h = jnp.einsum("ecm,emh->ech", xs, expert_wi.astype(jnp.float32))
+    h = jax.nn.gelu(h)
+    ys = jnp.einsum("ech,ehm->ecm", h, expert_wo.astype(jnp.float32))
+    # route back: [E, C, M] on every source device
+    ys = lax.all_to_all(ys, axis_name, split_axis=1, concat_axis=0,
+                        tiled=True)
+    out = jnp.einsum("ecm,gec->gm", ys, combine)
+    return out.astype(tokens.dtype), aux
+
+
+def dense_moe_reference(tokens, gate_w, expert_wi, expert_wo,
+                        capacity: int):
+    """Single-device reference: same gating + experts, no all_to_all."""
+    G, M = tokens.shape
+    E = expert_wi.shape[0]
+    gate_logits = tokens @ gate_w
+    dispatch, combine, aux = top2_gating(gate_logits, capacity)
+    xs = jnp.einsum("gm,gec->ecm", tokens.astype(jnp.float32),
+                    dispatch.astype(jnp.float32))
+    h = jax.nn.gelu(jnp.einsum("ecm,emh->ech", xs,
+                               expert_wi.astype(jnp.float32)))
+    ys = jnp.einsum("ech,ehm->ecm", h, expert_wo.astype(jnp.float32))
+    return jnp.einsum("ecm,gec->gm", ys, combine).astype(tokens.dtype), aux

@@ -1,0 +1,174 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the ``pipe``
+mesh axis.
+
+Absent from the reference (``architecture.rst:49-51``, SURVEY.md §2.10
+lists pipeline parallelism as not implemented) — built TPU-first: all
+pipeline stages run the *same* SPMD program (identical stage structure,
+stacked parameters sharded on the ``pipe`` axis); activations hop stage to
+stage via ``lax.ppermute`` inside a ``lax.scan`` over schedule ticks.
+The backward pass is the transposed ring (AD through ppermute), giving
+1F1B-equivalent communication without hand-written schedules.
+
+Per-device memory: O(stage params + microbatch activations · ticks); use
+``jax.checkpoint`` in ``stage_fn`` for long pipelines.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu import const
+from autodist_tpu.kernel import common
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, *,
+                   axis_name: str = const.PIPE_AXIS,
+                   num_microbatches: int):
+    """Run the pipeline schedule (call inside ``shard_map``).
+
+    Args:
+      stage_fn: ``(stage_params, activation) -> activation`` — one stage.
+      stage_params: this device's stage parameters (local shard).
+      x: local batch ``[B, ...]``; split into ``num_microbatches`` along dim 0.
+        Only stage 0's value is consumed; pass the same batch on all stages.
+      num_microbatches: M; B must be divisible by M.
+
+    Returns the last stage's outputs ``[B, ...]`` (zeros elsewhere — use
+    :func:`last_stage_value` or a psum to extract).
+    """
+    S = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = num_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    mb = x.reshape(M, B // M, *x.shape[1:])
+
+    # Probe output structure of one microbatch through one stage.
+    out_shape = jax.eval_shape(stage_fn, stage_params, mb[0])
+    T = M + S - 1
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, t):
+        prev_out, outputs = carry
+        recv = lax.ppermute(prev_out, axis_name, perm)
+        mb_idx = jnp.clip(t, 0, M - 1)
+        first_in = lax.dynamic_index_in_dim(mb, mb_idx, keepdims=False)
+        my_in = jnp.where(idx == 0, first_in, recv)
+        out = stage_fn(stage_params, my_in)
+        # Last stage: store microbatch (t - (S-1)) when in range.
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        valid = jnp.logical_and(idx == S - 1, t >= S - 1)
+        current = lax.dynamic_index_in_dim(outputs, out_idx, keepdims=False)
+        new_val = jnp.where(valid, out, current)
+        outputs = lax.dynamic_update_index_in_dim(outputs, new_val, out_idx, 0)
+        return (out, outputs), None
+
+    out0 = jnp.zeros((M, B // M) + tuple(out_shape.shape[1:]),
+                     out_shape.dtype)
+    carry0 = (jnp.zeros(tuple(out_shape.shape), out_shape.dtype), out0)
+    (_, outputs), _ = lax.scan(tick, carry0, jnp.arange(T))
+    return outputs.reshape(B, *outputs.shape[2:])
+
+
+def last_stage_value(value, axis_name: str = const.PIPE_AXIS):
+    """psum-select the last pipeline stage's value (zeros elsewhere)."""
+    S = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    return lax.psum(jnp.where(idx == S - 1, value, jnp.zeros_like(value)),
+                    axis_name)
+
+
+def lower_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
+                   optimizer, mesh, *, num_microbatches: int,
+                   data_axis: str = const.DATA_AXIS,
+                   pipe_axis: str = const.PIPE_AXIS):
+    """Build a complete pipelined SPMD train step.
+
+    ``stacked_params``: pytree whose leaves have a leading stage dimension
+    ``S == mesh.shape[pipe_axis]`` (sharded onto the pipe axis).
+    ``loss_head(outputs, batch) -> (loss, metrics)`` runs on the last stage.
+
+    Returns ``(init_fn, step_fn, state_shardings)`` with the same state
+    dict layout as the other lowerings.
+    """
+    S = mesh.shape[pipe_axis]
+    p_specs = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
+    state_specs = {"step": P(), "params": p_specs, "opt_state": p_specs,
+                   "extra": None, "sync_state": {}}
+
+    def opt_specs_tree(opt_state_shapes):
+        def spec_for(leaf):
+            return P(pipe_axis) if getattr(leaf, "ndim", 0) > 0 \
+                and leaf.shape and leaf.shape[0] == S else P()
+        return jax.tree.map(spec_for, opt_state_shapes)
+
+    opt_shapes = jax.eval_shape(optimizer.init, stacked_params)
+    o_specs = opt_specs_tree(opt_shapes)
+    state_specs["opt_state"] = o_specs
+    state_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                   state_specs,
+                                   is_leaf=lambda x: isinstance(x, P))
+
+    def _init(params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "params": jax.tree.map(jnp.asarray, params),
+                "opt_state": optimizer.init(jax.tree.map(jnp.asarray, params)),
+                "extra": None, "sync_state": {}}
+
+    init_fn = jax.jit(_init, out_shardings=state_shardings)
+
+    def _local_step(state, batch, rng):
+        stage_params = jax.tree.map(lambda p: p[0], state["params"])
+
+        def loss_of(sp):
+            outputs = pipeline_apply(stage_fn, sp, batch["x"],
+                                     axis_name=pipe_axis,
+                                     num_microbatches=num_microbatches)
+            loss, metrics = loss_head(outputs, batch)
+            # Differentiate the *masked local* loss: it is nonzero only on
+            # the last stage, and gradients reach earlier stages through
+            # the transposed ppermute ring.  (A psum here would double-
+            # scale cotangents under check_vma=False; the value is
+            # broadcast after the grad instead.)
+            S_ = lax.axis_size(pipe_axis)
+            idx = lax.axis_index(pipe_axis)
+            masked = jnp.where(idx == S_ - 1, loss, 0.0)
+            return masked, metrics
+
+        (masked_loss, metrics), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(stage_params)
+        idx = lax.axis_index(pipe_axis)
+        S_ = lax.axis_size(pipe_axis)
+        loss = lax.psum(masked_loss, pipe_axis)  # value broadcast only
+        metrics = jax.tree.map(
+            lambda m: lax.psum(
+                jnp.where(idx == S_ - 1, m, jnp.zeros_like(m)), pipe_axis),
+            metrics)
+        grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
+        grads = jax.tree.map(lambda g: g[None], grads)
+
+        updates, new_opt = optimizer.update(grads, state["opt_state"],
+                                            state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        metrics = jax.tree.map(lambda m: lax.pmean(m, data_axis), metrics)
+        return ({"step": state["step"] + 1, "params": new_params,
+                 "opt_state": new_opt, "extra": None, "sync_state": {}},
+                dict(metrics, loss=lax.pmean(loss, data_axis)))
+
+    batch_spec = P(data_axis)
+
+    def _step(state, batch, rng):
+        return jax.shard_map(
+            _local_step, mesh=mesh,
+            in_specs=(state_specs, batch_spec, P()),
+            out_specs=(state_specs, P()),
+            check_vma=False)(state, batch, rng)
+
+    step_fn = jax.jit(_step, donate_argnums=(0,))
+    return init_fn, step_fn, state_shardings

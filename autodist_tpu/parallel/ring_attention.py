@@ -1,0 +1,118 @@
+"""Ring attention: sequence/context parallelism over the ``seq`` mesh axis.
+
+Absent from the reference (``docs/design/architecture.rst:49-51`` declares
+model/sequence parallelism future work; SURVEY.md §5.7) — first-class here
+because long-context is a headline capability of the TPU build.  Design:
+q/k/v are sharded along the sequence dimension; key/value blocks rotate
+around the ring via ``lax.ppermute`` over ICI neighbors while each device
+accumulates its queries' attention with a numerically stable online
+softmax (flash-attention style running max/denominator).  Compute for
+block t overlaps with the DMA of block t+1 (XLA schedules the ppermute
+async); memory per device stays O(L/P · L/P).
+
+AD: the scan + ppermute structure is differentiable (ppermute transposes
+to the inverse permutation), so the backward pass is itself a ring.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _online_block_update(o, m, l, scores, v_blk):
+    """Flash-style accumulate one kv block.
+
+    o: [B, Lq, H, D] running (unnormalized) output
+    m: [B, H, Lq]    running max
+    l: [B, H, Lq]    running denominator
+    scores: [B, H, Lq, Lk] fp32
+    """
+    blk_max = scores.max(axis=-1)                          # [B,H,Lq]
+    new_m = jnp.maximum(m, blk_max)
+    correction = jnp.exp(m - new_m)
+    p = jnp.exp(scores - new_m[..., None])                 # [B,H,Lq,Lk]
+    new_l = l * correction + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk)
+    new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
+    return new_o, new_m, new_l
+
+
+def ring_self_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Ring attention over sequence shards.
+
+    Args (per-device shards, inside ``shard_map``):
+      q, k, v: [B, Lc, H, D] — local chunk of the sequence
+      axis_name: the mesh axis carrying the sequence dimension
+      causal: apply a causal mask using *global* positions
+
+    Returns [B, Lc, H, D].
+    """
+    p = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Lc, H, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+    qf = (q * scale).astype(jnp.float32)
+
+    q_pos = my * Lc + jnp.arange(Lc)                      # global q positions
+
+    o0 = jnp.zeros((B, Lc, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Lc), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Lc), jnp.float32)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def body(carry, step):
+        o, m, l, k_blk, v_blk = carry
+        src = (my - step) % p                             # owner of this block
+        kv_pos = src * Lc + jnp.arange(Lc)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                            k_blk.astype(jnp.float32))
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]      # [Lq, Lk]
+            scores = jnp.where(mask[None, None], scores,
+                               jnp.finfo(jnp.float32).min)
+        o, m, l = _online_block_update(o, m, l, scores, v_blk)
+        # rotate kv to the next device; last rotation is dead but keeps
+        # the loop shape static (XLA elides unused outputs)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (o, m, l, k_blk, v_blk), None
+
+    (o, m, l, _, _), _ = lax.scan(body, (o0, m0, l0, k, v),
+                                  jnp.arange(p))
+    norm = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / norm).astype(q.dtype)
+
+
+def make_ring_attention_fn(*, seq_axis: str = "seq", causal: bool = False):
+    """Adapter: a ``TransformerConfig.attention_fn`` that runs ring
+    attention when traced inside a ``shard_map`` carrying ``seq_axis``."""
+
+    def attention_fn(q, k, v, mask, dropout_rng):
+        del mask, dropout_rng  # causal handled via global positions
+        return ring_self_attention(q, k, v, axis_name=seq_axis,
+                                   causal=causal)
+
+    return attention_fn
+
+
+def sequence_sharded_attention(q, k, v, mesh, *, causal=False,
+                               seq_axis="seq", batch_axis=None):
+    """Convenience wrapper: shard q/k/v along sequence and run the ring.
+
+    Host-level entry (outside shard_map) for testing and for models that
+    want sequence parallelism without the full strategy stack.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(batch_axis, seq_axis)
+    fn = jax.shard_map(
+        functools.partial(ring_self_attention, axis_name=seq_axis,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

@@ -110,6 +110,12 @@ class PartitionerConfig:
 
     partition_str: str = ""
     mesh_axis: str = const.DATA_AXIS
+    # GSPMD generalization (beyond the reference's single axis): one mesh
+    # axis name (or None) per tensor dimension, e.g. ["data", None, "model"].
+    # When set it overrides partition_str/mesh_axis and may shard several
+    # dimensions — the strategy.proto:40-42 extensibility the reference
+    # anticipated.
+    spec: Optional[list] = None
 
     @property
     def partition_list(self) -> list[int]:
@@ -182,6 +188,11 @@ class GraphConfig:
 
     replicas: int = 1
     mesh_axes: dict[str, int] = dataclasses.field(default_factory=dict)
+    # Lowering path: "collective" = explicit per-variable collectives inside
+    # one shard_map (the synchronizer semantics of the reference);
+    # "gspmd" = jit + NamedSharding annotations, XLA inserts collectives
+    # (for tensor/model-parallel and mixed-axis strategies).
+    lowering: str = "collective"
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -189,7 +200,8 @@ class GraphConfig:
     @classmethod
     def from_dict(cls, d):
         return cls(replicas=d.get("replicas", 1),
-                   mesh_axes=dict(d.get("mesh_axes", {})))
+                   mesh_axes=dict(d.get("mesh_axes", {})),
+                   lowering=d.get("lowering", "collective"))
 
 
 @dataclasses.dataclass
@@ -251,7 +263,10 @@ class Strategy:
     def __str__(self):
         lines = [f"Strategy(id={self.id}, replicas={self.graph_config.replicas})"]
         for n in self.node_configs:
-            part = n.partitioner.partition_str if n.partitioner else "-"
+            part = "-"
+            if n.partitioner:
+                part = (str(n.partitioner.spec) if n.partitioner.spec
+                        else n.partitioner.partition_str)
             lines.append(
                 f"  {n.var_name}: sync={n.synchronizer.kind}"
                 f"({getattr(n.synchronizer, 'compressor', '')}) part={part}"

@@ -1,0 +1,102 @@
+"""GSPMD lowering tests: tensor-parallel and FSDP-sharded strategies must
+match single-device numerics, and param shardings must actually land on
+the declared mesh axes."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from autodist_tpu import AutoDist, Trainable
+from autodist_tpu.strategy.gspmd_builders import (FSDPSharded, Sharded,
+                                                  TensorParallel)
+
+from tests.unit.test_end_to_end import (make_batch, make_trainable,
+                                        single_device_reference)
+
+
+def test_sharded_dp_matches_single_device():
+    trainable = make_trainable()
+    batches = [make_batch(s) for s in range(3)]
+    expected = single_device_reference(make_trainable(), batches)
+    runner = AutoDist({}, Sharded()).build(trainable)
+    for b in batches:
+        runner.step(b)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6),
+        runner.get_params(), jax.device_get(expected))
+
+
+def test_sharded_rules_place_params():
+    trainable = make_trainable()
+    rules = [(r"dense/w$", ["model", None])]
+    ad = AutoDist({"mesh": {"data": 4, "model": 2}}, Sharded(rules))
+    runner = ad.build(trainable)
+    w = runner.state["params"]["dense"]["w"]
+    assert w.sharding.spec == P("model", None)
+    b = runner.state["params"]["dense"]["b"]
+    assert b.sharding.spec == P()
+    # training still works and matches single-device numerics
+    batches = [make_batch(s) for s in range(2)]
+    expected = single_device_reference(make_trainable(), batches)
+    for bt in batches:
+        runner.step(bt)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(a, e, rtol=2e-5, atol=2e-6),
+        runner.get_params(), jax.device_get(expected))
+
+
+def test_fsdp_sharded_matches():
+    trainable = make_trainable()
+    batches = [make_batch(s) for s in range(2)]
+    expected = single_device_reference(make_trainable(), batches)
+    runner = AutoDist({}, FSDPSharded(min_size=1)).build(trainable)
+    # dense/w dim0=6 not divisible by 8: lowering replicates it (warns)
+    for b in batches:
+        runner.step(b)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(a, e, rtol=2e-5, atol=2e-6),
+        runner.get_params(), jax.device_get(expected))
+
+
+def test_tensor_parallel_transformer():
+    """TP over a 2x4 data x model mesh on the bundled transformer."""
+    from autodist_tpu import models
+
+    cfg = models.TransformerConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        mlp_dim=64, max_len=16, dtype=jnp.float32, dropout_rate=0.0)
+    model = models.TransformerLM(cfg)
+    rng = jax.random.PRNGKey(0)
+    tokens = jnp.zeros((4, 8), jnp.int32)
+    params = model.init({"params": rng}, tokens)["params"]
+
+    def loss(p, extra, batch, step_rng):
+        logits = model.apply({"params": p}, batch["x"], deterministic=True)
+        l, metrics = models.lm_loss_head(logits, batch)
+        return l, extra, dict(metrics, loss=l)
+
+    trainable = Trainable(loss, params, optax.adam(1e-2), name="lm_tp")
+    ad = AutoDist({"mesh": {"data": 2, "model": 4}}, TensorParallel())
+    runner = ad.build(trainable)
+
+    # qkv kernels must be sharded on the model axis
+    qkv = runner.state["params"]["encoder"]["layer_0"]["attention"]["qkv"]["kernel"]
+    assert qkv.sharding.spec == P(None, None, "model", None)
+    wi = runner.state["params"]["encoder"]["layer_0"]["mlp"]["wi"]["kernel"]
+    assert wi.sharding.spec == P(None, "model")
+
+    r = np.random.RandomState(0)
+    xs = [r.randint(0, 128, (8, 8)).astype(np.int32) for _ in range(4)]
+    batches = [{"x": x, "y": x} for x in xs]  # learnable copy task
+    losses = [float(runner.step(b)["loss"]) for b in batches]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+    # TP numerics must match pure-DP numerics on the same model
+    from autodist_tpu import AllReduce
+    trainable2 = Trainable(loss, params, optax.adam(1e-2), name="lm_dp")
+    runner2 = AutoDist({}, AllReduce()).build(trainable2)
+    losses2 = [float(runner2.step(b)["loss"]) for b in batches]
+    np.testing.assert_allclose(losses, losses2, rtol=5e-4, atol=5e-5)

@@ -1,0 +1,114 @@
+"""MoE expert parallelism: the all_to_all dispatch must compute exactly
+what the single-device dense reference computes per token group, and the
+layer must train."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from autodist_tpu.parallel.moe import (dense_moe_reference,
+                                       expert_parallel_ffn, top2_gating)
+
+Pdev, G, E, M, H = 4, 8, 8, 16, 32
+E_local = E // Pdev
+
+
+def make_weights(seed=0):
+    r = np.random.RandomState(seed)
+    return (jnp.asarray(r.randn(M, E), jnp.float32) * 0.5,
+            jnp.asarray(r.randn(E, M, H), jnp.float32) * 0.2,
+            jnp.asarray(r.randn(E, H, M), jnp.float32) * 0.2)
+
+
+def test_expert_parallel_matches_dense():
+    gate_w, wi, wo = make_weights()
+    r = np.random.RandomState(1)
+    tokens = jnp.asarray(r.randn(Pdev * G, M), jnp.float32)
+    mesh = jax.make_mesh((Pdev,), ("expert",))
+
+    def run(tokens, gate_w, wi, wo):
+        out, aux = expert_parallel_ffn(tokens, gate_w, wi, wo,
+                                       capacity_factor=8.0)
+        return out, lax.pmean(aux, "expert")
+
+    fn = jax.jit(jax.shard_map(
+        run, mesh=mesh,
+        in_specs=(P("expert"), P(), P("expert"), P("expert")),
+        out_specs=(P("expert"), P()), check_vma=False))
+    out, aux = fn(tokens, gate_w, wi, wo)
+    out = np.asarray(out)
+
+    # capacity in the distributed layer: ceil(2*G*cf/E) with cf=8 -> 16
+    capacity = max(int(np.ceil(2 * G * 8.0 / E)), 4)
+    for p in range(Pdev):
+        shard = tokens[p * G:(p + 1) * G]
+        ref, _ = dense_moe_reference(shard, gate_w, wi, wo, capacity)
+        np.testing.assert_allclose(out[p * G:(p + 1) * G], np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+    assert np.isfinite(float(aux))
+
+
+def test_top2_gating_capacity_drops():
+    """With capacity 1 per expert, overflow tokens must be dropped, not
+    mis-routed."""
+    logits = jnp.asarray(np.tile([[5.0, 1.0, 0.0, 0.0]], (6, 1)), jnp.float32)
+    dispatch, combine, aux = top2_gating(logits, capacity=1)
+    # expert 0 can take exactly one token in slot 0
+    assert float(dispatch[:, 0].sum()) == 1.0
+    # weights normalized and bounded
+    assert float(combine.max()) <= 1.0 + 1e-6
+
+
+def test_moe_trains():
+    gate_w, wi, wo = make_weights(2)
+    mesh = jax.make_mesh((Pdev,), ("expert",))
+    r = np.random.RandomState(3)
+    x = r.randn(Pdev * G, M).astype(np.float32)
+    y = (x @ r.randn(M, M).astype(np.float32) * 0.1)
+
+    params = {"gate": gate_w, "wi": wi, "wo": wo}
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+
+    def local_loss(params, xb, yb):
+        out, aux = expert_parallel_ffn(xb, params["gate"], params["wi"],
+                                       params["wo"], capacity_factor=4.0)
+        return jnp.mean((out - yb) ** 2) + 0.01 * aux
+
+    def step(params, opt_state, xb, yb):
+        def total(p):
+            l = local_loss(p, xb, yb)
+            return l
+        l, g = jax.value_and_grad(total)(params)
+        # experts sharded: their grads are local; gate replicated: pmean
+        g = {"gate": lax.pmean(g["gate"], "expert"),
+             "wi": g["wi"], "wo": g["wo"]}
+        l = lax.pmean(l, "expert")
+        u, new_opt = opt.update(g, opt_state, params)
+        return optax.apply_updates(params, u), new_opt, l
+
+    specs_p = {"gate": P(), "wi": P("expert"), "wo": P("expert")}
+
+    # adam state mirrors the params tree: expert leaves sharded, rest rep.
+    def opt_spec(leaf):
+        if getattr(leaf, "ndim", 0) == 3:
+            return P("expert")
+        if getattr(leaf, "ndim", 0) == 2:
+            return P()
+        return P()
+    o_spec_tree = jax.tree.map(opt_spec, opt_state)
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(specs_p, o_spec_tree, P("expert"), P("expert")),
+        out_specs=(specs_p, o_spec_tree, P()), check_vma=False))
+
+    losses = []
+    for _ in range(10):
+        params, opt_state, l = fn(params, opt_state, x, y)
+        losses.append(float(l))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

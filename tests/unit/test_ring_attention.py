@@ -1,0 +1,65 @@
+"""Ring attention correctness: must match single-device full attention
+exactly (same math, different schedule), forward and backward."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from autodist_tpu.parallel.ring_attention import (ring_self_attention,
+                                                  sequence_sharded_attention)
+
+
+def reference_attention(q, k, v, causal=False):
+    D = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
+    if causal:
+        L = q.shape[1]
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v).astype(q.dtype)
+
+
+def make_qkv(seed=0, B=2, L=32, H=4, D=16):
+    r = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(r.randn(B, L, H, D), jnp.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_ring_matches_reference(causal):
+    q, k, v = make_qkv()
+    mesh = jax.make_mesh((8,), ("seq",))
+    out = sequence_sharded_attention(q, k, v, mesh, causal=causal)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["full", "causal"])
+def test_ring_gradients_match(causal):
+    q, k, v = make_qkv(seed=1, L=16)
+    mesh = jax.make_mesh((4,), ("seq",))
+
+    def ring_loss(q, k, v):
+        return (sequence_sharded_attention(q, k, v, mesh, causal=causal) ** 2).sum()
+
+    def ref_loss(q, k, v):
+        return (reference_attention(q, k, v, causal=causal) ** 2).sum()
+
+    g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ring_in_transformer_config():
+    """attention_fn plug-in point: TransformerLM forward under a seq mesh."""
+    from autodist_tpu.parallel.ring_attention import make_ring_attention_fn
+    from autodist_tpu.models.transformer import (TransformerConfig,
+                                                 dot_product_attention)
+    # Smoke check that the adapter signature matches the plug-in contract.
+    mesh = jax.make_mesh((4,), ("seq",))
+    fn = make_ring_attention_fn(causal=True)
+    assert callable(fn)
