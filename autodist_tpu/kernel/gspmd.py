@@ -59,6 +59,7 @@ class GspmdLowered:
     state_shardings: Any
     batch_spec: Any
     plan: Any = None
+    eval_fn: Any = None
 
     def init_state(self, params=None, extra=None, trainable=None):
         params = params if params is not None else trainable.params
@@ -184,7 +185,15 @@ def lower_gspmd(trainable: Trainable, strategy: Strategy, mesh) -> GspmdLowered:
         in_shardings=(state_shardings, batch_sharding, None),
         out_shardings=(state_shardings, None))
 
+    def _eval(state, batch, rng):
+        _, _, metrics = trainable.loss(state["params"], state["extra"],
+                                       batch, rng)
+        return dict(metrics)
+
+    eval_fn = jax.jit(
+        _eval, in_shardings=(state_shardings, batch_sharding, None))
+
     return GspmdLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
                         state_specs=state_specs,
                         state_shardings=state_shardings,
-                        batch_spec=batch_spec)
+                        batch_spec=batch_spec, eval_fn=eval_fn)

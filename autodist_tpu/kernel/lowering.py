@@ -243,6 +243,31 @@ def _sync_state_shapes(plan: Plan, trainable: Trainable, n: int):
 # --------------------------------------------------------------------------- #
 # The lowered program
 # --------------------------------------------------------------------------- #
+def _gather_full(plan: Plan, data_axis: str, stored):
+    """Stored-space params → full (gather sharded vars, unpad)."""
+
+    def full(name, p):
+        vp = plan.var_plans[name]
+        if vp.stored_sharded:
+            return common.all_gather_axis(
+                p, data_axis, vp.split_axis, vp.shape[vp.split_axis])
+        return p
+
+    return common.tree_from_names(stored, full)
+
+
+def _reduce_metrics(tree, data_axis: str):
+    """Cross-replica metric reduction: floats are averaged, integer
+    counts are summed (each is the correct global semantics)."""
+    def red(x):
+        if jnp.issubdtype(jnp.result_type(x), jnp.inexact):
+            return lax.pmean(x, data_axis)
+        if jnp.issubdtype(jnp.result_type(x), jnp.integer):
+            return lax.psum(x, data_axis)
+        return x
+    return jax.tree.map(red, tree)
+
+
 @dataclasses.dataclass
 class Lowered:
     """Compiled artifacts: jitted init and train-step functions plus the
@@ -255,6 +280,7 @@ class Lowered:
     state_specs: Any      # pytree of PartitionSpec
     state_shardings: Any  # pytree of NamedSharding
     batch_spec: Any
+    eval_fn: Any = None   # (state, batch, rng) -> metrics (no update)
 
     def init_state(self, params=None, extra=None, trainable=None):
         params = params if params is not None else trainable.params
@@ -328,21 +354,12 @@ def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
     # ---------------- train step ------------------------------------------ #
     def _local_step(state, batch, rng):
         params_store = state["params"]
-
-        def to_full(stored):
-            def full(name, p):
-                vp = plan.var_plans[name]
-                if vp.stored_sharded:
-                    return common.all_gather_axis(
-                        p, data_axis, vp.split_axis, vp.shape[vp.split_axis])
-                return p
-            return common.tree_from_names(stored, full)
-
         local_rng = jax.random.fold_in(rng, lax.axis_index(data_axis))
 
         def stored_loss(stored):
             loss, new_extra, metrics = trainable.loss(
-                to_full(stored), state["extra"], batch, local_rng)
+                _gather_full(plan, data_axis, stored), state["extra"],
+                batch, local_rng)
             return loss, (new_extra, metrics)
 
         grad_fn = jax.value_and_grad(stored_loss, has_aux=True)
@@ -414,11 +431,13 @@ def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
 
         new_params = common.tree_from_names(u_new, to_store)
 
-        pmean_f = lambda t: jax.tree.map(
+        metrics = _reduce_metrics(dict(metrics), data_axis)
+        # extra state (e.g. batch stats) must be SPMD-invariant: average
+        # float leaves defensively even if the model forgot axis_name.
+        new_extra = jax.tree.map(
             lambda x: lax.pmean(x, data_axis)
-            if jnp.issubdtype(jnp.result_type(x), jnp.inexact) else x, t)
-        metrics = pmean_f(dict(metrics))
-        new_extra = pmean_f(new_extra)
+            if jnp.issubdtype(jnp.result_type(x), jnp.inexact) else x,
+            new_extra)
 
         full_sync_state = dict(state["sync_state"])
         full_sync_state.update(new_sync_state)
@@ -442,6 +461,22 @@ def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
 
     step_fn = jax.jit(_step, donate_argnums=(0,))
 
+    # ---------------- eval step (no update; fetch contract) --------------- #
+    def _local_eval(state, batch, rng):
+        params_full = _gather_full(plan, data_axis, state["params"])
+        loss, _, metrics = trainable.loss(
+            params_full, state["extra"], batch,
+            jax.random.fold_in(rng, lax.axis_index(data_axis)))
+        return _reduce_metrics(dict(metrics), data_axis)
+
+    def _eval(state, batch, rng):
+        return jax.shard_map(
+            _local_eval, mesh=mesh,
+            in_specs=(state_specs, batch_spec, P()),
+            out_specs=P(), check_vma=False)(state, batch, rng)
+
+    eval_fn = jax.jit(_eval)
+
     return Lowered(plan=plan, mesh=mesh, init_fn=init_fn, step_fn=step_fn,
                    state_specs=state_specs, state_shardings=state_shardings,
-                   batch_spec=batch_spec)
+                   batch_spec=batch_spec, eval_fn=eval_fn)

@@ -87,6 +87,28 @@ class DistributedRunner:
             i += 1
         return metrics
 
+    def eval_step(self, batch, *, rng=None):
+        """Metrics without updating state (fetch-only contract — the
+        reference fetched tensors from the master replica without running
+        train ops, ``remapper.py:125-185``)."""
+        if self.lowered.eval_fn is None:
+            raise NotImplementedError("this lowering has no eval path")
+        batch = self._place_batch(batch)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        return self.lowered.eval_fn(self.state, batch, rng)
+
+    def evaluate(self, data: Iterable, num_batches: Optional[int] = None):
+        """Mean metrics over an eval dataset."""
+        sums, count = {}, 0
+        for i, batch in enumerate(data):
+            if num_batches is not None and i >= num_batches:
+                break
+            m = jax.device_get(self.eval_step(batch))
+            for k, v in m.items():
+                sums[k] = sums.get(k, 0.0) + np.asarray(v, dtype=float)
+            count += 1
+        return {k: v / max(count, 1) for k, v in sums.items()}
+
     # ---------------- fetches ------------------------------------------- #
     @property
     def step_count(self) -> int:

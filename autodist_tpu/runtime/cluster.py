@@ -1,0 +1,205 @@
+"""Multi-host cluster runtime: launcher, coordinator, failure watcher.
+
+Counterpart of the reference's cluster layer
+(``autodist/cluster.py`` — SSH/SFTP process control and per-node TF
+servers — plus ``autodist/coordinator.py`` — chief re-launches the user
+script on every worker with env-var role markers and hard-exits on any
+worker failure, ``coordinator.py:98-110``).
+
+On TPU pods there are no per-node graph servers: every host runs the same
+SPMD program connected through ``jax.distributed``.  What remains of the
+reference's runtime — and is built here — is:
+
+* the chief-launches-workers process model (``Coordinator``), with the
+  same env-var plane (``AUTODIST_TPU_WORKER``, ``AUTODIST_TPU_STRATEGY_ID``
+  ≙ ``AUTODIST_WORKER``/``AUTODIST_STRATEGY_ID``) so heterogeneous
+  strategy builders stay deterministic across hosts;
+* fail-fast watchers per worker (detection only, no recovery — the
+  reference's exact semantics, SURVEY.md §5.3) with clean teardown via
+  ``atexit`` (≙ ``cluster.py:171-216``);
+* per-host data feeding (feed-split ≙ ``remapper.py:109-123``) via
+  ``jax.make_array_from_process_local_data``.
+
+Remote transport is plain ``ssh`` subprocesses (paramiko is not in this
+image); ``LocalCluster`` spawns workers on localhost for testing the
+process plane without hardware.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Optional, Sequence
+
+from autodist_tpu import const
+from autodist_tpu.utils import logging
+
+
+class WorkerHandle:
+    """One launched worker process and its watcher thread."""
+
+    def __init__(self, name: str, proc: subprocess.Popen,
+                 on_failure: Callable[["WorkerHandle", int], None]):
+        self.name = name
+        self.proc = proc
+        self._on_failure = on_failure
+        self.thread = threading.Thread(target=self._watch, daemon=True)
+        self.thread.start()
+
+    def _watch(self):
+        rc = self.proc.wait()
+        if rc != 0:
+            self._on_failure(self, rc)
+
+    @property
+    def running(self) -> bool:
+        return self.proc.poll() is None
+
+    def terminate(self):
+        if self.running:
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                self.proc.terminate()
+
+
+class Coordinator:
+    """Chief-side process manager (≙ reference ``Coordinator``).
+
+    ``launch_workers`` starts one copy of ``argv`` per worker with the
+    role env vars set; any worker exiting non-zero triggers fail-fast
+    (terminate everything, then ``on_failure`` — by default raising in
+    ``join``; the reference hard-exited the chief, ``coordinator.py:108``).
+    """
+
+    def __init__(self, fail_fast: bool = True):
+        self.fail_fast = fail_fast
+        self.workers: list[WorkerHandle] = []
+        self._terminated = False
+        self._lock = threading.Lock()
+        atexit.register(self.terminate)
+
+    def _worker_failed(self, worker: WorkerHandle, rc: int):
+        with self._lock:
+            if self._terminated:
+                return  # we killed it ourselves; not a failure
+        logging.error("worker %s exited with %d", worker.name, rc)
+        if self.fail_fast:
+            self.terminate()
+
+    def _failures(self) -> list[tuple[str, int]]:
+        """Authoritative failure list from process returncodes (no watcher
+        race): terminated-by-us (negative rc after our own terminate) is
+        excluded only when we initiated teardown due to a real failure —
+        the first genuinely failing worker is always present."""
+        out = []
+        for w in self.workers:
+            rc = w.proc.poll()
+            if rc is not None and rc != 0 and not (self._terminated and rc < 0):
+                out.append((w.name, rc))
+        return out
+
+    def launch(self, name: str, argv: Sequence[str], *,
+               env: Optional[dict] = None, host: Optional[str] = None,
+               cwd: Optional[str] = None) -> WorkerHandle:
+        """Launch one worker locally, or on ``host`` via ssh."""
+        full_env = dict(os.environ)
+        full_env.update(env or {})
+        if host:
+            assignments = " ".join(
+                f"{k}={shlex.quote(v)}" for k, v in (env or {}).items())
+            remote = f"{assignments} {' '.join(shlex.quote(a) for a in argv)}"
+            argv = ["ssh", "-o", "BatchMode=yes", host, remote]
+        proc = subprocess.Popen(
+            list(argv), env=full_env, cwd=cwd, start_new_session=True)
+        handle = WorkerHandle(name, proc, self._worker_failed)
+        self.workers.append(handle)
+        logging.info("launched worker %s (pid %d)%s", name, proc.pid,
+                     f" on {host}" if host else "")
+        return handle
+
+    def join(self, timeout: Optional[float] = None):
+        """Wait for all workers; raise if any failed (fail-fast)."""
+        deadline = time.time() + timeout if timeout else None
+        for w in self.workers:
+            remaining = None if deadline is None \
+                else max(deadline - time.time(), 0.01)
+            try:
+                w.proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                self.terminate()
+                raise TimeoutError(f"worker {w.name} timed out")
+        failures = self._failures()
+        if failures:
+            raise RuntimeError(f"workers failed: {failures}")
+
+    def terminate(self):
+        with self._lock:
+            self._terminated = True
+        for w in self.workers:
+            w.terminate()
+
+
+class Cluster:
+    """The multi-host launch plan (≙ reference ``SSHCluster``).
+
+    ``spec['multihost']`` lists hosts; the chief (process 0) launches the
+    *same user script* on every other host with role env vars — the
+    reference's exact model (``coordinator.py:66-90``) minus graph
+    shipping (the strategy file is tiny JSON; SPMD ships nothing else).
+    """
+
+    def __init__(self, resource_spec, hosts: Optional[Sequence[str]] = None):
+        self.resource_spec = resource_spec
+        self.hosts = list(hosts or [])
+        self.coordinator = Coordinator()
+
+    @property
+    def is_chief(self) -> bool:
+        return not const.ENV.AUTODIST_TPU_WORKER.val
+
+    def launch_clients(self, strategy_id: str,
+                       argv: Optional[Sequence[str]] = None):
+        """Chief: start the user script on every worker host."""
+        if not self.is_chief:
+            return []
+        argv = list(argv or [sys.executable, os.path.abspath(sys.argv[0]),
+                             *sys.argv[1:]])
+        handles = []
+        for i, host in enumerate(self.hosts):
+            env = {
+                "AUTODIST_TPU_WORKER": host,
+                "AUTODIST_TPU_STRATEGY_ID": strategy_id,
+                "AUTODIST_TPU_PROCESS_ID": str(i + 1),
+                "AUTODIST_TPU_NUM_PROCESSES": str(len(self.hosts) + 1),
+                "AUTODIST_TPU_COORDINATOR": self.resource_spec.coordinator,
+            }
+            handles.append(self.coordinator.launch(
+                f"worker-{i + 1}", argv, env=env,
+                host=None if host in ("localhost", "127.0.0.1") else host))
+        return handles
+
+    def join(self, timeout: Optional[float] = None):
+        self.coordinator.join(timeout)
+
+    def terminate(self):
+        self.coordinator.terminate()
+
+
+def make_global_batch(batch, mesh, spec=None):
+    """Per-host feed: assemble a global array from this host's local shard
+    (feed-split contract ≙ ``remapper.py:109-123``; on one host this is a
+    plain device_put)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, spec if spec is not None else P(const.DATA_AXIS))
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x), batch)

@@ -1,0 +1,130 @@
+"""Tracing / profiling / throughput meters.
+
+Counterpart of the reference's observability layer (SURVEY.md §5.1):
+chrome-trace timelines per ``session.run`` (``runner.py:64-75``), graph
+transformation-stage snapshots (``visualization_util.py:24-36``), and the
+benchmark ``TimeHistory`` examples/sec meter
+(``examples/benchmark/imagenet.py:84-140``) — rebuilt on ``jax.profiler``
+traces (TensorBoard/Perfetto), HLO stage dumps, and blocking step timers.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from autodist_tpu import const
+from autodist_tpu.utils import logging
+
+
+@contextlib.contextmanager
+def trace(trace_dir: Optional[str] = None):
+    """Profile a region to a TensorBoard/Perfetto trace
+    (≙ chrome://tracing JSON under ``/tmp/autodist/traces``)."""
+    import jax
+
+    trace_dir = trace_dir or const.DEFAULT_TRACE_DIR
+    os.makedirs(trace_dir, exist_ok=True)
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield trace_dir
+    finally:
+        jax.profiler.stop_trace()
+        logging.info("trace written to %s", trace_dir)
+
+
+def dump_stages(lowered, trainable, strategy, out_dir: Optional[str] = None,
+                example_batch=None):
+    """Dump the per-stage artifacts of a build (≙ the reference's
+    0-original … 3-transformed TensorBoard graph snapshots,
+    ``graph_transformer.py:62-90``):
+
+      0-strategy.json   — the strategy IR
+      1-plan.txt        — resolved per-variable lowering plan
+      2-step.hlo.txt    — the compiled SPMD step's HLO
+    """
+    import jax
+
+    out_dir = out_dir or os.path.join(const.DEFAULT_WORKING_DIR, "stages")
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "0-strategy.json"), "w") as f:
+        f.write(strategy.to_json())
+    with open(os.path.join(out_dir, "1-plan.txt"), "w") as f:
+        plan = getattr(lowered, "plan", None)
+        if plan is not None and hasattr(plan, "var_plans"):
+            for name, vp in plan.var_plans.items():
+                f.write(f"{name}: stored_sharded={vp.stored_sharded} "
+                        f"axis={vp.split_axis} update={vp.update} "
+                        f"bucket={vp.bucket} compressor={vp.compressor}\n")
+        else:
+            f.write("gspmd lowering (XLA-derived collectives)\n")
+    if example_batch is not None:
+        try:
+            import jax.random as jrandom
+            state = lowered.init_state(trainable=trainable)
+            txt = lowered.step_fn.lower(
+                state, example_batch, jrandom.PRNGKey(0)).as_text()
+            with open(os.path.join(out_dir, "2-step.hlo.txt"), "w") as f:
+                f.write(txt)
+        except Exception as e:  # HLO dump is best-effort observability
+            logging.warning("HLO dump failed: %s", e)
+    logging.info("stage dumps written to %s", out_dir)
+    return out_dir
+
+
+class StepTimer:
+    """Throughput meter (≙ ``TimeHistory``: examples/sec =
+    batch_size × log_steps / elapsed)."""
+
+    def __init__(self, batch_size: int, warmup: int = 2):
+        self.batch_size = batch_size
+        self.warmup = warmup
+        self._times: list[float] = []
+        self._t0: Optional[float] = None
+        self._count = 0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        self._count += 1
+        if self._count > self.warmup:
+            self._times.append(dt)
+
+    @property
+    def steps_recorded(self) -> int:
+        return len(self._times)
+
+    @property
+    def mean_step_seconds(self) -> float:
+        return float(np.mean(self._times)) if self._times else float("nan")
+
+    @property
+    def examples_per_sec(self) -> float:
+        return self.batch_size / self.mean_step_seconds
+
+    def summary(self) -> dict:
+        ts = np.asarray(self._times)
+        return {
+            "steps": len(ts),
+            "mean_ms": float(ts.mean() * 1e3) if len(ts) else None,
+            "p50_ms": float(np.percentile(ts, 50) * 1e3) if len(ts) else None,
+            "p99_ms": float(np.percentile(ts, 99) * 1e3) if len(ts) else None,
+            "examples_per_sec": self.examples_per_sec if len(ts) else None,
+        }
+
+
+def mfu(examples_per_sec: float, flops_per_example: float,
+        peak_flops_total: float) -> float:
+    """Model FLOP utilization (the BASELINE.md headline metric)."""
+    return examples_per_sec * flops_per_example / peak_flops_total
+
+
+def transformer_train_flops_per_token(num_params: int) -> float:
+    """6N approximation: fwd 2N + bwd 4N FLOPs per token."""
+    return 6.0 * num_params

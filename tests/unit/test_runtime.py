@@ -1,0 +1,118 @@
+"""Runtime layer tests: coordinator process management (fail-fast
+semantics ≙ reference coordinator watcher, ``coordinator.py:98-110``),
+per-host feeding, profiling meters and stage dumps."""
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu.runtime.cluster import (Cluster, Coordinator,
+                                          make_global_batch)
+from autodist_tpu.utils.profiling import (StepTimer, dump_stages, mfu,
+                                          transformer_train_flops_per_token)
+
+
+def test_coordinator_success_join():
+    c = Coordinator()
+    c.launch("ok-1", [sys.executable, "-c", "print('hi')"])
+    c.launch("ok-2", [sys.executable, "-c", "import time; time.sleep(0.2)"])
+    c.join(timeout=30)
+
+
+def test_coordinator_fail_fast_kills_siblings():
+    c = Coordinator()
+    slow = c.launch("slow", [sys.executable, "-c",
+                             "import time; time.sleep(60)"])
+    c.launch("bad", [sys.executable, "-c", "import sys; sys.exit(3)"])
+    with pytest.raises(RuntimeError, match="bad.*3"):
+        c.join(timeout=30)
+    # the long-running sibling must have been terminated (fail-fast)
+    deadline = time.time() + 10
+    while slow.running and time.time() < deadline:
+        time.sleep(0.1)
+    assert not slow.running
+
+
+def test_coordinator_timeout():
+    c = Coordinator()
+    c.launch("hang", [sys.executable, "-c", "import time; time.sleep(60)"])
+    with pytest.raises(TimeoutError):
+        c.join(timeout=1)
+
+
+def test_cluster_launch_env_plane(tmp_path):
+    """Workers get the role env vars (≙ AUTODIST_WORKER/STRATEGY_ID)."""
+    out = tmp_path / "env.txt"
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os\n"
+        "open(%r, 'w').write(os.environ.get('AUTODIST_TPU_WORKER','') + '|' +\n"
+        "    os.environ.get('AUTODIST_TPU_STRATEGY_ID','') + '|' +\n"
+        "    os.environ.get('AUTODIST_TPU_PROCESS_ID',''))\n" % str(out))
+    from autodist_tpu import ResourceSpec
+    cluster = Cluster(ResourceSpec({}), hosts=["localhost"])
+    cluster.launch_clients("strat-42", argv=[sys.executable, str(script)])
+    cluster.join(timeout=30)
+    assert out.read_text() == "localhost|strat-42|1"
+
+
+def test_make_global_batch_single_host():
+    mesh = jax.make_mesh((8,), ("data",))
+    batch = {"x": np.arange(16.0).reshape(16, 1)}
+    global_b = make_global_batch(batch, mesh)
+    assert global_b["x"].shape == (16, 1)
+    assert global_b["x"].sharding.spec == jax.sharding.PartitionSpec("data")
+
+
+def test_step_timer_and_mfu():
+    t = StepTimer(batch_size=64, warmup=1)
+    for _ in range(4):
+        with t:
+            time.sleep(0.01)
+    s = t.summary()
+    assert s["steps"] == 3
+    assert s["examples_per_sec"] > 0
+    assert 0 < mfu(1000, transformer_train_flops_per_token(1_000_000),
+                   1e15) < 1
+
+
+def test_dump_stages(tmp_path):
+    from autodist_tpu import AllReduce, AutoDist
+    from tests.unit.test_end_to_end import make_batch, make_trainable
+
+    trainable = make_trainable()
+    ad = AutoDist({}, AllReduce())
+    strategy = ad.build_or_load_strategy(trainable)
+    lowered = ad.lower(trainable, strategy)
+    runner_batch = jax.tree.map(lambda x: jax.numpy.asarray(x), make_batch())
+    out = dump_stages(lowered, trainable, strategy, str(tmp_path),
+                      example_batch=runner_batch)
+    names = sorted(os.listdir(out))
+    assert "0-strategy.json" in names
+    assert "1-plan.txt" in names
+    assert "2-step.hlo.txt" in names
+    hlo = open(os.path.join(out, "2-step.hlo.txt")).read()
+    assert "all-reduce" in hlo or "all_reduce" in hlo.replace("-", "_")
+
+
+def test_eval_step_no_update():
+    from autodist_tpu import AllReduce, AutoDist, PartitionedPS
+    from autodist_tpu.strategy.gspmd_builders import Sharded
+    from tests.unit.test_end_to_end import make_batch, make_trainable
+
+    for builder in (AllReduce(), PartitionedPS(), Sharded()):
+        runner = AutoDist({}, builder).build(make_trainable())
+        before = runner.get_params()
+        m = runner.eval_step(make_batch())
+        assert np.isfinite(float(m["loss"]))
+        after = runner.get_params()
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), before, after)
+        # evaluate() over several batches
+        agg = runner.evaluate([make_batch(s) for s in range(3)])
+        assert "loss" in agg and np.isfinite(agg["loss"])
