@@ -81,6 +81,7 @@ class Trainable:
         optimizer: Any,  # optax.GradientTransformation
         *,
         extra: Any = None,
+        eval_loss: Optional[Callable] = None,
         sparse_params: Sequence[str] = (),
         detect_sparse: bool = True,
         name: str = "trainable",
@@ -89,6 +90,10 @@ class Trainable:
         self.params = params
         self.optimizer = optimizer
         self.extra = extra
+        # Inference-mode loss for runner.eval_step/evaluate: same signature
+        # as ``loss`` but must apply the model with dropout off and BatchNorm
+        # running averages.  Falls back to the train loss when not given.
+        self.eval_loss = eval_loss if eval_loss is not None else loss
         self.name = name
         self._explicit_sparse = set(sparse_params)
         self._detect_sparse = detect_sparse

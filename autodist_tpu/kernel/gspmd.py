@@ -186,8 +186,8 @@ def lower_gspmd(trainable: Trainable, strategy: Strategy, mesh) -> GspmdLowered:
         out_shardings=(state_shardings, None))
 
     def _eval(state, batch, rng):
-        _, _, metrics = trainable.loss(state["params"], state["extra"],
-                                       batch, rng)
+        _, _, metrics = trainable.eval_loss(state["params"], state["extra"],
+                                            batch, rng)
         return dict(metrics)
 
     eval_fn = jax.jit(

@@ -464,7 +464,7 @@ def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
     # ---------------- eval step (no update; fetch contract) --------------- #
     def _local_eval(state, batch, rng):
         params_full = _gather_full(plan, data_axis, state["params"])
-        loss, _, metrics = trainable.loss(
+        loss, _, metrics = trainable.eval_loss(
             params_full, state["extra"], batch,
             jax.random.fold_in(rng, lax.axis_index(data_axis)))
         return _reduce_metrics(dict(metrics), data_axis)

@@ -9,10 +9,15 @@ from autodist_tpu.models.cnn import (MnistCNN, make_cnn_trainable,
                                      make_linear_regression_trainable)
 from autodist_tpu.models.lm1b import (LSTMWordLM, make_lm1b_trainable,
                                       sampled_softmax_loss)
+from autodist_tpu.models.densenet import (DenseNet, DenseNet121, DenseNet169,
+                                          DenseNet201)
+from autodist_tpu.models.inception import InceptionV3
 from autodist_tpu.models.ncf import NeuMF, make_ncf_trainable
 from autodist_tpu.models.resnet import (ResNet18, ResNet34, ResNet50,
                                         ResNet101, ResNet152,
                                         classification_loss_head,
+                                        make_image_trainable,
                                         make_resnet_trainable)
+from autodist_tpu.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19
 from autodist_tpu.models.transformer import (Encoder, TransformerConfig,
                                              TransformerLM, lm_loss_head)

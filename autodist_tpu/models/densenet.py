@@ -1,0 +1,89 @@
+"""DenseNet family (DenseNet121/169/201) for ImageNet-style classification.
+
+Counterpart of the reference's DenseNet121 benchmark model
+(``examples/benchmark/imagenet.py`` drives
+``tf.keras.applications.DenseNet121``).  TPU-first: NHWC, bfloat16
+compute, fp32 BatchNorm statistics synchronized over the data mesh axis
+(``axis_name``), concatenation-heavy dense blocks left to XLA fusion.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_CFG = {
+    121: (6, 12, 24, 16),
+    169: (6, 12, 32, 32),
+    201: (6, 12, 48, 32),
+}
+
+
+class DenseLayer(nn.Module):
+    """BN-ReLU-Conv1x1 (bottleneck 4k) -> BN-ReLU-Conv3x3 (growth k)."""
+    growth_rate: int
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.relu(self.norm()(x))
+        y = self.conv(4 * self.growth_rate, (1, 1))(y)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.growth_rate, (3, 3))(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class TransitionLayer(nn.Module):
+    """BN-ReLU-Conv1x1 (halve channels) -> 2x2 average pool."""
+    out_features: int
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(self.norm()(x))
+        x = self.conv(self.out_features, (1, 1))(x)
+        return nn.avg_pool(x, (2, 2), strides=(2, 2))
+
+
+class DenseNet(nn.Module):
+    depth: int = 121
+    growth_rate: int = 32
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, padding="SAME",
+                                 dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=self.dtype,
+            axis_name=self.axis_name if train else None)
+        x = x.astype(self.dtype)
+        x = conv(2 * self.growth_rate, (7, 7), (2, 2),
+                 padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        x = nn.relu(norm(name="bn_init")(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        block_sizes = _CFG[self.depth]
+        features = 2 * self.growth_rate
+        for i, n_layers in enumerate(block_sizes):
+            for _ in range(n_layers):
+                x = DenseLayer(self.growth_rate, conv=conv, norm=norm)(x)
+            features += n_layers * self.growth_rate
+            if i != len(block_sizes) - 1:
+                features //= 2
+                x = TransitionLayer(features, conv=conv, norm=norm)(x)
+        x = nn.relu(norm(name="bn_final")(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+DenseNet121 = functools.partial(DenseNet, depth=121)
+DenseNet169 = functools.partial(DenseNet, depth=169)
+DenseNet201 = functools.partial(DenseNet, depth=201)

@@ -1,0 +1,160 @@
+"""Inception v3 for ImageNet-style classification (299x299 input).
+
+Counterpart of the reference's InceptionV3 benchmark model
+(``examples/benchmark/imagenet.py`` drives
+``tf.keras.applications.InceptionV3``).  TPU-first: NHWC, bfloat16
+compute, fp32 synced BatchNorm; the factorized 7x7/3x3 branches are
+plain convs that XLA fuses with the following BN+ReLU.  The auxiliary
+classifier head is omitted (modern training does not need it; the
+reference's Keras model also drops it at inference).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: tuple[int, int]
+    strides: tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    conv: Any = None
+    norm: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = self.conv(self.features, self.kernel, self.strides,
+                      padding=self.padding)(x)
+        return nn.relu(self.norm()(x))
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cbn = functools.partial(ConvBN, conv=self.conv, norm=self.norm)
+        b1 = cbn(64, (1, 1))(x)
+        b2 = cbn(64, (5, 5))(cbn(48, (1, 1))(x))
+        b3 = cbn(96, (3, 3))(cbn(96, (3, 3))(cbn(64, (1, 1))(x)))
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = cbn(self.pool_features, (1, 1))(b4)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35x35 -> 17x17."""
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cbn = functools.partial(ConvBN, conv=self.conv, norm=self.norm)
+        b1 = cbn(384, (3, 3), (2, 2), padding="VALID")(x)
+        b2 = cbn(96, (3, 3), (2, 2), padding="VALID")(
+            cbn(96, (3, 3))(cbn(64, (1, 1))(x)))
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7x7 branches at 17x17."""
+    channels_7x7: int
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cbn = functools.partial(ConvBN, conv=self.conv, norm=self.norm)
+        c = self.channels_7x7
+        b1 = cbn(192, (1, 1))(x)
+        b2 = cbn(c, (1, 1))(x)
+        b2 = cbn(c, (1, 7))(b2)
+        b2 = cbn(192, (7, 1))(b2)
+        b3 = cbn(c, (1, 1))(x)
+        b3 = cbn(c, (7, 1))(b3)
+        b3 = cbn(c, (1, 7))(b3)
+        b3 = cbn(c, (7, 1))(b3)
+        b3 = cbn(192, (1, 7))(b3)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = cbn(192, (1, 1))(b4)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17x17 -> 8x8."""
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cbn = functools.partial(ConvBN, conv=self.conv, norm=self.norm)
+        b1 = cbn(320, (3, 3), (2, 2), padding="VALID")(cbn(192, (1, 1))(x))
+        b2 = cbn(192, (1, 1))(x)
+        b2 = cbn(192, (1, 7))(b2)
+        b2 = cbn(192, (7, 1))(b2)
+        b2 = cbn(192, (3, 3), (2, 2), padding="VALID")(b2)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded-filter-bank block at 8x8."""
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        cbn = functools.partial(ConvBN, conv=self.conv, norm=self.norm)
+        b1 = cbn(320, (1, 1))(x)
+        b2 = cbn(384, (1, 1))(x)
+        b2 = jnp.concatenate(
+            [cbn(384, (1, 3))(b2), cbn(384, (3, 1))(b2)], axis=-1)
+        b3 = cbn(384, (3, 3))(cbn(448, (1, 1))(x))
+        b3 = jnp.concatenate(
+            [cbn(384, (1, 3))(b3), cbn(384, (3, 1))(b3)], axis=-1)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = cbn(192, (1, 1))(b4)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-3, dtype=self.dtype,
+            axis_name=self.axis_name if train else None)
+        cbn = functools.partial(ConvBN, conv=conv, norm=norm)
+        x = x.astype(self.dtype)
+        # Stem: 299 -> 35
+        x = cbn(32, (3, 3), (2, 2), padding="VALID")(x)
+        x = cbn(32, (3, 3), padding="VALID")(x)
+        x = cbn(64, (3, 3))(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = cbn(80, (1, 1), padding="VALID")(x)
+        x = cbn(192, (3, 3), padding="VALID")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # Inception stacks
+        for pool_features in (32, 64, 64):
+            x = InceptionA(pool_features, conv=conv, norm=norm)(x)
+        x = InceptionB(conv=conv, norm=norm)(x)
+        for c in (128, 160, 160, 192):
+            x = InceptionC(c, conv=conv, norm=norm)(x)
+        x = InceptionD(conv=conv, norm=norm)(x)
+        x = InceptionE(conv=conv, norm=norm)(x)
+        x = InceptionE(conv=conv, norm=norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)

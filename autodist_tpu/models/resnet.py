@@ -116,22 +116,50 @@ def classification_loss_head(logits, batch):
     return loss, {"accuracy": acc}
 
 
-def make_resnet_trainable(model, optimizer, rng, *, image_size=224,
-                          channels=3, batch_size=8):
-    """Trainable for a ResNet with synced BatchNorm extra-state."""
+def make_image_trainable(model, optimizer, rng, *, image_size=224,
+                         channels=3, batch_size=8, name="image"):
+    """Trainable for any image classifier in the zoo.
+
+    Handles both BatchNorm models (ResNet/DenseNet/Inception — running
+    statistics carried as Trainable extra-state, synced over the data
+    axis) and stateless ones (VGG).
+    """
     from autodist_tpu.capture import Trainable
 
     sample = jnp.zeros((batch_size, image_size, image_size, channels),
                        jnp.float32)
     variables = model.init({"params": rng}, sample, train=False)
     params = variables["params"]
-    extra = {"batch_stats": variables["batch_stats"]}
+    has_bn = "batch_stats" in variables
+    extra = {"batch_stats": variables["batch_stats"]} if has_bn else None
 
     def loss(p, ex, batch, step_rng):
-        logits, updates = model.apply(
-            {"params": p, **ex}, batch["x"], train=True,
-            mutable=["batch_stats"])
+        rngs = {"dropout": step_rng}
+        if has_bn:
+            logits, updates = model.apply(
+                {"params": p, **ex}, batch["x"], train=True, rngs=rngs,
+                mutable=["batch_stats"])
+            new_extra = {"batch_stats": updates["batch_stats"]}
+        else:
+            logits = model.apply({"params": p}, batch["x"], train=True,
+                                 rngs=rngs)
+            new_extra = ex
         l, metrics = classification_loss_head(logits, batch)
-        return l, {"batch_stats": updates["batch_stats"]}, dict(metrics, loss=l)
+        return l, new_extra, dict(metrics, loss=l)
 
-    return Trainable(loss, params, optimizer, extra=extra, name="resnet")
+    def eval_loss(p, ex, batch, step_rng):
+        logits = model.apply({"params": p, **(ex or {})}, batch["x"],
+                             train=False)
+        l, metrics = classification_loss_head(logits, batch)
+        return l, ex, dict(metrics, loss=l)
+
+    return Trainable(loss, params, optimizer, extra=extra,
+                     eval_loss=eval_loss, name=name)
+
+
+def make_resnet_trainable(model, optimizer, rng, *, image_size=224,
+                          channels=3, batch_size=8):
+    """Trainable for a ResNet with synced BatchNorm extra-state."""
+    return make_image_trainable(model, optimizer, rng, image_size=image_size,
+                                channels=channels, batch_size=batch_size,
+                                name="resnet")

@@ -1,0 +1,58 @@
+"""VGG family (VGG11/13/16/19) for ImageNet-style classification.
+
+Counterpart of the reference's VGG16 benchmark model
+(``examples/benchmark/imagenet.py:161-166`` drives
+``tf.keras.applications.VGG16``).  TPU-first choices: NHWC layout,
+bfloat16 compute with fp32 head, and the classifier expressed as
+1x1-style dense layers over the pooled feature map so the whole model is
+three big MXU-friendly matmuls after the conv trunk.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Each entry: number of 3x3 conv layers per stage; maxpool between stages.
+_CFG = {
+    11: (1, 1, 2, 2, 2),
+    13: (2, 2, 2, 2, 2),
+    16: (2, 2, 3, 3, 3),
+    19: (2, 2, 4, 4, 4),
+}
+_STAGE_FILTERS = (64, 128, 256, 512, 512)
+
+
+class VGG(nn.Module):
+    depth: int = 16
+    num_classes: int = 1000
+    hidden: int = 4096
+    dropout_rate: float = 0.0   # classic VGG uses 0.5; off by default (bench)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, kernel_size=(3, 3), padding="SAME",
+                                 dtype=self.dtype)
+        x = x.astype(self.dtype)
+        for stage, n_layers in enumerate(_CFG[self.depth]):
+            for i in range(n_layers):
+                x = nn.relu(conv(_STAGE_FILTERS[stage],
+                                 name=f"conv{stage}_{i}")(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for i in range(2):
+            x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype,
+                                 name=f"fc{i}")(x))
+            if self.dropout_rate > 0:
+                x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+VGG11 = functools.partial(VGG, depth=11)
+VGG13 = functools.partial(VGG, depth=13)
+VGG16 = functools.partial(VGG, depth=16)
+VGG19 = functools.partial(VGG, depth=19)

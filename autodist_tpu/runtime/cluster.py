@@ -81,6 +81,7 @@ class Coordinator:
         self.fail_fast = fail_fast
         self.workers: list[WorkerHandle] = []
         self._terminated = False
+        self._first_failure: Optional[tuple[str, int]] = None
         self._lock = threading.Lock()
         atexit.register(self.terminate)
 
@@ -88,20 +89,25 @@ class Coordinator:
         with self._lock:
             if self._terminated:
                 return  # we killed it ourselves; not a failure
+            if self._first_failure is None:
+                self._first_failure = (worker.name, rc)
         logging.error("worker %s exited with %d", worker.name, rc)
         if self.fail_fast:
             self.terminate()
 
     def _failures(self) -> list[tuple[str, int]]:
-        """Authoritative failure list from process returncodes (no watcher
-        race): terminated-by-us (negative rc after our own terminate) is
-        excluded only when we initiated teardown due to a real failure —
-        the first genuinely failing worker is always present."""
+        """Authoritative failure list: process returncodes, with
+        terminated-by-us (negative rc after our own terminate) excluded —
+        except the recorded first failure, which is always reported even
+        when it was a signal death (segfault/OOM-kill) that itself
+        triggered the fail-fast teardown."""
         out = []
         for w in self.workers:
             rc = w.proc.poll()
             if rc is not None and rc != 0 and not (self._terminated and rc < 0):
                 out.append((w.name, rc))
+        if self._first_failure is not None and self._first_failure not in out:
+            out.insert(0, self._first_failure)
         return out
 
     def launch(self, name: str, argv: Sequence[str], *,
@@ -125,7 +131,7 @@ class Coordinator:
 
     def join(self, timeout: Optional[float] = None):
         """Wait for all workers; raise if any failed (fail-fast)."""
-        deadline = time.time() + timeout if timeout else None
+        deadline = time.time() + timeout if timeout is not None else None
         for w in self.workers:
             remaining = None if deadline is None \
                 else max(deadline - time.time(), 0.01)

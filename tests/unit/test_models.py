@@ -148,3 +148,50 @@ def test_sampled_softmax_rewards_true_label():
     assert float(l_aligned) < float(l_random) - 1.0
     # accidental-hit masking: true label among negatives must not blow up
     assert np.isfinite(float(l_aligned))
+
+
+@pytest.mark.parametrize("factory,size", [
+    (lambda: models.VGG16(num_classes=10, hidden=64, dtype=jnp.float32), 32),
+    (lambda: models.DenseNet121(num_classes=10, growth_rate=8,
+                                dtype=jnp.float32), 32),
+], ids=["vgg16", "densenet121"])
+def test_imagenet_zoo_trains(factory, size):
+    # ≙ reference examples/benchmark/imagenet.py model flag (VGG16,
+    # DenseNet121); tiny widths/images for CPU test speed.
+    rng = np.random.RandomState(6)
+    t = models.make_image_trainable(factory(), optax.sgd(0.01),
+                                    jax.random.PRNGKey(0), image_size=size,
+                                    batch_size=8)
+    batches = [{"x": rng.randn(8, size, size, 3).astype(np.float32),
+                "y": rng.randint(0, 10, (8,)).astype(np.int32)}
+               for _ in range(2)]
+    _, losses = run_steps(t, batches, AllReduce())
+    assert np.isfinite(losses).all()
+
+
+def test_inception_v3_forward_shape():
+    # Full InceptionV3 topology check (299x299 stem → 8x8 grid → logits);
+    # forward-only at batch 1 to keep CPU time bounded.
+    model = models.InceptionV3(num_classes=7, dtype=jnp.float32)
+    x = jnp.zeros((1, 299, 299, 3), jnp.float32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (1, 7)
+
+
+def test_vgg_dropout_and_eval_mode():
+    """Dropout needs an rng at train time; eval must be inference-mode
+    (deterministic, dropout off)."""
+    rng = np.random.RandomState(7)
+    model = models.VGG11(num_classes=10, hidden=32, dropout_rate=0.5,
+                         dtype=jnp.float32)
+    t = models.make_image_trainable(model, optax.sgd(0.01),
+                                    jax.random.PRNGKey(0), image_size=32,
+                                    batch_size=8)
+    batch = {"x": rng.randn(8, 32, 32, 3).astype(np.float32),
+             "y": rng.randint(0, 10, (8,)).astype(np.int32)}
+    runner, losses = run_steps(t, [batch], AllReduce())
+    assert np.isfinite(losses).all()
+    e1 = runner.eval_step(batch, rng=jax.random.PRNGKey(1))
+    e2 = runner.eval_step(batch, rng=jax.random.PRNGKey(2))
+    assert float(e1["loss"]) == float(e2["loss"])
