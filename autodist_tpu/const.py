@@ -42,6 +42,10 @@ class ENV(enum.Enum):
     AUTODIST_TPU_NUM_PROCESSES = (lambda v: int(v) if v else 1,)
     AUTODIST_TPU_PROCESS_ID = (lambda v: int(v) if v else 0,)
     AUTODIST_TPU_DUMP_HLO = (lambda v: v == "True" or v == "1",)  # per-stage HLO dumps
+    # Chip generation override for MFU/cost math (e.g. "v5e"); falls back to
+    # the platform plugin's hint, then to device_kind detection.
+    AUTODIST_TPU_GENERATION = (
+        lambda v: (v or os.environ.get("PALLAS_AXON_TPU_GEN", "")).lower(),)
 
     @property
     def val(self):

@@ -187,6 +187,14 @@ class ResourceSpec:
 
 def _detect_generation() -> str:
     import jax
+    env_gen = const.ENV.AUTODIST_TPU_GENERATION.val
+    if env_gen in CHIP_SPECS:
+        return env_gen
+    if env_gen:
+        logging.warning(
+            "unrecognized chip generation override %r (valid: %s); "
+            "falling back to device_kind detection",
+            env_gen, sorted(CHIP_SPECS))
     try:
         kind = jax.devices()[0].device_kind.lower()
     except Exception:  # pragma: no cover

@@ -1,48 +1,69 @@
 """Benchmark driver: prints ONE JSON line with the headline metric.
 
-Measured on whatever devices are visible (the driver runs this on real TPU
-hardware).  Metric: training-step throughput (examples/sec) plus model FLOP
-utilization on the flagship model, in the style of the reference's
-``TimeHistory`` examples/sec meter (``examples/benchmark/imagenet.py:84-140``).
+Headline (BASELINE.md): BERT-base masked-LM training MFU — the reference's
+flagship benchmark (``examples/benchmark/bert.py``) measured the way its
+``TimeHistory`` meter did (examples/sec = batch x steps / elapsed,
+``examples/benchmark/imagenet.py:84-140``), converted to model-FLOP
+utilization against the chip's peak bf16 throughput.  Runs on whatever
+devices are visible (the driver runs this on real TPU hardware; on a CPU
+dev machine it shrinks the model so the bench stays fast).
 """
 import json
 import time
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import optax
 
 
+def mlm_model_flops_per_example(cfg, seq_len: int, num_masked: int) -> float:
+    """Analytic matmul FLOPs for one BERT MLM training example (fwd x3 for
+    fwd+bwd).  Counts encoder matmuls (qkv 6H^2 + out-proj 2H^2 + mlp
+    4*H*mlp_dim per token), attention score+value einsums (4*L*H per
+    token), and the MLM head (2*H^2 transform + 2*H*V tied decode per
+    masked position)."""
+    H, L, V, P = cfg.hidden_size, seq_len, cfg.vocab_size, num_masked
+    per_token_layer = 8.0 * H * H + 4.0 * H * cfg.mlp_dim + 4.0 * L * H
+    encoder_fwd = L * cfg.num_layers * per_token_layer
+    head_fwd = P * (2.0 * H * H + 2.0 * H * V)
+    return 3.0 * (encoder_fwd + head_fwd)
+
+
 def main():
-    from autodist_tpu import AllReduce, AutoDist, Trainable
+    from autodist_tpu import AllReduce, AutoDist
+    from autodist_tpu.models import bert
     from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.utils import profiling
 
-    dim, hidden, out, batch = 1024, 4096, 1024, 4096
-    rng = np.random.RandomState(0)
-    params = {
-        "l1": {"w": jnp.asarray(rng.randn(dim, hidden) * 0.02, jnp.bfloat16)},
-        "l2": {"w": jnp.asarray(rng.randn(hidden, hidden) * 0.02, jnp.bfloat16)},
-        "l3": {"w": jnp.asarray(rng.randn(hidden, out) * 0.02, jnp.bfloat16)},
-    }
+    on_accel = jax.default_backend() != "cpu"
+    if on_accel:
+        cfg = bert.bert_base(dropout_rate=0.0, attention_dropout_rate=0.0)
+        batch_per_chip, seq_len, num_masked, steps = 16, 512, 76, 30
+    else:  # CPU dev smoke: same code path, toy size
+        from autodist_tpu.models.transformer import TransformerConfig
+        cfg = TransformerConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                                num_heads=2, mlp_dim=128, max_len=64,
+                                dropout_rate=0.0, attention_dropout_rate=0.0)
+        batch_per_chip, seq_len, num_masked, steps = 4, 64, 8, 3
 
-    def loss_fn(p, b):
-        h = jax.nn.relu(b["x"] @ p["l1"]["w"])
-        h = jax.nn.relu(h @ p["l2"]["w"])
-        pred = h @ p["l3"]["w"]
-        return jnp.mean((pred.astype(jnp.float32) - b["y"]) ** 2)
-
-    trainable = Trainable.from_loss_fn(loss_fn, params, optax.adam(1e-3))
     rs = ResourceSpec({})
-    ad = AutoDist(rs, AllReduce(chunk_size=8))
-    runner = ad.build(trainable)
     n = rs.num_devices()
-    data = {"x": rng.randn(batch, dim).astype(np.float32),
-            "y": rng.randn(batch, out).astype(np.float32)}
+    batch = batch_per_chip * n
+
+    rng = jax.random.PRNGKey(0)
+    # init batch is shape-only (params are batch-size independent); keep it
+    # tiny so startup doesn't scale with device count
+    trainable = bert.make_mlm_trainable(
+        cfg, optax.adamw(1e-4, weight_decay=0.01), rng,
+        batch_size=2, seq_len=seq_len, num_masked=num_masked)
+    ad = AutoDist(rs, AllReduce(chunk_size=256))  # BERT chunk=256 (bert.py:62)
+    runner = ad.build(trainable)
+
+    data = bert.synthetic_mlm_batch(0, batch, seq_len, num_masked,
+                                    cfg.vocab_size)
 
     runner.step(data)  # compile
     jax.block_until_ready(runner.state)
-    steps = 20
     t0 = time.perf_counter()
     for _ in range(steps):
         runner.step(data)
@@ -50,15 +71,18 @@ def main():
     dt = time.perf_counter() - t0
 
     examples_per_sec = batch * steps / dt
-    # fwd+bwd matmul FLOPs: 3 matmuls * 2 mn k * 3 (fwd + 2x bwd)
-    flops_per_example = 6 * (dim * hidden + hidden * hidden + hidden * out)
-    mfu = (examples_per_sec * flops_per_example
-           / (rs.chip.peak_bf16_tflops * 1e12 * n))
+    flops_per_example = mlm_model_flops_per_example(cfg, seq_len, num_masked)
+    peak = rs.chip.peak_bf16_tflops * 1e12 * n
+    mfu = profiling.mfu(examples_per_sec, flops_per_example, peak)
     print(json.dumps({
-        "metric": "mlp_train_examples_per_sec",
-        "value": round(examples_per_sec, 1),
-        "unit": "examples/sec",
+        "metric": "bert_base_mlm_mfu",
+        "value": round(mfu, 4),
+        "unit": "mfu",
         "vs_baseline": round(mfu / 0.45, 4),
+        "examples_per_sec": round(examples_per_sec, 2),
+        "step_ms": round(dt / steps * 1e3, 2),
+        "devices": n,
+        "chip": rs.chip.name,
     }))
 
 
