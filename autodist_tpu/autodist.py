@@ -54,13 +54,29 @@ class AutoDist:
 
     # ------------------------------------------------------------------ #
     def build_or_load_strategy(self, trainable: Trainable) -> Strategy:
-        """Chief builds + serializes; workers deserialize by ID
-        (≙ reference ``_build_or_load_strategy``, ``autodist.py:100-109``)."""
+        """Chief builds + publishes; workers load by ID (≙ reference
+        ``_build_or_load_strategy``, ``autodist.py:100-109``).  Handoff
+        rides the native coordination service when one is configured
+        (blocking KV get ≙ the reference's SFTP strategy drop,
+        ``coordinator.py:66-90``); otherwise the shared strategy dir."""
+        from autodist_tpu.runtime import coordination
+
         strategy_id = const.ENV.AUTODIST_TPU_STRATEGY_ID.val
+        client = coordination.service_client()
         if not IS_CHIEF and strategy_id:
+            if client is not None:
+                data = client.get(f"strategy/{strategy_id}", timeout_ms=60000)
+                if data:
+                    return Strategy.from_json(data.decode())
+                logging.warning(
+                    "strategy %s not on coordination service after 60s; "
+                    "falling back to the strategy dir", strategy_id)
             return Strategy.deserialize(strategy_id)
         strategy = self.strategy_builder.build(trainable, self.resource_spec)
         if IS_CHIEF:
+            if client is not None:
+                client.put(f"strategy/{strategy.id}",
+                           strategy.to_json().encode())
             try:
                 path = strategy.serialize()
                 logging.debug("strategy serialized to %s", path)

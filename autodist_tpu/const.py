@@ -46,6 +46,8 @@ class ENV(enum.Enum):
     # the platform plugin's hint, then to device_kind detection.
     AUTODIST_TPU_GENERATION = (
         lambda v: (v or os.environ.get("PALLAS_AXON_TPU_GEN", "")).lower(),)
+    # host:port of the native host-coordination service (runtime/coordination)
+    AUTODIST_TPU_COORD_SERVICE = (lambda v: v or "",)
 
     @property
     def val(self):

@@ -1,0 +1,10 @@
+"""Multi-host runtime: cluster launcher, native host-coordination service."""
+from autodist_tpu.runtime.cluster import (Cluster, Coordinator, WorkerHandle,
+                                          make_global_batch)
+from autodist_tpu.runtime.coordination import (CoordClient, CoordServer,
+                                               SSPController, service_client)
+
+__all__ = [
+    "Cluster", "Coordinator", "WorkerHandle", "make_global_batch",
+    "CoordClient", "CoordServer", "SSPController", "service_client",
+]

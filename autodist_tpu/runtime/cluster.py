@@ -160,20 +160,74 @@ class Cluster:
     shipping (the strategy file is tiny JSON; SPMD ships nothing else).
     """
 
-    def __init__(self, resource_spec, hosts: Optional[Sequence[str]] = None):
+    def __init__(self, resource_spec, hosts: Optional[Sequence[str]] = None,
+                 *, coord_service: bool = True,
+                 coord_host: Optional[str] = None):
         self.resource_spec = resource_spec
         self.hosts = list(hosts or [])
         self.coordinator = Coordinator()
+        # Native host-coordination service (runtime/coordination): the chief
+        # runs the server; its address propagates to workers via env.
+        self._use_coord_service = coord_service
+        self._coord_host = coord_host or self._default_coord_host()
+        self._coord_server = None
+        atexit.register(self.terminate)
+
+    def _default_coord_host(self) -> str:
+        """Address remote workers can reach the chief's coordination server
+        on: the jax.distributed coordinator's host when configured, the
+        chief's FQDN when any worker is remote, else loopback."""
+        coordinator = getattr(self.resource_spec, "coordinator", "")
+        if coordinator:
+            return coordinator.rpartition(":")[0] or coordinator
+        if any(h not in ("localhost", "127.0.0.1") for h in self.hosts):
+            import socket
+            return socket.getfqdn()
+        return "127.0.0.1"
 
     @property
     def is_chief(self) -> bool:
         return not const.ENV.AUTODIST_TPU_WORKER.val
 
-    def launch_clients(self, strategy_id: str,
-                       argv: Optional[Sequence[str]] = None):
-        """Chief: start the user script on every worker host."""
+    def _start_coord_service(self) -> str:
+        """Start the native coordination server (chief only); returns its
+        advertised host:port and exports it to this process's env so the
+        chief's own :func:`~autodist_tpu.runtime.coordination.service_client`
+        finds it."""
+        if self._coord_server is None:
+            from autodist_tpu.runtime.coordination import CoordServer
+            self._coord_server = CoordServer()
+            addr = f"{self._coord_host}:{self._coord_server.port}"
+            os.environ["AUTODIST_TPU_COORD_SERVICE"] = addr
+            logging.info("coordination service at %s", addr)
+        return f"{self._coord_host}:{self._coord_server.port}"
+
+    def launch_clients(self, strategy,
+                       argv: Optional[Sequence[str]] = None,
+                       extra_env: Optional[dict] = None):
+        """Chief: start the user script on every worker host.
+
+        ``strategy`` is the built Strategy object (published to the
+        coordination service so workers without a shared filesystem can
+        load it) or a bare strategy-id string (env handoff only).
+        """
         if not self.is_chief:
             return []
+        strategy_id = strategy if isinstance(strategy, str) else strategy.id
+        coord_addr = ""
+        if self._use_coord_service:
+            try:
+                coord_addr = self._start_coord_service()
+            except (OSError, subprocess.CalledProcessError) as e:
+                logging.warning(
+                    "coordination service unavailable (%s); workers fall "
+                    "back to the shared strategy dir", e)
+        if coord_addr and not isinstance(strategy, str):
+            from autodist_tpu.runtime.coordination import service_client
+            client = service_client()
+            if client is not None:
+                client.put(f"strategy/{strategy_id}",
+                           strategy.to_json().encode())
         argv = list(argv or [sys.executable, os.path.abspath(sys.argv[0]),
                              *sys.argv[1:]])
         handles = []
@@ -185,6 +239,9 @@ class Cluster:
                 "AUTODIST_TPU_NUM_PROCESSES": str(len(self.hosts) + 1),
                 "AUTODIST_TPU_COORDINATOR": self.resource_spec.coordinator,
             }
+            if coord_addr:
+                env["AUTODIST_TPU_COORD_SERVICE"] = coord_addr
+            env.update(extra_env or {})
             handles.append(self.coordinator.launch(
                 f"worker-{i + 1}", argv, env=env,
                 host=None if host in ("localhost", "127.0.0.1") else host))
@@ -195,6 +252,14 @@ class Cluster:
 
     def terminate(self):
         self.coordinator.terminate()
+        if self._coord_server is not None:
+            from autodist_tpu.runtime import coordination
+            addr = f"{self._coord_host}:{self._coord_server.port}"
+            if os.environ.get("AUTODIST_TPU_COORD_SERVICE") == addr:
+                del os.environ["AUTODIST_TPU_COORD_SERVICE"]
+            coordination.reset_service_client()
+            self._coord_server.stop()
+            self._coord_server = None
 
 
 def make_global_batch(batch, mesh, spec=None):

@@ -1,0 +1,311 @@
+"""Host coordination service: Python binding over the native C++ library.
+
+The reference built its between-graph control plane out of TensorFlow C++
+runtime primitives — size-1 FIFO token queues as sync barriers and
+depth-``staleness`` queues for stale-synchronous parallel (SSP) training
+(``ps_synchronizer.py:335-458``), plus SFTP file drops for the
+chief→worker strategy handoff (``coordinator.py:66-90``).  Here those are
+a standalone C++ TCP service (``native/coord.cc``): the chief process runs
+a :class:`CoordServer`; every host connects a :class:`CoordClient` for
+
+* **KV with blocking get** — strategy handoff, config distribution;
+* **named barriers** — job-level sync points outside the SPMD program
+  (XLA collectives synchronize *inside* the step; this covers start-up,
+  checkpoint rotation, teardown);
+* **FIFO byte queues** — the token-queue pattern;
+* **SSP progress tracking** — :class:`SSPController` below.
+
+The library is compiled on demand with ``make`` (g++); there is no
+pre-built binary in the repo.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from autodist_tpu import const
+from autodist_tpu.utils import logging
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libautodist_coord.so")
+_SRC_PATH = os.path.join(_NATIVE_DIR, "coord.cc")
+
+_build_lock = threading.Lock()
+_lib = None
+
+OK, TIMEOUT, ERROR = 0, 1, 2
+
+
+def _ensure_built() -> str:
+    """Compile the native library if missing or older than its source."""
+    with _build_lock:
+        if (not os.path.exists(_LIB_PATH)
+                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC_PATH)):
+            logging.info("building native coordination library in %s",
+                         _NATIVE_DIR)
+            subprocess.run(["make", "-s"], cwd=_NATIVE_DIR, check=True)
+    return _LIB_PATH
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(_ensure_built())
+    lib.coord_server_start.restype = ctypes.c_void_p
+    lib.coord_server_start.argtypes = [ctypes.c_int]
+    lib.coord_server_port.restype = ctypes.c_int
+    lib.coord_server_port.argtypes = [ctypes.c_void_p]
+    lib.coord_server_stop.argtypes = [ctypes.c_void_p]
+    lib.coord_client_connect.restype = ctypes.c_void_p
+    lib.coord_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                         ctypes.c_int]
+    lib.coord_client_close.argtypes = [ctypes.c_void_p]
+    lib.coord_put.restype = ctypes.c_int
+    lib.coord_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_char_p, ctypes.c_uint32]
+    lib.coord_get.restype = ctypes.c_int
+    lib.coord_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_int64,
+                              ctypes.POINTER(ctypes.c_void_p),
+                              ctypes.POINTER(ctypes.c_uint32)]
+    lib.coord_barrier.restype = ctypes.c_int
+    lib.coord_barrier.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int64, ctypes.c_int64]
+    lib.coord_counter_add.restype = ctypes.c_int
+    lib.coord_counter_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int64,
+                                      ctypes.POINTER(ctypes.c_int64)]
+    lib.coord_queue_put.restype = ctypes.c_int
+    lib.coord_queue_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_char_p, ctypes.c_uint32]
+    lib.coord_queue_get.restype = ctypes.c_int
+    lib.coord_queue_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int64,
+                                    ctypes.POINTER(ctypes.c_void_p),
+                                    ctypes.POINTER(ctypes.c_uint32)]
+    lib.coord_ssp_register.restype = ctypes.c_int
+    lib.coord_ssp_register.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.coord_ssp_report.restype = ctypes.c_int
+    lib.coord_ssp_report.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64]
+    lib.coord_ssp_wait.restype = ctypes.c_int
+    lib.coord_ssp_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                   ctypes.c_int64]
+    lib.coord_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class CoordServer:
+    """In-process native coordination server (run by the chief)."""
+
+    def __init__(self, port: int = 0):
+        self._lib = _load()
+        self._handle = self._lib.coord_server_start(port)
+        if not self._handle:
+            raise OSError(f"could not start coordination server on port {port}")
+        self.port = self._lib.coord_server_port(self._handle)
+
+    def stop(self):
+        if self._handle:
+            self._lib.coord_server_stop(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def __del__(self):  # best-effort cleanup
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class CoordClient:
+    """Client for the coordination service.
+
+    One instance per thread: requests are serialized on one TCP
+    connection, so a blocking call (``get``/``barrier``/``queue_get``/
+    ``ssp_wait``) stalls other calls on the same client.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 connect_timeout_ms: int = 10000):
+        self._lib = _load()
+        self._handle = self._lib.coord_client_connect(
+            host.encode(), port, connect_timeout_ms)
+        if not self._handle:
+            raise OSError(f"could not connect to coordinator {host}:{port}")
+
+    def close(self):
+        if self._handle:
+            self._lib.coord_client_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------ #
+    def put(self, key: str, value: bytes):
+        if self._lib.coord_put(self._handle, key.encode(), value,
+                               len(value)) != OK:
+            raise OSError(f"put({key}) failed")
+
+    def get(self, key: str, timeout_ms: int = 0) -> Optional[bytes]:
+        """Returns the value, blocking up to ``timeout_ms`` (-1 = forever)
+        for it to appear; None on timeout."""
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_uint32()
+        st = self._lib.coord_get(self._handle, key.encode(), timeout_ms,
+                                 ctypes.byref(out), ctypes.byref(out_len))
+        if st == TIMEOUT:
+            return None
+        if st != OK:
+            raise OSError(f"get({key}) failed")
+        return self._take(out, out_len)
+
+    def barrier(self, name: str, num_participants: int,
+                timeout_ms: int = -1) -> bool:
+        st = self._lib.coord_barrier(self._handle, name.encode(),
+                                     num_participants, timeout_ms)
+        if st == ERROR:
+            raise OSError(f"barrier({name}) failed")
+        return st == OK
+
+    def counter_add(self, key: str, delta: int = 1) -> int:
+        out = ctypes.c_int64()
+        if self._lib.coord_counter_add(self._handle, key.encode(), delta,
+                                       ctypes.byref(out)) != OK:
+            raise OSError(f"counter_add({key}) failed")
+        return out.value
+
+    def queue_put(self, key: str, value: bytes):
+        if self._lib.coord_queue_put(self._handle, key.encode(), value,
+                                     len(value)) != OK:
+            raise OSError(f"queue_put({key}) failed")
+
+    def queue_get(self, key: str, timeout_ms: int = -1) -> Optional[bytes]:
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_uint32()
+        st = self._lib.coord_queue_get(self._handle, key.encode(), timeout_ms,
+                                       ctypes.byref(out),
+                                       ctypes.byref(out_len))
+        if st == TIMEOUT:
+            return None
+        if st != OK:
+            raise OSError(f"queue_get({key}) failed")
+        return self._take(out, out_len)
+
+    def ssp_register(self, worker: str):
+        if self._lib.coord_ssp_register(self._handle, worker.encode()) != OK:
+            raise OSError("ssp_register failed")
+
+    def ssp_report(self, worker: str, step: int):
+        if self._lib.coord_ssp_report(self._handle, worker.encode(),
+                                      step) != OK:
+            raise OSError("ssp_report failed")
+
+    def ssp_wait(self, step: int, staleness: int) -> bool:
+        """Block until every registered worker has completed step
+        ``step - 1 - staleness``; returns False on (10-minute) timeout."""
+        st = self._lib.coord_ssp_wait(self._handle, step, staleness)
+        if st == ERROR:
+            raise OSError("ssp_wait failed")
+        return st == OK
+
+    # ------------------------------------------------------------------ #
+    def _take(self, out, out_len) -> bytes:
+        if not out or out_len.value == 0:
+            return b""
+        data = ctypes.string_at(out, out_len.value)
+        self._lib.coord_free(out)
+        return data
+
+
+_default_client: Optional[CoordClient] = None
+_default_client_lock = threading.Lock()
+
+
+def service_client() -> Optional[CoordClient]:
+    """Process-wide client for the service advertised in
+    ``AUTODIST_TPU_COORD_SERVICE`` (host:port), or None when no service is
+    configured or reachable.  The chief's
+    :class:`~autodist_tpu.runtime.cluster.Cluster` sets that env var when
+    it starts the server, and propagates it to every worker it launches."""
+    global _default_client
+    addr = const.ENV.AUTODIST_TPU_COORD_SERVICE.val
+    if not addr:
+        return None
+    with _default_client_lock:
+        if _default_client is None:
+            host, _, port = addr.rpartition(":")
+            try:
+                _default_client = CoordClient(host or "127.0.0.1", int(port))
+            except (OSError, ValueError) as e:
+                logging.warning(
+                    "coordination service %s unreachable (%s); continuing "
+                    "without it", addr, e)
+                return None
+        return _default_client
+
+
+def reset_service_client():
+    """Drop the cached default client (used when the service shuts down)."""
+    global _default_client
+    with _default_client_lock:
+        if _default_client is not None:
+            _default_client.close()
+            _default_client = None
+
+
+class SSPController:
+    """Stale-synchronous-parallel gate around a worker's step loop
+    (≙ the reference's depth-``staleness`` token queues,
+    ``ps_synchronizer.py:387-458``).
+
+    Usage per worker process::
+
+        ssp = SSPController(client, worker="host3", staleness=3)
+        for step in range(n):
+            ssp.start_step(step)   # blocks if > staleness ahead of slowest
+            runner.step(batch)
+            ssp.finish_step(step)
+
+    ``staleness=0`` degenerates to bulk-synchronous lockstep.
+
+    ``num_workers``, when given, barriers until that many workers have
+    registered — otherwise an early starter could run arbitrarily far
+    ahead before its peers register, voiding the staleness bound.
+    """
+
+    def __init__(self, client: CoordClient, worker: str, staleness: int,
+                 num_workers: Optional[int] = None,
+                 register_timeout_ms: int = 600000):
+        if staleness < 0:
+            raise ValueError("staleness must be >= 0")
+        self.client = client
+        self.worker = worker
+        self.staleness = staleness
+        client.ssp_register(worker)
+        if num_workers is not None:
+            if not client.barrier("ssp/registered", num_workers,
+                                  timeout_ms=register_timeout_ms):
+                raise TimeoutError(
+                    f"only some of the {num_workers} SSP workers registered "
+                    f"within {register_timeout_ms}ms")
+
+    def start_step(self, step: int) -> bool:
+        return self.client.ssp_wait(step, self.staleness)
+
+    def finish_step(self, step: int):
+        self.client.ssp_report(self.worker, step)
