@@ -65,18 +65,28 @@ class AutoDist:
         client = coordination.service_client()
         if not IS_CHIEF and strategy_id:
             if client is not None:
-                data = client.get(f"strategy/{strategy_id}", timeout_ms=60000)
+                try:
+                    data = client.get(f"strategy/{strategy_id}",
+                                      timeout_ms=60000)
+                except OSError as e:
+                    data = None
+                    logging.warning("coordination service get failed (%s)", e)
                 if data:
                     return Strategy.from_json(data.decode())
                 logging.warning(
-                    "strategy %s not on coordination service after 60s; "
-                    "falling back to the strategy dir", strategy_id)
+                    "strategy %s not on coordination service; falling back "
+                    "to the strategy dir", strategy_id)
             return Strategy.deserialize(strategy_id)
         strategy = self.strategy_builder.build(trainable, self.resource_spec)
         if IS_CHIEF:
             if client is not None:
-                client.put(f"strategy/{strategy.id}",
-                           strategy.to_json().encode())
+                try:
+                    client.put(f"strategy/{strategy.id}",
+                               strategy.to_json().encode())
+                except OSError as e:
+                    logging.warning(
+                        "could not publish strategy to the coordination "
+                        "service (%s); workers use the strategy dir", e)
             try:
                 path = strategy.serialize()
                 logging.debug("strategy serialized to %s", path)

@@ -1,0 +1,4 @@
+"""Pallas TPU kernels for the hot ops."""
+from autodist_tpu.ops.flash_attention import flash_attention, make_attention_fn
+
+__all__ = ["flash_attention", "make_attention_fn"]

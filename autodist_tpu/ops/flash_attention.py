@@ -1,0 +1,217 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of the transformer stack (SURVEY.md §7 step 8 "compressor/
+custom kernels"; the reference had no fused attention — its bundled BERT
+benchmark ran plain einsum attention, ``examples/benchmark/utils/
+bert_modeling.py``).  This is the TPU-idiomatic replacement: blockwise
+online-softmax attention that never materializes the [L, L] score matrix
+in HBM — scores live in VMEM one (block_q, block_k) tile at a time, so
+memory is O(L·D) instead of O(L²) and the MXU sees back-to-back matmuls.
+
+Layout contract matches ``models/transformer.py``: q/k/v are
+``[batch, length, heads, head_dim]``; softmax in fp32 regardless of input
+dtype.  The backward pass is a blockwise recompute from the saved
+logsumexp (standard flash-attention backward), written in plain JAX so
+XLA fuses it; forward is the Pallas kernel.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+NEG_INF = float(np.finfo(np.float32).min)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+                causal: bool, block_k: int, seq_len: int):
+    """One (batch·head, q-block) program: online softmax over k blocks."""
+    block_q = q_ref.shape[1]
+    head_dim = q_ref.shape[2]
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
+
+    num_kb = pl.cdiv(seq_len, block_k)
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing.
+        num_kb = jnp.minimum(num_kb, pl.cdiv((iq + 1) * block_q, block_k))
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [BQ, BK]
+        if causal:
+            rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    """q/k/v: [BH, L, D] → (out [BH, L, D], lse [BH, L])."""
+    bh, seq_len, head_dim = q.shape
+    block_q = min(block_q, seq_len)
+    block_k = min(block_k, seq_len)
+    if seq_len % block_q or seq_len % block_k:
+        raise ValueError(
+            f"sequence length {seq_len} must be divisible by block sizes "
+            f"({block_q}, {block_k})")
+    grid = (bh, seq_len // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_k=block_k,
+        seq_len=seq_len)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda bh_, iq: (bh_, iq, 0)),
+            pl.BlockSpec((1, seq_len, head_dim), lambda bh_, iq: (bh_, 0, 0)),
+            pl.BlockSpec((1, seq_len, head_dim), lambda bh_, iq: (bh_, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda bh_, iq: (bh_, iq, 0)),
+            pl.BlockSpec((1, block_q), lambda bh_, iq: (bh_, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_len, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_len), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_k):
+    """Blockwise flash backward (recompute from lse), plain JAX.
+
+    All inputs [BH, L, D] (lse [BH, L]); returns (dq, dk, dv) in fp32.
+    """
+    bh, seq_len, head_dim = q.shape
+    block_k = min(block_k, seq_len)
+    num_kb = seq_len // block_k
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    of = out.astype(jnp.float32)
+    delta = jnp.sum(gf * of, axis=-1)  # [BH, L]
+    rows = jnp.arange(seq_len)
+
+    def body(dq, kb):
+        k_blk = jax.lax.dynamic_slice_in_dim(kf, kb * block_k, block_k, 1)
+        v_blk = jax.lax.dynamic_slice_in_dim(vf, kb * block_k, block_k, 1)
+        s = jnp.einsum("bld,bkd->blk", qf, k_blk) * scale
+        p = jnp.exp(s - lse[..., None])  # [BH, L, BK]
+        if causal:
+            cols = kb * block_k + jnp.arange(block_k)
+            p = jnp.where(rows[:, None] >= cols[None, :], p, 0.0)
+        dv_blk = jnp.einsum("blk,bld->bkd", p, gf)
+        dp = jnp.einsum("bld,bkd->blk", gf, v_blk)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("blk,bkd->bld", ds, k_blk)
+        dk_blk = jnp.einsum("blk,bld->bkd", ds, qf)
+        return dq, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(body, dq0, jnp.arange(num_kb))
+    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(bh, seq_len, head_dim)
+    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, seq_len, head_dim)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhld(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_bhld_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bhld_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, scale, causal, block_k)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_bhld.defvjp(_flash_bhld_fwd, _flash_bhld_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused attention over ``[batch, length, heads, head_dim]`` inputs.
+
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU (the
+    simulated CPU mesh used by the test harness).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    b, l, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    def to_bhld(x):
+        return jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
+
+    out = _flash_bhld(to_bhld(q), to_bhld(k), to_bhld(v), float(scale),
+                      bool(causal), int(block_q), int(block_k),
+                      bool(interpret))
+    return jnp.moveaxis(out.reshape(b, h, l, d), 1, 2)
+
+
+def make_attention_fn(causal: bool, *, block_q: int = 128,
+                      block_k: int = 128):
+    """Adapter for ``TransformerConfig.attention_fn``: ``(q, k, v, mask,
+    dropout_rng) -> out``.
+
+    The flash kernel supports exactly two masking structures: none, and
+    the static causal triangle.  With ``causal=True`` the mask the model
+    passes is taken to *be* the causal mask (set the config's ``causal``
+    flag to match); with ``causal=False`` any non-None mask (i.e. a
+    padding mask, as in the BERT stack) is rejected rather than silently
+    ignored.  Attention dropout is likewise rejected — use the default
+    einsum attention for those cases.
+    """
+
+    def attention_fn(q, k, v, mask, dropout_rng):
+        if dropout_rng is not None:
+            raise ValueError(
+                "flash attention does not support attention dropout; set "
+                "attention_dropout_rate=0 or use the default attention")
+        if mask is not None and not causal:
+            raise ValueError(
+                "flash attention supports only causal or no masking; got a "
+                "mask with causal=False (padding masks need the default "
+                "attention)")
+        return flash_attention(q, k, v, causal=causal,
+                               block_q=block_q, block_k=block_k)
+
+    return attention_fn

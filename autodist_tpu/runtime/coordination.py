@@ -232,40 +232,53 @@ class CoordClient:
         return data
 
 
-_default_client: Optional[CoordClient] = None
-_default_client_lock = threading.Lock()
+# One default client per thread: CoordClient serializes requests on one
+# TCP connection, so sharing across threads would let a blocking call
+# (barrier/queue_get with long timeouts) stall every other caller.
+_tls = threading.local()
+_service_clients: list[CoordClient] = []
+_service_clients_lock = threading.Lock()
 
 
 def service_client() -> Optional[CoordClient]:
-    """Process-wide client for the service advertised in
+    """This thread's client for the service advertised in
     ``AUTODIST_TPU_COORD_SERVICE`` (host:port), or None when no service is
     configured or reachable.  The chief's
     :class:`~autodist_tpu.runtime.cluster.Cluster` sets that env var when
     it starts the server, and propagates it to every worker it launches."""
-    global _default_client
     addr = const.ENV.AUTODIST_TPU_COORD_SERVICE.val
     if not addr:
         return None
-    with _default_client_lock:
-        if _default_client is None:
-            host, _, port = addr.rpartition(":")
-            try:
-                _default_client = CoordClient(host or "127.0.0.1", int(port))
-            except (OSError, ValueError) as e:
-                logging.warning(
-                    "coordination service %s unreachable (%s); continuing "
-                    "without it", addr, e)
-                return None
-        return _default_client
+    cached = getattr(_tls, "client", None)
+    if (cached is not None and cached._handle
+            and getattr(_tls, "addr", None) == addr):
+        return cached
+    host, _, port = addr.rpartition(":")
+    try:
+        client = CoordClient(host or "127.0.0.1", int(port))
+    except (OSError, ValueError) as e:
+        logging.warning(
+            "coordination service %s unreachable (%s); continuing "
+            "without it", addr, e)
+        return None
+    _tls.client, _tls.addr = client, addr
+    with _service_clients_lock:
+        _service_clients.append(client)
+    return client
 
 
 def reset_service_client():
-    """Drop the cached default client (used when the service shuts down)."""
-    global _default_client
-    with _default_client_lock:
-        if _default_client is not None:
-            _default_client.close()
-            _default_client = None
+    """Close every cached default client (used when the service shuts
+    down).  Threads re-create their client on next use."""
+    with _service_clients_lock:
+        for c in _service_clients:
+            try:
+                c.close()
+            except OSError:
+                pass
+        _service_clients.clear()
+    _tls.client = None
+    _tls.addr = None
 
 
 class SSPController:

@@ -25,6 +25,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <cerrno>
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
@@ -280,7 +281,17 @@ struct Server {
   void Serve() {
     for (;;) {
       int fd = ::accept(listen_fd, nullptr, nullptr);
-      if (fd < 0) return;  // listen socket closed -> shutting down
+      if (fd < 0) {
+        // Transient errors (client reset before accept, fd exhaustion,
+        // signal) must not kill the service; only a closed/invalid listen
+        // socket means shutdown.
+        if (errno == ECONNABORTED || errno == EINTR) continue;
+        if (errno == EMFILE || errno == ENFILE) {
+          std::this_thread::sleep_for(std::chrono::milliseconds(100));
+          continue;
+        }
+        return;
+      }
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       {
@@ -408,6 +419,7 @@ void coord_client_close(void* handle) {
 static int Call(Client* c, uint8_t op, const char* key, const void* val,
                 uint32_t val_len, int64_t arg, int64_t arg2, char** out,
                 uint32_t* out_len, int64_t* ret = nullptr) {
+  if (c == nullptr) return kError;
   std::lock_guard<std::mutex> g(c->mu);
   uint16_t klen = static_cast<uint16_t>(std::strlen(key));
   uint32_t len = 1 + 2 + klen + 4 + val_len + 8 + 8;
