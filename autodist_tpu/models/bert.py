@@ -87,13 +87,21 @@ def mlm_loss_head(logits, batch):
 
 
 def make_mlm_trainable(cfg: TransformerConfig, optimizer, rng,
-                       *, batch_size=8, seq_len=128, num_masked=20):
-    """Build a Trainable for BERT MLM (init on synthetic shapes)."""
+                       *, batch_size=8, seq_len=128, num_masked=20,
+                       with_input_mask=True):
+    """Build a Trainable for BERT MLM (init on synthetic shapes).
+
+    ``with_input_mask=False`` drops the padding mask from the init sample
+    — required for attention kernels that only support unpadded batches
+    (e.g. the Pallas flash path); feed batches without ``input_mask``.
+    """
     from autodist_tpu.capture import Trainable
 
     model = BertModel(cfg)
     sample = synthetic_mlm_batch(rng, batch_size, seq_len, num_masked,
                                  cfg.vocab_size)
+    if not with_input_mask:
+        sample.pop("input_mask", None)
     variables = model.init({"params": rng, "dropout": rng}, sample,
                            deterministic=True)
 

@@ -24,6 +24,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import weakref
 from typing import Optional
 
 from autodist_tpu import const
@@ -64,6 +65,7 @@ def _load():
     lib.coord_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                          ctypes.c_int]
     lib.coord_client_close.argtypes = [ctypes.c_void_p]
+    lib.coord_client_shutdown.argtypes = [ctypes.c_void_p]
     lib.coord_put.restype = ctypes.c_int
     lib.coord_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                               ctypes.c_char_p, ctypes.c_uint32]
@@ -139,21 +141,38 @@ class CoordClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  connect_timeout_ms: int = 10000):
         self._lib = _load()
+        self._shutdown = False
         self._handle = self._lib.coord_client_connect(
             host.encode(), port, connect_timeout_ms)
         if not self._handle:
             raise OSError(f"could not connect to coordinator {host}:{port}")
 
     def close(self):
+        """Free the native client.  Only the owning thread may call this:
+        freeing while another thread is blocked in a call on the same
+        client is a use-after-free (use :meth:`shutdown` cross-thread)."""
         if self._handle:
             self._lib.coord_client_close(self._handle)
             self._handle = None
+
+    def shutdown(self):
+        """Cross-thread-safe: wake any blocked call on this client (it
+        fails with OSError) without freeing the native object."""
+        self._shutdown = True
+        if self._handle:
+            self._lib.coord_client_shutdown(self._handle)
 
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
         self.close()
+
+    def __del__(self):  # reclaim the socket when the owner thread is gone
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------ #
     def put(self, key: str, value: bytes):
@@ -234,9 +253,11 @@ class CoordClient:
 
 # One default client per thread: CoordClient serializes requests on one
 # TCP connection, so sharing across threads would let a blocking call
-# (barrier/queue_get with long timeouts) stall every other caller.
+# (barrier/queue_get with long timeouts) stall every other caller.  The
+# registry holds weak refs so clients of exited threads are reclaimed by
+# GC (CoordClient.__del__ closes the socket) instead of accumulating.
 _tls = threading.local()
-_service_clients: list[CoordClient] = []
+_service_clients: "weakref.WeakSet[CoordClient]" = weakref.WeakSet()
 _service_clients_lock = threading.Lock()
 
 
@@ -250,9 +271,12 @@ def service_client() -> Optional[CoordClient]:
     if not addr:
         return None
     cached = getattr(_tls, "client", None)
-    if (cached is not None and cached._handle
-            and getattr(_tls, "addr", None) == addr):
-        return cached
+    if cached is not None:
+        if (cached._handle and not cached._shutdown
+                and getattr(_tls, "addr", None) == addr):
+            return cached
+        cached.close()  # ours: stale address or shut down — replace it
+        _tls.client = None
     host, _, port = addr.rpartition(":")
     try:
         client = CoordClient(host or "127.0.0.1", int(port))
@@ -263,20 +287,26 @@ def service_client() -> Optional[CoordClient]:
         return None
     _tls.client, _tls.addr = client, addr
     with _service_clients_lock:
-        _service_clients.append(client)
+        _service_clients.add(client)
     return client
 
 
 def reset_service_client():
-    """Close every cached default client (used when the service shuts
-    down).  Threads re-create their client on next use."""
+    """Wake and retire every cached default client (used when the service
+    shuts down).  Foreign threads' clients are only shut down — never
+    freed from here (a blocked call may hold them); each owner closes or
+    re-creates on next use.  This thread's client is closed outright."""
+    own = getattr(_tls, "client", None)
     with _service_clients_lock:
-        for c in _service_clients:
-            try:
-                c.close()
-            except OSError:
-                pass
+        for c in list(_service_clients):
+            if c is not own:
+                try:
+                    c.shutdown()
+                except OSError:
+                    pass
         _service_clients.clear()
+    if own is not None:
+        own.close()
     _tls.client = None
     _tls.addr = None
 

@@ -413,6 +413,14 @@ void coord_client_close(void* handle) {
   delete c;
 }
 
+// Wakes any call blocked on this client (recv returns EOF) WITHOUT freeing
+// it — safe to invoke from another thread while a Call is in flight; the
+// owner closes (or leaks until exit) the husk later.
+void coord_client_shutdown(void* handle) {
+  if (!handle) return;
+  ::shutdown(static_cast<Client*>(handle)->fd, SHUT_RDWR);
+}
+
 // Round-trips one request.  Returns status; *out/*out_len receive a
 // malloc'd value buffer (caller frees with coord_free) and *ret the
 // response's i64 field, when non-null.

@@ -1,0 +1,108 @@
+"""Shared benchmark harness (≙ reference ``examples/benchmark/utils/``:
+absl flags system + benchmark logger + ``TimeHistory`` meter).
+
+Provides the common flag set, a JSON-lines benchmark logger, and the
+timed training loop all benchmark drivers share.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))  # repo root when run as a script
+
+
+def base_parser(description: str) -> argparse.ArgumentParser:
+    """Common flags (≙ ``utils/flags/_base.py``/``_performance.py``)."""
+    ap = argparse.ArgumentParser(description=description)
+    ap.add_argument("--strategy", default="AllReduce",
+                    help="strategy builder name (AllReduce, PS, "
+                         "PSLoadBalancing, PartitionedPS, Parallax, ZeRO, ...)")
+    ap.add_argument("--batch-size", type=int, default=None,
+                    help="global batch size (default: per-model)")
+    ap.add_argument("--train-steps", type=int, default=30)
+    ap.add_argument("--warmup-steps", type=int, default=2)
+    ap.add_argument("--log-steps", type=int, default=10,
+                    help="steps between throughput reports (TimeHistory)")
+    ap.add_argument("--chunk-size", type=int, default=None,
+                    help="allreduce bucketing chunk size (default: per-model)")
+    ap.add_argument("--benchmark-log-dir", default=None,
+                    help="write benchmark JSON lines here")
+    ap.add_argument("--preset", choices=["tiny", "full"], default="full",
+                    help="tiny = smoke-test sizes for CPU")
+    return ap
+
+
+class BenchmarkLogger:
+    """JSON-lines metric logger (≙ ``utils/logs/logger.py``)."""
+
+    def __init__(self, log_dir: Optional[str] = None):
+        self._f = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            self._f = open(os.path.join(log_dir, "metric.log"), "a")
+
+    def log_metric(self, name: str, value, unit: str = "", step: int = 0,
+                   extras: Optional[dict] = None):
+        record = {"name": name, "value": float(value), "unit": unit,
+                  "timestamp": time.time(), "step": step,
+                  **(extras or {})}
+        line = json.dumps(record)
+        print(line)
+        if self._f:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self):
+        if self._f:
+            self._f.close()
+
+
+def run_benchmark(runner, make_batch: Callable[[int], dict], *,
+                  batch_size: int, train_steps: int, warmup_steps: int,
+                  log_steps: int, logger: BenchmarkLogger,
+                  flops_per_example: Optional[float] = None,
+                  peak_flops: Optional[float] = None) -> dict:
+    """Timed training loop with windowed examples/sec reports
+    (≙ ``TimeHistory``: examples/sec = batch_size × log_steps / elapsed,
+    reference ``examples/benchmark/imagenet.py:84-140``)."""
+    import jax
+
+    for step in range(warmup_steps):
+        runner.step(make_batch(step))
+    jax.block_until_ready(runner.state)
+
+    times = []
+    window_start = time.perf_counter()
+    for step in range(train_steps):
+        t0 = time.perf_counter()
+        metrics = runner.step(make_batch(warmup_steps + step))
+        jax.block_until_ready(metrics)
+        times.append(time.perf_counter() - t0)
+        if (step + 1) % log_steps == 0:
+            elapsed = time.perf_counter() - window_start
+            logger.log_metric("examples_per_sec",
+                              batch_size * log_steps / elapsed, "examples/s",
+                              step=step + 1)
+            window_start = time.perf_counter()
+
+    mean_s = float(np.mean(times))
+    summary = {
+        "examples_per_sec": batch_size / mean_s,
+        "step_ms_mean": mean_s * 1e3,
+        "step_ms_p50": float(np.percentile(times, 50) * 1e3),
+    }
+    if flops_per_example and peak_flops:
+        summary["mfu"] = summary["examples_per_sec"] * flops_per_example / peak_flops
+    logger.log_metric("examples_per_sec_final", summary["examples_per_sec"],
+                      "examples/s", step=train_steps,
+                      extras={k: v for k, v in summary.items()
+                              if k != "examples_per_sec"})
+    return summary

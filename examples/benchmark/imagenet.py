@@ -1,0 +1,78 @@
+"""ImageNet CNN benchmark (≙ reference ``examples/benchmark/imagenet.py``):
+ResNet50/ResNet101/VGG16/InceptionV3/DenseNet121 with a strategy flag and
+the reference's per-model allreduce chunk-size tuning
+(``imagenet.py:151-158``: vgg16=25, resnet101=200, inceptionv3=30,
+default=512).  Synthetic ImageNet-shaped data.
+
+    python examples/benchmark/imagenet.py --model resnet50 --train-steps 50
+    python examples/benchmark/imagenet.py --model resnet18 --preset tiny
+"""
+import numpy as np
+
+from common import BenchmarkLogger, base_parser, run_benchmark
+
+# Reference-tuned collective bucketing per model (imagenet.py:151-158).
+CHUNK_SIZES = {"vgg16": 25, "resnet101": 200, "inceptionv3": 30}
+DEFAULT_CHUNK = 512
+
+
+def build_model(name: str):
+    from autodist_tpu.models import densenet, inception, resnet, vgg
+    zoo = {
+        "resnet18": resnet.ResNet18, "resnet50": resnet.ResNet50,
+        "resnet101": resnet.ResNet101, "vgg16": vgg.VGG16,
+        "densenet121": densenet.DenseNet121,
+        "inceptionv3": inception.InceptionV3,
+    }
+    return zoo[name](num_classes=1000)
+
+
+def main():
+    ap = base_parser("ImageNet CNN benchmark")
+    ap.add_argument("--model", default="resnet50",
+                    choices=["resnet18", "resnet50", "resnet101", "vgg16",
+                             "densenet121", "inceptionv3"])
+    args = ap.parse_args()
+
+    import jax
+    import optax
+
+    from autodist_tpu import AutoDist
+    from autodist_tpu.models.resnet import make_image_trainable
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.strategy import builders
+
+    rs = ResourceSpec({})
+    n = rs.num_devices()
+    if args.preset == "tiny":
+        image_size, batch = 32, 8 * n
+    else:
+        image_size = 299 if args.model == "inceptionv3" else 224
+        batch = args.batch_size or 32 * n
+    chunk = args.chunk_size or CHUNK_SIZES.get(args.model, DEFAULT_CHUNK)
+
+    trainable = make_image_trainable(
+        build_model(args.model), optax.sgd(0.1, momentum=0.9),
+        jax.random.PRNGKey(0), image_size=image_size, batch_size=2,
+        name=args.model)
+    builder = builders.create(args.strategy, **(
+        {"chunk_size": chunk} if args.strategy == "AllReduce" else {}))
+    runner = AutoDist(rs, builder).build(trainable)
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, image_size, image_size, 3).astype(np.float32)
+    y = rng.randint(0, 1000, (batch,)).astype(np.int32)
+
+    logger = BenchmarkLogger(args.benchmark_log_dir)
+    summary = run_benchmark(
+        runner, lambda step: {"x": x, "y": y}, batch_size=batch,
+        train_steps=args.train_steps, warmup_steps=args.warmup_steps,
+        log_steps=args.log_steps, logger=logger)
+    print(f"{args.model}/{args.strategy}: "
+          f"{summary['examples_per_sec']:.1f} examples/s "
+          f"({summary['step_ms_mean']:.1f} ms/step, {n} devices)")
+    logger.close()
+
+
+if __name__ == "__main__":
+    main()

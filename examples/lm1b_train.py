@@ -1,0 +1,50 @@
+"""lm1b-style word language model with sampled softmax
+(≙ reference ``examples/lm1b/lm1b_train.py``), Parallax hybrid strategy:
+dense LSTM weights go over allreduce, the embedding and softmax tables
+take the sharded sparse path.
+
+    python examples/lm1b_train.py --steps 20
+"""
+import argparse
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import jax
+import numpy as np
+import optax
+
+from autodist_tpu import AutoDist
+from autodist_tpu.models.lm1b import make_lm1b_trainable
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seq-len", type=int, default=20)
+    ap.add_argument("--vocab-size", type=int, default=10_000)
+    ap.add_argument("--strategy", default="Parallax")
+    args = ap.parse_args()
+
+    trainable = make_lm1b_trainable(
+        optax.adagrad(0.2), jax.random.PRNGKey(0),
+        vocab_size=args.vocab_size, seq_len=args.seq_len,
+        batch_size=args.batch_size)
+    runner = AutoDist({}, args.strategy).build(trainable)
+
+    rng = np.random.RandomState(0)
+    for step in range(args.steps):
+        x = rng.randint(0, args.vocab_size,
+                        (args.batch_size, args.seq_len)).astype(np.int32)
+        y = np.roll(x, -1, axis=1)
+        metrics = runner.step({"x": x, "y": y})
+        if step % 5 == 0 or step == args.steps - 1:
+            print(f"step {step}: loss={float(np.asarray(metrics['loss'])):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Example/benchmark scripts smoke tests.
+
+The reference's integration tier ran its example case files end-to-end
+per strategy (SURVEY.md §4); here each script runs as a subprocess on a
+small simulated CPU mesh with tiny sizes.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_script(rel_path, *args, timeout=240):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "PYTHONPATH": REPO,
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, rel_path), *args],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    return proc.stdout
+
+
+def test_linear_regression():
+    out = run_script("examples/linear_regression.py", "--steps", "6")
+    assert "loss=" in out
+
+
+def test_image_classifier():
+    out = run_script("examples/image_classifier.py", "--steps", "4",
+                     "--batch-size", "16")
+    assert "loss=" in out
+
+
+def test_sentiment_classifier_partitioned_ps():
+    out = run_script("examples/sentiment_classifier.py", "--steps", "4",
+                     "--strategy", "PartitionedPS", "--vocab-size", "1000")
+    assert "loss=" in out
+
+
+def test_lm1b_parallax():
+    out = run_script("examples/lm1b_train.py", "--steps", "4",
+                     "--vocab-size", "2000")
+    assert "loss=" in out
+
+
+def test_benchmark_imagenet_tiny():
+    out = run_script("examples/benchmark/imagenet.py", "--model", "resnet18",
+                     "--preset", "tiny", "--train-steps", "4",
+                     "--log-steps", "2", "--warmup-steps", "1")
+    assert "examples_per_sec_final" in out
+    assert "resnet18/AllReduce" in out
+
+
+def test_benchmark_bert_tiny_flash(tmp_path):
+    out = run_script("examples/benchmark/bert.py", "--preset", "tiny",
+                     "--train-steps", "4", "--log-steps", "2",
+                     "--warmup-steps", "1", "--flash-attention",
+                     "--benchmark-log-dir", str(tmp_path))
+    assert "MFU" in out
+    assert (tmp_path / "metric.log").exists()
+
+
+def test_benchmark_ncf_tiny():
+    out = run_script("examples/benchmark/ncf.py", "--preset", "tiny",
+                     "--train-steps", "4", "--log-steps", "2",
+                     "--warmup-steps", "1")
+    assert "ncf/AllReduce" in out
