@@ -20,10 +20,11 @@ from autodist_tpu.strategy.builders import (AllReduce, Parallax,
                                             RandomAxisPartitionAR,
                                             UnevenPartitionedPS, ZeRO)
 from autodist_tpu.strategy.ir import Strategy
+from autodist_tpu.simulator import AutoStrategy
 
 __all__ = [
     "AutoDist", "Trainable", "VarInfo", "ResourceSpec", "DistributedRunner",
     "Strategy", "AllReduce", "PS", "PSLoadBalancing", "PartitionedPS",
     "UnevenPartitionedPS", "PartitionedAR", "RandomAxisPartitionAR",
-    "Parallax", "ZeRO",
+    "Parallax", "ZeRO", "AutoStrategy",
 ]

@@ -1,0 +1,6 @@
+"""Strategy cost simulation and auto-selection (the working counterpart
+of the reference's AutoSync stub, ``autodist/simulator/``)."""
+from autodist_tpu.simulator.auto_strategy import AutoStrategy, default_candidates
+from autodist_tpu.simulator.cost_model import CostModel, StrategyCost
+
+__all__ = ["AutoStrategy", "CostModel", "StrategyCost", "default_candidates"]

@@ -1,0 +1,77 @@
+"""AutoStrategy: pick the best strategy by analytic cost.
+
+The working realization of the reference's *planned* AutoSync auto-
+strategy flow (strategy → cost model → choose; the reference shipped
+only the dataset stub, ``autodist/simulator/dataset/README.md``): build
+every candidate strategy, score with :class:`CostModel`, take the
+cheapest feasible plan.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from autodist_tpu.simulator.cost_model import CostModel, StrategyCost
+from autodist_tpu.strategy import builders as _builders
+from autodist_tpu.strategy.base import StrategyBuilder
+from autodist_tpu.utils import logging
+
+
+def default_candidates() -> list[StrategyBuilder]:
+    return [
+        _builders.AllReduce(),
+        _builders.AllReduce(compressor="bf16"),
+        _builders.PSLoadBalancing(),
+        _builders.PartitionedPS(),
+        _builders.Parallax(),
+        _builders.ZeRO(),
+    ]
+
+
+class AutoStrategy(StrategyBuilder):
+    """Chooses among candidate builders with the analytic cost model
+    (≙ the reference's declared AutoStrategy direction, SURVEY.md §2.3).
+
+    ``auto = AutoStrategy(); AutoDist(spec, auto).build(trainable)`` —
+    after ``build``, ``auto.report`` holds the scored candidates.
+    """
+
+    def __init__(self, candidates: Optional[Sequence[StrategyBuilder]] = None,
+                 **cost_model_kwargs):
+        self.candidates = list(candidates) if candidates is not None \
+            else default_candidates()
+        if not self.candidates:
+            raise ValueError("AutoStrategy needs at least one candidate")
+        self.cost_model_kwargs = cost_model_kwargs
+        self.report: list[tuple[str, StrategyCost]] = []
+
+    def build(self, trainable, resource_spec):
+        model = CostModel(resource_spec, **self.cost_model_kwargs)
+        scored = []
+        for builder in self.candidates:
+            name = type(builder).__name__
+            try:
+                strategy = builder.build(trainable, resource_spec)
+            except ValueError as e:
+                logging.debug("candidate %s skipped: %s", name, e)
+                continue
+            cost = model.strategy_cost(trainable, strategy)
+            scored.append((name, cost, strategy))
+        if not scored:
+            raise ValueError("no AutoStrategy candidate produced a strategy")
+        scored.sort(key=lambda t: (t[1].score, t[1].num_collectives))
+        self.report = [(name, cost) for name, cost, _ in scored]
+        for name, cost in self.report:
+            logging.info(
+                "auto-strategy candidate %-18s comm=%8.1fMB t=%7.3fms "
+                "colls=%3d mem/dev=%6.2fGB%s", name,
+                cost.comm_bytes / 1e6, cost.comm_time_s * 1e3,
+                cost.num_collectives, cost.mem_bytes_per_device / 1e9,
+                "" if cost.feasible else "  INFEASIBLE")
+        best_name, best_cost, best_strategy = scored[0]
+        if not best_cost.feasible:
+            raise ValueError(
+                "no candidate strategy fits in device memory "
+                f"(best: {best_name} needs "
+                f"{best_cost.mem_bytes_per_device / 1e9:.2f} GB/device)")
+        logging.info("auto-strategy picked %s", best_name)
+        return best_strategy

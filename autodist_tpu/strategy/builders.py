@@ -284,7 +284,14 @@ BUILDERS = {
 def create(name: str, **kw) -> StrategyBuilder:
     """Builder factory by name (≙ reference ``Synchronizer.create``
     reflection, ``synchronizer.py:90-104``)."""
+    if name == "AutoStrategy":  # lazy: simulator imports this module
+        from autodist_tpu.simulator import AutoStrategy
+        return AutoStrategy(**kw)
+    if name in ("Sharded", "TensorParallel", "FSDPSharded"):
+        from autodist_tpu.strategy import gspmd_builders
+        return getattr(gspmd_builders, name)(**kw)
     if name not in BUILDERS:
-        raise ValueError(f"unknown strategy builder {name!r}; "
-                         f"have {sorted(BUILDERS)}")
+        raise ValueError(
+            f"unknown strategy builder {name!r}; have "
+            f"{sorted(BUILDERS) + ['AutoStrategy', 'Sharded', 'TensorParallel', 'FSDPSharded']}")
     return BUILDERS[name](**kw)
