@@ -29,8 +29,13 @@ NEG_INF = float(np.finfo(np.float32).min)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
-                causal: bool, block_k: int, seq_len: int):
-    """One (batch·head, q-block) program: online softmax over k blocks."""
+                causal: bool, block_k: int, seq_len: int, valid_len: int):
+    """One (batch·head, q-block) program: online softmax over k blocks.
+
+    ``seq_len`` is the (possibly padded) physical length; ``valid_len``
+    the logical one — padded key columns are masked with the same finite
+    ``NEG_INF`` the causal mask uses, so fully-masked rows stay NaN-free.
+    """
     block_q = q_ref.shape[1]
     head_dim = q_ref.shape[2]
     iq = pl.program_id(1)
@@ -48,12 +53,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [BQ, BK]
+        cols = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
         if causal:
             rows = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            cols = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
+        if valid_len < seq_len:
+            s = jnp.where(cols < valid_len, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
@@ -69,22 +76,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
 
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    lse_ref[0] = m + jnp.log(l)  # [BQ, 1]
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    """q/k/v: [BH, L, D] → (out [BH, L, D], lse [BH, L])."""
+def _aligned_block(seq_len: int, block: int) -> int:
+    """Clamp a requested block size to the sequence and round down to the
+    TPU sublane tile (8); sequences shorter than a tile use one padded
+    8-row block."""
+    return max(8, (min(block, seq_len) // 8) * 8)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               valid_len):
+    """q/k/v: [BH, L_pad, D] (pre-padded so both blocks divide L_pad) →
+    (out [BH, L_pad, D], lse [BH, L_pad, 1])."""
     bh, seq_len, head_dim = q.shape
-    block_q = min(block_q, seq_len)
-    block_k = min(block_k, seq_len)
-    if seq_len % block_q or seq_len % block_k:
-        raise ValueError(
-            f"sequence length {seq_len} must be divisible by block sizes "
-            f"({block_q}, {block_k})")
+    assert seq_len % block_q == 0 and seq_len % block_k == 0
     grid = (bh, seq_len // block_q)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_k=block_k,
-        seq_len=seq_len)
+        seq_len=seq_len, valid_len=valid_len)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -95,23 +106,34 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, head_dim), lambda bh_, iq: (bh_, iq, 0)),
-            pl.BlockSpec((1, block_q), lambda bh_, iq: (bh_, iq)),
+            # lse kept 3D [BH, L, 1]: TPU block shapes must tile the last
+            # two dims (divisible by 8/128 or full-size); a trailing
+            # singleton satisfies that where a 2D (1, block_q) cannot.
+            pl.BlockSpec((1, block_q, 1), lambda bh_, iq: (bh_, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_len, head_dim), q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_len), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq_len, 1), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
 
 
-def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_k):
+def _flash_fwd_2d(q, k, v, scale, causal, block_q, block_k, interpret,
+                  valid_len):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          interpret, valid_len)
+    return out, lse[..., 0]
+
+
+def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_k, valid_len):
     """Blockwise flash backward (recompute from lse), plain JAX.
 
-    All inputs [BH, L, D] (lse [BH, L]); returns (dq, dk, dv) in fp32.
+    All inputs [BH, L_pad, D] (lse [BH, L_pad]); returns (dq, dk, dv)
+    in fp32.  The recompute must re-apply the valid-length mask: padded
+    k rows are zeros, which would otherwise contribute p=exp(-lse) ≠ 0.
     """
     bh, seq_len, head_dim = q.shape
-    block_k = min(block_k, seq_len)
     num_kb = seq_len // block_k
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
@@ -126,9 +148,11 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_k):
         v_blk = jax.lax.dynamic_slice_in_dim(vf, kb * block_k, block_k, 1)
         s = jnp.einsum("bld,bkd->blk", qf, k_blk) * scale
         p = jnp.exp(s - lse[..., None])  # [BH, L, BK]
+        cols = kb * block_k + jnp.arange(block_k)
         if causal:
-            cols = kb * block_k + jnp.arange(block_k)
             p = jnp.where(rows[:, None] >= cols[None, :], p, 0.0)
+        if valid_len < seq_len:
+            p = jnp.where(cols[None, :] < valid_len, p, 0.0)
         dv_blk = jnp.einsum("blk,bld->bkd", p, gf)
         dp = jnp.einsum("bld,bkd->blk", gf, v_blk)
         ds = p * (dp - delta[..., None]) * scale
@@ -143,20 +167,26 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_k):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_bhld(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bhld(q, k, v, scale, causal, block_q, block_k, interpret,
+                valid_len):
+    out, _ = _flash_fwd_2d(q, k, v, scale, causal, block_q, block_k,
+                           interpret, valid_len)
     return out
 
 
-def _flash_bhld_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+def _flash_bhld_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+                    valid_len):
+    out, lse = _flash_fwd_2d(q, k, v, scale, causal, block_q, block_k,
+                             interpret, valid_len)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bhld_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bhld_bwd(scale, causal, block_q, block_k, interpret, valid_len,
+                    res, g):
     q, k, v, out, lse = res
-    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, scale, causal, block_k)
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, scale, causal, block_k,
+                            valid_len)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -178,12 +208,23 @@ def flash_attention(q, k, v, *, causal: bool = False,
     if scale is None:
         scale = 1.0 / math.sqrt(d)
 
+    # Blocks are 8-aligned; the sequence is zero-padded to a common block
+    # multiple (masked inside the kernel), so any length lowers on TPU
+    # without materializing [L, L] scores.
+    bq = _aligned_block(l, block_q)
+    bk = _aligned_block(l, block_k)
+    lcm = bq * bk // math.gcd(bq, bk)
+    l_pad = ((l + lcm - 1) // lcm) * lcm
+
     def to_bhld(x):
-        return jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
+        x = jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
+        if l_pad != l:
+            x = jnp.pad(x, ((0, 0), (0, l_pad - l), (0, 0)))
+        return x
 
     out = _flash_bhld(to_bhld(q), to_bhld(k), to_bhld(v), float(scale),
-                      bool(causal), int(block_q), int(block_k),
-                      bool(interpret))
+                      bool(causal), bq, bk, bool(interpret), int(l))
+    out = out[:, :l] if l_pad != l else out
     return jnp.moveaxis(out.reshape(b, h, l, d), 1, 2)
 
 

@@ -108,12 +108,21 @@ class CostModel:
                 mem_bytes += bytes_ \
                     + bytes_ * factor \
                     + (bytes_ * self.opt_state_multiplier) / n
+            elif sync.kind == "ps":
+                # Dense unpartitioned PS ⇒ ZeRO-1 U_FLAT lowering
+                # (lowering.py:150-152): params + grads replicated,
+                # reduce_scatter grads + all_gather params (ring-equivalent
+                # volume), optimizer state sharded 1/n.
+                comm_bytes += ring * bytes_
+                num_collectives += 2
+                mem_bytes += 2.0 * bytes_ \
+                    + (bytes_ * self.opt_state_multiplier) / n
             else:
                 # Replicated DP allreduce: bucketed collectives count once
                 # per group (≙ ScopedAllocator merging, runner.py:40-46).
                 comm_bytes += ring * bytes_ * factor
                 group = getattr(sync, "group", None)
-                if group is not None and sync.kind == "allreduce":
+                if group is not None:
                     groups.add(group)
                 else:
                     num_collectives += 1

@@ -44,10 +44,32 @@ def test_forward_uneven_blocks():
                                atol=1e-5, rtol=1e-5)
 
 
-def test_indivisible_seq_raises():
-    q, k, v = _inputs(l=100)
-    with pytest.raises(ValueError, match="divisible"):
-        flash_attention(q, k, v, block_q=64, block_k=64)
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("l", [100, 127, 4])
+def test_indivisible_seq_pads_and_masks(l, causal):
+    """Arbitrary sequence lengths (incl. prime and sub-tile) are padded to
+    a block multiple and masked — numerics must still match, forward and
+    backward."""
+    q, k, v = _inputs(l=l, d=16)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    ref = _reference(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = _reference(q, k, v, causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=1e-4, rtol=1e-3,
+                                   err_msg=f"d{name} mismatch at l={l}")
 
 
 @pytest.mark.parametrize("causal", [False, True])
