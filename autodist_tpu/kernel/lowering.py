@@ -67,6 +67,8 @@ class VarPlan:
     update: str                   # U_REPLICATED | U_FLAT | U_AXIS
     bucket: Optional[str]         # allreduce bucket key (None = unsynced path)
     compressor: str = "none"
+    sparse_lookup: bool = False   # vocab-sharded: feed the loss a
+                                  # ShardedEmbedding (touched-rows sync)
 
     @property
     def param_spec(self) -> P:
@@ -143,9 +145,14 @@ def make_plan(trainable: Trainable, strategy: Strategy, mesh) -> Plan:
                     "as fully synchronous (documented gap, SURVEY.md §7)",
                     sync.staleness, info.name)
             if split_axis >= 0 and info.shape:
+                # Sparse + vocab(axis-0)-sharded: the loss sees a
+                # ShardedEmbedding and only touched rows cross the wire
+                # (≙ reference sparse PS path, ps_synchronizer.py:476-535).
                 plan = VarPlan(info.name, info.shape, info.dtype,
                                stored_sharded=True, split_axis=split_axis,
-                               update=U_AXIS, bucket=None)
+                               update=U_AXIS, bucket=None,
+                               sparse_lookup=bool(node.is_sparse)
+                               and split_axis == 0)
             else:
                 plan = VarPlan(info.name, info.shape, info.dtype,
                                stored_sharded=False, split_axis=-1,
@@ -244,10 +251,18 @@ def _sync_state_shapes(plan: Plan, trainable: Trainable, n: int):
 # The lowered program
 # --------------------------------------------------------------------------- #
 def _gather_full(plan: Plan, data_axis: str, stored):
-    """Stored-space params → full (gather sharded vars, unpad)."""
+    """Stored-space params → full (gather sharded vars, unpad).
+
+    Sparse vocab-sharded tables are *not* gathered: the loss receives a
+    :class:`ShardedEmbedding` whose row lookups move touched rows only
+    (dense uses decay to an all_gather via ``__jax_array__``)."""
+    from autodist_tpu.ops.sparse import ShardedEmbedding
 
     def full(name, p):
         vp = plan.var_plans[name]
+        if vp.sparse_lookup:
+            return ShardedEmbedding(p, vp.shape[0], data_axis,
+                                    plan.num_replicas)
         if vp.stored_sharded:
             return common.all_gather_axis(
                 p, data_axis, vp.split_axis, vp.shape[vp.split_axis])

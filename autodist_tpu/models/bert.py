@@ -68,7 +68,10 @@ class BertModel(nn.Module):
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_dense")(gathered)
         h = nn.gelu(h)
         h = nn.LayerNorm(dtype=cfg.dtype, name="mlm_ln")(h)
-        logits = embed.attend(h.astype(jnp.float32))  # [B, P, V]
+        # Tied-embedding decode on the MXU in model dtype (the [H, V]
+        # matmul is the head's FLOP bulk); logits promoted to fp32 for
+        # the softmax by the loss head.
+        logits = embed.attend(h).astype(jnp.float32)  # [B, P, V]
         logits = logits + self.param(
             "mlm_bias", nn.initializers.zeros, (cfg.vocab_size,), jnp.float32)
         return logits

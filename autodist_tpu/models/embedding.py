@@ -1,0 +1,43 @@
+"""Sharding-aware embedding layer for the model zoo.
+
+Drop-in for ``flax.linen.Embed`` (same param name/shape, so checkpoints
+interchange) that routes lookups through
+:func:`autodist_tpu.ops.embedding_lookup`: under a vocab-sharded strategy
+(Parallax / PartitionedPS, reference ``parallax_strategy.py:24-71``) the
+table arrives as a :class:`~autodist_tpu.ops.ShardedEmbedding` and only
+touched rows cross the wire; replicated tables take a plain gather.
+``flax.linen.Embed`` still *works* with sharded tables (its ``jnp.take``
+decays to the dense all_gather fallback) — this layer is what makes the
+sparse path actually sparse.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from autodist_tpu.ops.sparse import embedding_lookup
+
+
+class SparseEmbed(nn.Module):
+    """Embedding lookup with touched-rows-only synchronization."""
+
+    num_embeddings: int
+    features: int
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+    # flax.linen.Embed's default, so the layer swaps in init-identically.
+    embedding_init: Any = nn.initializers.variance_scaling(
+        1.0, "fan_in", "normal", out_axis=0)
+
+    @nn.compact
+    def __call__(self, ids):
+        table = self.param("embedding", self.embedding_init,
+                           (self.num_embeddings, self.features),
+                           self.param_dtype)
+        # Cast before the lookup (as nn.Embed does) so the collective
+        # moves rows at compute precision, not storage precision.
+        if self.dtype is not None:
+            table = table.astype(self.dtype)
+        return embedding_lookup(table, ids)

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from autodist_tpu.models.embedding import SparseEmbed
+
 
 def log_uniform_sample(rng, num_samples: int, vocab_size: int):
     """Log-uniform (Zipfian) candidate ids + expected-count corrections,
@@ -67,7 +69,8 @@ class LSTMWordLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens):
-        x = nn.Embed(self.vocab_size, self.embed_dim, name="embedding")(tokens)
+        x = SparseEmbed(self.vocab_size, self.embed_dim,
+                        name="embedding")(tokens)
         B = tokens.shape[0]
         for i in range(self.num_layers):
             cell = nn.OptimizedLSTMCell(self.hidden_dim, name=f"lstm_{i}")

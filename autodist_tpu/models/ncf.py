@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from autodist_tpu.models.embedding import SparseEmbed
+
 
 class NeuMF(nn.Module):
     num_users: int = 138_000
@@ -22,12 +24,14 @@ class NeuMF(nn.Module):
 
     @nn.compact
     def __call__(self, users, items):
-        mf_u = nn.Embed(self.num_users, self.mf_dim, name="mf_user_embedding")(users)
-        mf_i = nn.Embed(self.num_items, self.mf_dim, name="mf_item_embedding")(items)
-        mlp_u = nn.Embed(self.num_users, self.mlp_dims[0] // 2,
-                         name="mlp_user_embedding")(users)
-        mlp_i = nn.Embed(self.num_items, self.mlp_dims[0] // 2,
-                         name="mlp_item_embedding")(items)
+        mf_u = SparseEmbed(self.num_users, self.mf_dim,
+                           name="mf_user_embedding")(users)
+        mf_i = SparseEmbed(self.num_items, self.mf_dim,
+                           name="mf_item_embedding")(items)
+        mlp_u = SparseEmbed(self.num_users, self.mlp_dims[0] // 2,
+                            name="mlp_user_embedding")(users)
+        mlp_i = SparseEmbed(self.num_items, self.mlp_dims[0] // 2,
+                            name="mlp_item_embedding")(items)
 
         gmf = mf_u * mf_i
         mlp = jnp.concatenate([mlp_u, mlp_i], axis=-1)

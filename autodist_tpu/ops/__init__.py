@@ -1,4 +1,6 @@
-"""Pallas TPU kernels for the hot ops."""
+"""Pallas TPU kernels and sharding-aware ops for the hot paths."""
 from autodist_tpu.ops.flash_attention import flash_attention, make_attention_fn
+from autodist_tpu.ops.sparse import ShardedEmbedding, embedding_lookup
 
-__all__ = ["flash_attention", "make_attention_fn"]
+__all__ = ["flash_attention", "make_attention_fn", "ShardedEmbedding",
+           "embedding_lookup"]

@@ -1,0 +1,166 @@
+"""Touched-rows-only synchronization for vocab-sharded embeddings.
+
+TPU-native counterpart of the reference's entire sparse machinery: the
+index-range split of IndexedSlices gradients
+(``autodist/kernel/partitioner.py:660-684``), the sparse conditional
+accumulators on the PS (``ps_synchronizer.py:476-535``), and the
+allgather of indices+values under collective sync
+(``all_reduce_synchronizer.py:132-173``).  On a TPU mesh both directions
+become batch-sized collectives inside the one SPMD program:
+
+* **forward (pull ≙ embedding_lookup over the partitioned variable,
+  reference ``partitioner.py:576-602``)**: all_gather the *ids* (tiny),
+  every shard answers the ids it owns with zeros elsewhere, and a
+  psum_scatter returns each device exactly the rows for its own batch —
+  wire volume scales with *touched rows*, never with the table.
+* **backward (push ≙ sparse accumulator)**: all_gather (ids, grad rows)
+  and scatter-add the entries each shard owns into its slice.
+
+The :class:`ShardedEmbedding` wrapper is what the lowering feeds the
+loss function in place of a gathered table.  Row indexing (``table[ids]``
+or :func:`embedding_lookup`) takes the sparse path; any other use decays
+to a dense ``all_gather`` via ``__jax_array__`` — the FSDP semantics the
+table would have had anyway — so dense consumers (e.g. a tied softmax
+decode) keep working, they just pay the dense price.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _collective_lookup(shard, ids, axis_name: str, num_shards: int,
+                       full_rows: int):
+    out, _ = _collective_lookup_fwd(shard, ids, axis_name, num_shards,
+                                    full_rows)
+    return out
+
+
+def _local_hits(shard, ids, axis_name):
+    """Rows of ``shard`` for the global ``ids`` it owns, zeros elsewhere."""
+    rows_per_shard = shard.shape[0]
+    local = ids - lax.axis_index(axis_name) * rows_per_shard
+    ok = (local >= 0) & (local < rows_per_shard)
+    rows = jnp.take(shard, jnp.clip(local, 0, rows_per_shard - 1), axis=0)
+    return jnp.where(ok[..., None], rows, 0), local, ok
+
+
+def _rows_per_shard(full_rows: int, num_shards: int) -> int:
+    """Rows each shard holds (stored tables pad the vocab axis to
+    ``num_shards``·this — ``kernel.common.padded_shape``).  The backward
+    derives scatter offsets from this, so :meth:`ShardedEmbedding.lookup`
+    validates the shard against it up front."""
+    from autodist_tpu.kernel import common
+    return common.ceil_div(full_rows, num_shards)
+
+
+def _collective_lookup_fwd(shard, ids, axis_name, num_shards, full_rows):
+    flat_ids = ids.reshape(-1)
+    gids = lax.all_gather(flat_ids, axis_name)       # [n, B] — tiny
+    rows, _, _ = _local_hits(shard, gids, axis_name)  # [n, B, D]
+    n, b, d = rows.shape
+    # Sum over shards; device i keeps slice i == the rows for its own ids.
+    mine = lax.psum_scatter(rows.reshape(n * b, d), axis_name,
+                            scatter_dimension=0, tiled=True)
+    out = mine.reshape(*ids.shape, d)
+    return out, ids
+
+
+def _collective_lookup_bwd(axis_name, num_shards, full_rows, ids, g):
+    flat_ids = ids.reshape(-1)
+    d = g.shape[-1]
+    gids = lax.all_gather(flat_ids, axis_name)                 # [n, B]
+    grows = lax.all_gather(g.reshape(-1, d), axis_name)        # [n, B, D]
+    rows_per_shard = _rows_per_shard(full_rows, num_shards)
+    local = gids - lax.axis_index(axis_name) * rows_per_shard
+    ok = (local >= 0) & (local < rows_per_shard)
+    contrib = jnp.where(ok[..., None], grows, 0).reshape(-1, d)
+    idx = jnp.clip(local, 0, rows_per_shard - 1).reshape(-1)
+    d_shard = jnp.zeros((rows_per_shard, d), g.dtype).at[idx].add(contrib)
+    d_ids = np.zeros(ids.shape, jax.dtypes.float0)  # ids are integral
+    return d_shard, d_ids
+
+
+_collective_lookup.defvjp(_collective_lookup_fwd, _collective_lookup_bwd)
+
+
+@dataclasses.dataclass
+class ShardedEmbedding:
+    """A vocab-sharded embedding table as seen by the loss function.
+
+    ``shard`` is this device's contiguous row block (inside ``shard_map``);
+    ``full_rows`` the unpadded logical row count.  Deliberately *not* a
+    registered pytree: it only ever lives as an intermediate inside the
+    traced step (AD flows through the closed-over shard tracer), and
+    opacity is what lets flax treat it as a parameter leaf whose
+    ``.shape`` reports the full logical table.
+    """
+
+    shard: Any
+    full_rows: int
+    axis_name: str
+    num_shards: int
+
+    # -- array-ish surface ------------------------------------------------ #
+    @property
+    def shape(self):
+        return (self.full_rows,) + tuple(self.shard.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.shard.dtype
+
+    @property
+    def ndim(self):
+        return self.shard.ndim
+
+    def __getitem__(self, ids):
+        """Row lookup → the touched-rows-only collective path."""
+        if isinstance(ids, tuple) or not (
+                hasattr(ids, "dtype") or isinstance(ids, (list, int))):
+            return self.to_full()[ids]
+        ids = jnp.asarray(ids)
+        if not jnp.issubdtype(ids.dtype, jnp.integer):
+            return self.to_full()[ids]
+        return self.lookup(ids)
+
+    def lookup(self, ids):
+        expect = _rows_per_shard(self.full_rows, self.num_shards)
+        if self.shard.shape[0] != expect:
+            raise ValueError(
+                f"shard has {self.shard.shape[0]} rows; a {self.full_rows}"
+                f"-row table over {self.num_shards} shards stores {expect} "
+                "rows per shard (backward scatter offsets assume this)")
+        return _collective_lookup(self.shard, jnp.asarray(ids),
+                                  self.axis_name, self.num_shards,
+                                  self.full_rows)
+
+    def astype(self, dtype):
+        return ShardedEmbedding(self.shard.astype(dtype), self.full_rows,
+                                self.axis_name, self.num_shards)
+
+    def to_full(self):
+        """Dense escape hatch: the all-gathered table (FSDP semantics)."""
+        from autodist_tpu.kernel import common
+        return common.all_gather_axis(self.shard, self.axis_name, 0,
+                                      self.full_rows)
+
+    def __jax_array__(self):
+        return self.to_full()
+
+
+def embedding_lookup(table, ids):
+    """Sharding-aware embedding lookup: the declared-access counterpart
+    of the reference rewiring ``ResourceGather`` consumers onto the
+    partitioned variable (``partitioner.py:576-602``).  ``table`` may be
+    a plain array (plain gather) or a :class:`ShardedEmbedding`."""
+    if isinstance(table, ShardedEmbedding):
+        return table.lookup(ids)
+    return jnp.take(table, ids, axis=0)

@@ -90,8 +90,15 @@ class CostModel:
             sync = node.synchronizer
             factor = COMPRESSOR_FACTOR.get(
                 getattr(sync, "compressor", "none"), 1.0)
+            # Touched-rows pricing only applies when the lowering actually
+            # takes the sparse path: PS + vocab(axis-0) partitioning
+            # (lowering.py make_plan's sparse_lookup gate).
+            sparse_fast = (
+                node.is_sparse and sync.kind == "ps" and sharded
+                and node.partitioner.num_shards > 1
+                and max(node.partitioner.split_axis, 0) == 0)
 
-            if node.is_sparse and sync.kind == "ps":
+            if sparse_fast:
                 # Sparse sharded path: only touched rows move (gather of
                 # params + scatter of grads), ≙ the reference's sparse
                 # PS push/pull (ps_synchronizer.py:476-535).
