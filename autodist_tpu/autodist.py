@@ -112,11 +112,28 @@ class AutoDist:
 
     def build(self, trainable: Trainable,
               strategy: Optional[Strategy] = None, *,
-              rng: Any = None) -> DistributedRunner:
+              rng: Any = None, **runner_kwargs):
         """Lower + instantiate the runner (≙ building the distributed
-        session, reference ``autodist.py:139-150``)."""
+        session, reference ``autodist.py:139-150``).
+
+        A strategy with any ``PS(sync=False)`` node dispatches to
+        :class:`~autodist_tpu.runner.AsyncPSRunner` (host-side push/pull —
+        asynchrony cannot live inside one SPMD program); everything else
+        gets the SPMD :class:`~autodist_tpu.runner.DistributedRunner`."""
+        strategy = strategy or self.build_or_load_strategy(trainable)
+        from autodist_tpu.strategy.ir import PSSynchronizer
+        async_nodes = [
+            nc for nc in strategy.node_configs
+            if isinstance(nc.synchronizer, PSSynchronizer)
+            and not nc.synchronizer.sync]
+        if async_nodes:
+            from autodist_tpu.runner import AsyncPSRunner
+            staleness = max((nc.synchronizer.staleness
+                             for nc in async_nodes), default=0)
+            return AsyncPSRunner(trainable, staleness=staleness, rng=rng,
+                                 **runner_kwargs)
         return DistributedRunner(trainable, self.lower(trainable, strategy),
-                                 rng=rng)
+                                 rng=rng, **runner_kwargs)
 
     # Convenience one-shot (≙ the experimental ``autodist.function``,
     # reference ``autodist.py:252-289``).

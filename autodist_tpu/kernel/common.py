@@ -99,6 +99,35 @@ def local_axis_shard(x, axis_name: str, n: int, axis: int):
 # --------------------------------------------------------------------------- #
 # Pytree path helpers
 # --------------------------------------------------------------------------- #
+def match_var_by_suffix(leaf_name: str, var_names, shape_ok=None):
+    """Resolve an optimizer-state leaf path to the variable whose path it
+    embeds (optax states nest param-shaped subtrees under the same key
+    paths, e.g. ``ScaleByAdamState.mu/<var path>``).
+
+    Candidates are variables whose full path is a ``/``-suffix of
+    ``leaf_name``; the longest (most specific) wins — ``nested/w`` beats
+    ``w`` for leaf ``mu/nested/w``.  ``shape_ok(var_name) -> bool``, when
+    given, filters candidates (longest-first) so a specific-but-wrong-shape
+    match falls through to a shorter one instead of silently failing.
+    Equal-length distinct candidates are impossible for pure suffix
+    matching (same length + same suffix position ⇒ same string), but the
+    invariant is asserted rather than assumed.
+    """
+    candidates = [v for v in var_names
+                  if leaf_name == v or leaf_name.endswith("/" + v)]
+    if not candidates:
+        return None
+    candidates.sort(key=len, reverse=True)
+    for a, b in zip(candidates, candidates[1:]):
+        assert len(a) != len(b), (
+            f"ambiguous optimizer-state match for {leaf_name!r}: "
+            f"{a!r} vs {b!r}")
+    for cand in candidates:
+        if shape_ok is None or shape_ok(cand):
+            return cand
+    return None
+
+
 def flatten_with_names(tree):
     """[(name, leaf)] using the same naming as ``capture.path_to_name``."""
     from autodist_tpu.capture import path_to_name

@@ -128,14 +128,13 @@ def lower_gspmd(trainable: Trainable, strategy: Strategy, mesh) -> GspmdLowered:
             trainable.params))
 
     def opt_spec_for(path, leaf):
+        from autodist_tpu.kernel import common
         name = path_to_name(path)
-        candidates = [v for v in by_name
-                      if name == v or name.endswith("/" + v)]
-        if candidates:
-            var = max(candidates, key=len)
-            if tuple(leaf.shape) == tuple(shapes_by_name[var]):
-                return by_name[var]
-        return P()
+        var = common.match_var_by_suffix(
+            name, by_name,
+            shape_ok=lambda v: tuple(leaf.shape)
+            == tuple(shapes_by_name[v]))
+        return by_name[var] if var else P()
 
     o_specs = jax.tree_util.tree_map_with_path(opt_spec_for, opt_shapes)
     extra_specs = jax.tree.map(lambda _: P(), trainable.extra)

@@ -108,6 +108,8 @@ class Plan:
     num_replicas: int
     buckets: dict[str, list[str]]          # bucket key -> ordered var names
     bucket_compressor: dict[str, str]      # bucket key -> compressor name
+    ssp_staleness: int = 0                 # max PSSynchronizer.staleness:
+                                           # the runner's host-side SSP gate
 
 
 def make_plan(trainable: Trainable, strategy: Strategy, mesh) -> Plan:
@@ -122,6 +124,7 @@ def make_plan(trainable: Trainable, strategy: Strategy, mesh) -> Plan:
     var_plans: dict[str, VarPlan] = {}
     buckets: dict[str, list[str]] = {}
     bucket_comp: dict[str, str] = {}
+    ssp_staleness = 0
     for info in trainable.var_infos():
         node = strategy.node_config_for(info.name)
         sync = node.synchronizer if node else AllReduceSynchronizer()
@@ -133,17 +136,24 @@ def make_plan(trainable: Trainable, strategy: Strategy, mesh) -> Plan:
                 # Mesh resolution overrides shard-count hints the same way
                 # the reference's compiler overrode device strings
                 # (strategy/base.py:120-168): shards must map 1:1 onto the
-                # mesh axis.
-                logging.warning(
+                # mesh axis.  Routine (UnevenPartitionedPS emits reference
+                # counts by design), hence debug not warning.
+                logging.debug(
                     "%s: partitioner requests %d shards; lowering over the "
                     "%d-way %s axis instead", info.name, part.num_shards, n,
                     const.DATA_AXIS)
         if isinstance(sync, PSSynchronizer):
-            if sync.staleness > 0:
-                logging.warning(
-                    "staleness=%d on %s: SSP fights SPMD lockstep; lowering "
-                    "as fully synchronous (documented gap, SURVEY.md §7)",
-                    sync.staleness, info.name)
+            if not sync.sync:
+                # Async PS is a different execution mode (host-side push/
+                # pull, runner.AsyncPSRunner) — it cannot lower into one
+                # SPMD program, and silently training synchronously would
+                # misreport the semantics the user asked for.
+                raise NotImplementedError(
+                    f"PS(sync=False) on {info.name}: asynchronous training "
+                    "does not lower to a synchronous SPMD program; build "
+                    "through AutoDist (which dispatches to AsyncPSRunner) "
+                    "or use sync=True")
+            ssp_staleness = max(ssp_staleness, sync.staleness)
             if split_axis >= 0 and info.shape:
                 # Sparse + vocab(axis-0)-sharded: the loss sees a
                 # ShardedEmbedding and only touched rows cross the wire
@@ -173,7 +183,7 @@ def make_plan(trainable: Trainable, strategy: Strategy, mesh) -> Plan:
                 bucket_comp[key] = sync.compressor
         var_plans[info.name] = plan
     return Plan(var_plans=var_plans, num_replicas=n, buckets=buckets,
-                bucket_compressor=bucket_comp)
+                bucket_compressor=bucket_comp, ssp_staleness=ssp_staleness)
 
 
 # --------------------------------------------------------------------------- #
@@ -223,13 +233,11 @@ def _opt_state_specs(plan: Plan, trainable: Trainable, n: int):
     def spec_for(path, leaf):
         from autodist_tpu.capture import path_to_name
         name = path_to_name(path)
-        candidates = [v for v in var_names
-                      if name == v or name.endswith("/" + v)]
-        if candidates:
-            vp = plan.var_plans[max(candidates, key=len)]
-            if tuple(leaf.shape) == vp.update_shape(n):
-                return vp.update_spec()
-        return P()
+        var = common.match_var_by_suffix(
+            name, var_names,
+            shape_ok=lambda v: tuple(leaf.shape)
+            == plan.var_plans[v].update_shape(n))
+        return plan.var_plans[var].update_spec() if var else P()
 
     return jax.tree_util.tree_map_with_path(spec_for, opt_shapes), opt_shapes
 

@@ -124,6 +124,13 @@ class ResourceSpec:
         return devs
 
     def num_devices(self) -> int:
+        """Declared device count when the spec gives one — strategy
+        building must work *before* the backend is initialized (the chief
+        plans, then launches workers, then bootstraps; ≙ the reference
+        building strategies from the YAML inventory alone,
+        ``resource_spec.py:45-78``).  Falls back to the live device list."""
+        if self._requested_devices is not None:
+            return self._requested_devices
         return len(self.devices())
 
     def resolved_mesh_shape(self) -> dict[str, int]:

@@ -11,12 +11,19 @@ shard.  Initializers-on-construction (``runner.py:97-100``) becomes
 """
 from __future__ import annotations
 
+import io
+import os
+import struct
+import threading
 import time
 from typing import Any, Iterable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from autodist_tpu import const
 from autodist_tpu.kernel.lowering import Lowered
@@ -26,7 +33,9 @@ from autodist_tpu.utils import logging
 class DistributedRunner:
     """Owns (mesh, compiled step fns, state); the training session."""
 
-    def __init__(self, trainable, lowered: Lowered, *, rng: Optional[Any] = None):
+    def __init__(self, trainable, lowered: Lowered, *, rng: Optional[Any] = None,
+                 ssp_worker: Optional[str] = None,
+                 ssp_num_workers: Optional[int] = None):
         self.trainable = trainable
         self.lowered = lowered
         self.mesh = lowered.mesh
@@ -34,6 +43,35 @@ class DistributedRunner:
         self.state = lowered.init_state(trainable=trainable)
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._step_times: list[float] = []
+        self._host_step = 0
+        self._ssp = self._make_ssp_gate(ssp_worker, ssp_num_workers)
+
+    def _make_ssp_gate(self, worker: Optional[str],
+                       num_workers: Optional[int]):
+        """Host-side stale-synchronous gate (≙ the reference's
+        depth-``staleness`` token queues, ``ps_synchronizer.py:387-458``):
+        active when the strategy carries ``staleness > 0`` and a
+        coordination service is reachable.  Inside one SPMD process group
+        the program is lockstep regardless; the gate bounds skew *between*
+        processes of the job."""
+        staleness = getattr(self.lowered.plan, "ssp_staleness", 0)
+        if staleness <= 0:
+            return None
+        from autodist_tpu.runtime import coordination
+
+        client = coordination.service_client()
+        if client is None:
+            logging.warning(
+                "strategy requests staleness=%d but no coordination service "
+                "is configured (AUTODIST_TPU_COORD_SERVICE); running in "
+                "lockstep", staleness)
+            return None
+        worker = worker or const.ENV.AUTODIST_TPU_WORKER.val or "chief"
+        if num_workers is None:
+            n = const.ENV.AUTODIST_TPU_NUM_PROCESSES.val
+            num_workers = n if n > 1 else None
+        return coordination.SSPController(client, worker, staleness,
+                                          num_workers=num_workers)
 
     # ---------------- feed/fetch (≙ Remapper) -------------------------- #
     def _place_batch(self, batch):
@@ -58,10 +96,23 @@ class DistributedRunner:
     # ---------------- the hot loop (≙ WrappedSession.run) --------------- #
     def step(self, batch, *, rng=None):
         """One optimizer step; returns the metrics dict (fetch contract)."""
+        if self._ssp is not None and not self._ssp.start_step(self._host_step):
+            # A timed-out bounded wait means a peer stalled or died;
+            # free-running past it would silently void the staleness bound
+            # the strategy asked for.  Fail fast (framework policy §5.3).
+            raise TimeoutError(
+                f"SSP wait at step {self._host_step} timed out: a worker "
+                f"is more than staleness={self._ssp.staleness} steps behind")
         batch = self._place_batch(batch)
         if rng is None:
             self.rng, rng = jax.random.split(self.rng)
         self.state, metrics = self.lowered.step_fn(self.state, batch, rng)
+        if self._ssp is not None:
+            # Report completion only once the device work really finished —
+            # the dispatch above is async.
+            jax.block_until_ready(metrics)
+            self._ssp.finish_step(self._host_step)
+        self._host_step += 1
         return metrics
 
     def run(self, data: Iterable, num_steps: Optional[int] = None,
@@ -122,3 +173,239 @@ class DistributedRunner:
 
     def get_extra(self):
         return jax.device_get(self.state["extra"])
+
+
+# --------------------------------------------------------------------------- #
+# Asynchronous PS (PS(sync=False))
+# --------------------------------------------------------------------------- #
+def _pack_tree(version: int, tree) -> bytes:
+    leaves = [np.asarray(l) for l in jax.tree.leaves(tree)]
+    buf = io.BytesIO()
+    np.savez(buf, **{f"l{i}": l for i, l in enumerate(leaves)})
+    return struct.pack("<q", version) + buf.getvalue()
+
+
+def _unpack_tree(data: bytes, like):
+    version = struct.unpack("<q", data[:8])[0]
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    with np.load(io.BytesIO(data[8:])) as z:
+        new = [z[f"l{i}"] for i in range(len(leaves))]
+    return version, jax.tree_util.tree_unflatten(treedef, new)
+
+
+class AsyncPSRunner:
+    """Asynchronous parameter-server training — ``PS(sync=False)``
+    (reference ``synchronizers.proto:31``, ``ps_synchronizer.py:216-230``:
+    workers push gradients and proceed without waiting for each other).
+
+    SPMD lockstep cannot express this, so the data plane leaves XLA: each
+    process computes gradients with a *local* SPMD program (pmean over its
+    own devices ≙ in-graph replica aggregation), then pushes them to a
+    host-side PS loop over the coordination service (grads queue ≙ the
+    reference's conditional accumulators in their accumulate-1 async
+    configuration; params KV ≙ workers' read ops).  The optimizer runs
+    only on the PS; workers' parameters change only via pulls, and with a
+    single worker pull-after-apply reproduces synchronous SGD exactly
+    (tested).  ``staleness > 0`` adds the same SSP gate as the sync path.
+    """
+
+    GRADS_QUEUE = "asyncps/grads"
+    PARAMS_KEY = "asyncps/params"
+    VERSION_KEY = "asyncps/version"  # tiny: polled without moving the blob
+
+    def __init__(self, trainable, *, staleness: int = 0,
+                 rng: Optional[Any] = None, ssp_worker: Optional[str] = None,
+                 ssp_num_workers: Optional[int] = None,
+                 is_chief: Optional[bool] = None):
+        from autodist_tpu.runtime import coordination
+
+        if trainable.extra is not None:
+            raise NotImplementedError(
+                "async PS does not support mutable extra state (batch "
+                "stats); train those models synchronously")
+        self.trainable = trainable
+        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._host_step = 0
+        self._closed = False
+
+        self.is_chief = (is_chief if is_chief is not None
+                         else not const.ENV.AUTODIST_TPU_WORKER.val)
+        self._own_server = None
+        client = coordination.service_client()
+        if client is None:
+            if not self.is_chief:
+                # A private in-process server would hold no published
+                # params: the worker would block forever on the first
+                # pull.  Fail loudly instead.
+                raise OSError(
+                    "async PS worker needs a reachable coordination "
+                    "service (AUTODIST_TPU_COORD_SERVICE); none configured "
+                    "or connection failed")
+            # Single-process convenience: the chief runs the PS service
+            # in-process.
+            self._own_server = coordination.CoordServer()
+            os.environ["AUTODIST_TPU_COORD_SERVICE"] = \
+                f"127.0.0.1:{self._own_server.port}"
+            client = coordination.service_client()
+        self._client = client
+
+        worker = ssp_worker or const.ENV.AUTODIST_TPU_WORKER.val or "chief"
+
+        # Local mesh only: async workers never run cross-process collectives.
+        devs = np.array(jax.local_devices())
+        self.mesh = Mesh(devs, (const.DATA_AXIS,))
+        n = len(devs)
+        data_axis = const.DATA_AXIS
+
+        def local_grads(params, batch, rng_):
+            local_rng = jax.random.fold_in(rng_, lax.axis_index(data_axis))
+
+            def loss_fn(p):
+                loss, _, metrics = trainable.loss(p, None, batch, local_rng)
+                return loss, metrics
+
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
+            metrics = jax.tree.map(
+                lambda m: lax.pmean(m, data_axis)
+                if jnp.issubdtype(jnp.result_type(m), jnp.inexact) else m,
+                dict(metrics))
+            return grads, metrics
+
+        self._grads_fn = jax.jit(jax.shard_map(
+            local_grads, mesh=self.mesh,
+            in_specs=(P(), P(data_axis), P()),
+            out_specs=(P(), P()), check_vma=False))
+        self._batch_sharding = NamedSharding(self.mesh, P(data_axis))
+
+        self.params = jax.tree.map(np.asarray, trainable.params)
+        self._params_version = 0
+        self._ps_thread = None
+        self._ps_stop_event = threading.Event()
+        if self.is_chief:
+            self._start_ps_loop()
+        else:
+            self._pull(block=True, force=True)  # adopt the PS's init params
+
+        self._ssp = None
+        if staleness > 0:
+            if ssp_num_workers is None:
+                np_ = const.ENV.AUTODIST_TPU_NUM_PROCESSES.val
+                ssp_num_workers = np_ if np_ > 1 else None
+            self._ssp = coordination.SSPController(
+                self._client, worker, staleness,
+                num_workers=ssp_num_workers)
+
+    # ------------------------------------------------------------------ #
+    def _start_ps_loop(self):
+        """The parameter server proper: one host thread owning (params,
+        opt_state), applying every pushed gradient as it arrives (≙ the
+        PS devices' apply ops, reference ``ps_synchronizer.py:216-230``)."""
+        opt = self.trainable.optimizer
+        ps_params = self.trainable.params
+        ps_opt_state = opt.init(ps_params)
+        apply_fn = jax.jit(lambda g, s, p: opt.update(g, s, p))
+        # Blob first, version second: a reader that sees version N will
+        # fetch blob ≥ N (never older).
+        self._client.put(self.PARAMS_KEY, _pack_tree(0, ps_params))
+        self._client.put(self.VERSION_KEY, struct.pack("<q", 0))
+        coord_addr = os.environ.get("AUTODIST_TPU_COORD_SERVICE", "")
+
+        def loop():
+            from autodist_tpu.runtime.coordination import CoordClient
+            nonlocal ps_params, ps_opt_state
+            host, _, port = coord_addr.rpartition(":")
+            ps_client = CoordClient(host or "127.0.0.1", int(port))
+            version = 0
+            while not self._ps_stop_event.is_set():
+                try:
+                    msg = ps_client.queue_get(self.GRADS_QUEUE,
+                                              timeout_ms=200)
+                except OSError:
+                    break  # service shut down
+                if msg is None:
+                    continue
+                _, grads = _unpack_tree(msg, ps_params)
+                updates, ps_opt_state = apply_fn(grads, ps_opt_state,
+                                                 ps_params)
+                ps_params = optax.apply_updates(ps_params, updates)
+                version += 1
+                ps_client.put(self.PARAMS_KEY,
+                              _pack_tree(version, ps_params))
+                ps_client.put(self.VERSION_KEY, struct.pack("<q", version))
+            ps_client.close()
+
+        self._ps_thread = threading.Thread(target=loop, daemon=True,
+                                           name="asyncps-server")
+        self._ps_thread.start()
+
+    def _pull(self, block: bool = False, force: bool = False):
+        ver_raw = self._client.get(self.VERSION_KEY,
+                                   timeout_ms=-1 if block else 0)
+        if ver_raw is None:
+            return
+        if not force and struct.unpack("<q", ver_raw)[0] == self._params_version:
+            return  # nothing new: skip moving the blob
+        data = self._client.get(self.PARAMS_KEY, timeout_ms=-1)
+        self._params_version, self.params = _unpack_tree(data, self.params)
+
+    # ------------------------------------------------------------------ #
+    def step(self, batch, *, rng=None):
+        """Pull-latest → local grads → push; returns local metrics."""
+        if self._closed:
+            raise RuntimeError("runner is closed")
+        if self._ssp is not None and not self._ssp.start_step(self._host_step):
+            raise TimeoutError(
+                f"SSP wait at step {self._host_step} timed out: a worker "
+                f"is more than staleness={self._ssp.staleness} steps behind")
+        self._pull()
+        if rng is None:
+            self.rng, rng = jax.random.split(self.rng)
+        batch = jax.tree.map(
+            lambda x: jax.device_put(np.asarray(x), self._batch_sharding),
+            batch)
+        grads, metrics = self._grads_fn(self.params, batch, rng)
+        self._client.queue_put(self.GRADS_QUEUE,
+                               _pack_tree(self._host_step,
+                                          jax.device_get(grads)))
+        if self._ssp is not None:
+            self._ssp.finish_step(self._host_step)
+        self._host_step += 1
+        return metrics
+
+    def wait_applied(self, min_version: int, timeout_s: float = 30.0):
+        """Block until the PS has applied at least ``min_version`` updates
+        (deterministic hand-off for tests / epoch boundaries)."""
+        deadline = time.time() + timeout_s
+        while self._params_version < min_version:
+            self._pull(block=False)
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"PS applied {self._params_version} < {min_version} "
+                    f"updates within {timeout_s}s")
+            time.sleep(0.005)
+
+    @property
+    def step_count(self) -> int:
+        return self._host_step
+
+    def get_params(self):
+        self._pull()
+        return self.params
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._ps_stop_event.set()
+        if self._ps_thread is not None:
+            self._ps_thread.join(timeout=5)
+        if self._own_server is not None:
+            from autodist_tpu.runtime import coordination
+            addr = f"127.0.0.1:{self._own_server.port}"
+            if os.environ.get("AUTODIST_TPU_COORD_SERVICE") == addr:
+                del os.environ["AUTODIST_TPU_COORD_SERVICE"]
+            coordination.reset_service_client()
+            self._own_server.stop()
+            self._own_server = None

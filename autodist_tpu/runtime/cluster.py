@@ -113,16 +113,27 @@ class Coordinator:
     def launch(self, name: str, argv: Sequence[str], *,
                env: Optional[dict] = None, host: Optional[str] = None,
                cwd: Optional[str] = None) -> WorkerHandle:
-        """Launch one worker locally, or on ``host`` via ssh."""
+        """Launch one worker locally, or on ``host`` via ssh.
+
+        Remote env vars travel on ssh *stdin* (a `/bin/sh -s` bootstrap),
+        never on the command line: the set includes the coordination
+        shared secret, and argv is world-readable via ``ps`` on both
+        ends for the lifetime of the job."""
         full_env = dict(os.environ)
         full_env.update(env or {})
+        stdin_script = None
         if host:
-            assignments = " ".join(
-                f"{k}={shlex.quote(v)}" for k, v in (env or {}).items())
-            remote = f"{assignments} {' '.join(shlex.quote(a) for a in argv)}"
-            argv = ["ssh", "-o", "BatchMode=yes", host, remote]
+            lines = [f"export {k}={shlex.quote(v)}"
+                     for k, v in (env or {}).items()]
+            lines.append("exec " + " ".join(shlex.quote(a) for a in argv))
+            stdin_script = "\n".join(lines) + "\n"
+            argv = ["ssh", "-o", "BatchMode=yes", host, "/bin/sh -s"]
         proc = subprocess.Popen(
-            list(argv), env=full_env, cwd=cwd, start_new_session=True)
+            list(argv), env=full_env, cwd=cwd, start_new_session=True,
+            stdin=subprocess.PIPE if stdin_script else None)
+        if stdin_script:
+            proc.stdin.write(stdin_script.encode())
+            proc.stdin.close()
         handle = WorkerHandle(name, proc, self._worker_failed)
         self.workers.append(handle)
         logging.info("launched worker %s (pid %d)%s", name, proc.pid,
@@ -241,6 +252,9 @@ class Cluster:
             }
             if coord_addr:
                 env["AUTODIST_TPU_COORD_SERVICE"] = coord_addr
+                token = os.environ.get("AUTODIST_TPU_COORD_TOKEN", "")
+                if token:
+                    env["AUTODIST_TPU_COORD_TOKEN"] = token
             env.update(extra_env or {})
             handles.append(self.coordinator.launch(
                 f"worker-{i + 1}", argv, env=env,

@@ -57,13 +57,14 @@ def _load():
         return _lib
     lib = ctypes.CDLL(_ensure_built())
     lib.coord_server_start.restype = ctypes.c_void_p
-    lib.coord_server_start.argtypes = [ctypes.c_int]
+    lib.coord_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                       ctypes.c_char_p]
     lib.coord_server_port.restype = ctypes.c_int
     lib.coord_server_port.argtypes = [ctypes.c_void_p]
     lib.coord_server_stop.argtypes = [ctypes.c_void_p]
     lib.coord_client_connect.restype = ctypes.c_void_p
     lib.coord_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
-                                         ctypes.c_int]
+                                         ctypes.c_int, ctypes.c_char_p]
     lib.coord_client_close.argtypes = [ctypes.c_void_p]
     lib.coord_client_shutdown.argtypes = [ctypes.c_void_p]
     lib.coord_put.restype = ctypes.c_int
@@ -103,11 +104,33 @@ def _load():
 
 
 class CoordServer:
-    """In-process native coordination server (run by the chief)."""
+    """In-process native coordination server (run by the chief).
 
-    def __init__(self, port: int = 0):
+    Every connection must authenticate with a shared-secret ``token``
+    before any other request is served (the reference's control plane was
+    authenticated SSH/SFTP, ``cluster.py:271-374``; an open barrier/KV
+    port would let any host that can reach it corrupt the strategy
+    handoff).  Default token: ``AUTODIST_TPU_COORD_TOKEN``, else a fresh
+    ``secrets`` token exported to this process's env so in-process
+    clients and launched workers inherit it.  ``bind_host`` restricts the
+    listening interface (``AUTODIST_TPU_COORD_BIND``; default all
+    interfaces, as remote workers must reach the chief).
+    """
+
+    def __init__(self, port: int = 0, bind_host: Optional[str] = None,
+                 token: Optional[str] = None):
         self._lib = _load()
-        self._handle = self._lib.coord_server_start(port)
+        if bind_host is None:
+            bind_host = const.ENV.AUTODIST_TPU_COORD_BIND.val
+        if token is None:
+            token = const.ENV.AUTODIST_TPU_COORD_TOKEN.val
+            if not token:
+                import secrets
+                token = secrets.token_hex(16)
+                os.environ["AUTODIST_TPU_COORD_TOKEN"] = token
+        self.token = token
+        self._handle = self._lib.coord_server_start(
+            (bind_host or "").encode(), port, token.encode())
         if not self._handle:
             raise OSError(f"could not start coordination server on port {port}")
         self.port = self._lib.coord_server_port(self._handle)
@@ -139,13 +162,18 @@ class CoordClient:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 connect_timeout_ms: int = 10000):
+                 connect_timeout_ms: int = 10000,
+                 token: Optional[str] = None):
         self._lib = _load()
         self._shutdown = False
+        if token is None:
+            token = const.ENV.AUTODIST_TPU_COORD_TOKEN.val
         self._handle = self._lib.coord_client_connect(
-            host.encode(), port, connect_timeout_ms)
+            host.encode(), port, connect_timeout_ms, (token or "").encode())
         if not self._handle:
-            raise OSError(f"could not connect to coordinator {host}:{port}")
+            raise OSError(
+                f"could not connect to coordinator {host}:{port} "
+                "(unreachable or token rejected)")
 
     def close(self):
         """Free the native client.  Only the owning thread may call this:

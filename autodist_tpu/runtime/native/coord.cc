@@ -51,6 +51,8 @@ enum Op : uint8_t {
   kSspRegister = 7,  // key = worker name
   kSspReport = 8,    // key = worker name, arg = completed step
   kSspWait = 9,      // arg = step, arg2 = staleness; uses default timeout
+  kAuth = 10,        // val = shared-secret token; must be a connection's
+                     // first request when the server has a token
 };
 
 enum Status : uint8_t { kOk = 0, kTimeout = 1, kError = 2 };
@@ -163,6 +165,12 @@ bool WaitFor(ServerState& state, std::unique_lock<std::mutex>& lk,
 void HandleRequest(ServerState& state, const Request& req, int fd) {
   std::unique_lock<std::mutex> lk(state.mu);
   switch (req.op) {
+    case kAuth: {
+      // Already authenticated (or no token configured): idempotent OK.
+      lk.unlock();
+      WriteResponse(fd, kOk, 0, "");
+      return;
+    }
     case kPut: {
       state.kv[req.key] = req.val;
       state.cv.notify_all();
@@ -278,6 +286,8 @@ struct Server {
   std::unordered_set<int> conn_fds;
   int active_conns = 0;
 
+  std::string token;  // empty = unauthenticated (trusted loopback only)
+
   void Serve() {
     for (;;) {
       int fd = ::accept(listen_fd, nullptr, nullptr);
@@ -301,7 +311,22 @@ struct Server {
       }
       std::thread([this, fd] {
         Request req;
-        while (ReadRequest(fd, &req)) HandleRequest(state, req, fd);
+        // With a token configured, the first request must authenticate;
+        // anything else (or a wrong token) terminates the connection
+        // before it can touch barriers/KV/queues.
+        bool authed = token.empty();
+        while (ReadRequest(fd, &req)) {
+          if (!authed) {
+            if (req.op == kAuth && req.val == token) {
+              authed = true;
+              if (!WriteResponse(fd, kOk, 0, "")) break;
+              continue;
+            }
+            WriteResponse(fd, kError, 0, "");
+            break;
+          }
+          HandleRequest(state, req, fd);
+        }
         {
           std::lock_guard<std::mutex> g(conn_mu);
           conn_fds.erase(fd);
@@ -324,8 +349,10 @@ struct Server {
 
 extern "C" {
 
-// Starts a server on `port` (0 = ephemeral).  Returns a handle or null.
-void* coord_server_start(int port) {
+// Starts a server on `bind_host:port` (port 0 = ephemeral; bind_host
+// null/"" = all interfaces) requiring `token` (null/"" = no auth) on
+// every connection.  Returns a handle or null.
+void* coord_server_start(const char* bind_host, int port, const char* token) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return nullptr;
   int one = 1;
@@ -333,6 +360,12 @@ void* coord_server_start(int port) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (bind_host != nullptr && bind_host[0] != '\0') {
+    if (::inet_pton(AF_INET, bind_host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return nullptr;
+    }
+  }
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
       ::listen(fd, 128) < 0) {
@@ -344,6 +377,7 @@ void* coord_server_start(int port) {
   auto* srv = new Server();
   srv->listen_fd = fd;
   srv->port = ntohs(addr.sin_port);
+  if (token != nullptr) srv->token = token;
   srv->accept_thread = std::thread([srv] { srv->Serve(); });
   return srv;
 }
@@ -372,7 +406,12 @@ struct Client {
   std::mutex mu;  // serializes request/response pairs on this connection
 };
 
-void* coord_client_connect(const char* host, int port, int timeout_ms) {
+static int Call(Client* c, uint8_t op, const char* key, const void* val,
+                uint32_t val_len, int64_t arg, int64_t arg2, char** out,
+                uint32_t* out_len, int64_t* ret = nullptr);
+
+void* coord_client_connect(const char* host, int port, int timeout_ms,
+                           const char* token) {
   // Resolve hostname or IPv4 literal (chief addresses are usually
   // hostnames on a pod).
   addrinfo hints{};
@@ -402,6 +441,14 @@ void* coord_client_connect(const char* host, int port, int timeout_ms) {
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   auto* c = new Client();
   c->fd = fd;
+  if (token != nullptr && token[0] != '\0') {
+    if (Call(c, kAuth, "", token, static_cast<uint32_t>(std::strlen(token)),
+             0, 0, nullptr, nullptr) != kOk) {
+      ::close(c->fd);
+      delete c;
+      return nullptr;
+    }
+  }
   return c;
 }
 
@@ -426,7 +473,7 @@ void coord_client_shutdown(void* handle) {
 // response's i64 field, when non-null.
 static int Call(Client* c, uint8_t op, const char* key, const void* val,
                 uint32_t val_len, int64_t arg, int64_t arg2, char** out,
-                uint32_t* out_len, int64_t* ret = nullptr) {
+                uint32_t* out_len, int64_t* ret) {
   if (c == nullptr) return kError;
   std::lock_guard<std::mutex> g(c->mu);
   uint16_t klen = static_cast<uint16_t>(std::strlen(key));
@@ -465,6 +512,7 @@ static int Call(Client* c, uint8_t op, const char* key, const void* val,
     *out_len = 0;
     if (vlen) {
       *out = static_cast<char*>(std::malloc(vlen));
+      if (*out == nullptr) return kError;
       std::memcpy(*out, rbuf.data() + 13, vlen);
       *out_len = vlen;
     }

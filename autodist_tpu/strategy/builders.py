@@ -122,10 +122,25 @@ class PartitionedPS(PSLoadBalancing):
 
 
 class UnevenPartitionedPS(PartitionedPS):
-    """Uneven-shard variant (reference
-    ``uneven_partition_ps_strategy.py:126-135`` used a non-divisor shard
-    count).  On TPU uneven shards are realized by padding the last shard,
-    so the lowering is identical; the builder is kept for API parity."""
+    """Uneven-shard variant: the reference's ``get_num_shards`` picks the
+    *smallest non-divisor* ≥ 2 of dim0 so shards come out unequal
+    (``uneven_partition_ps_strategy.py:126-135``); that count is emitted
+    into the strategy IR for serialization parity.  At lowering time the
+    mesh resolver maps any shard count onto the mesh axis (≙ the
+    reference compiler overriding device strings,
+    ``strategy/base.py:120-168``), where non-divisible dims are realized
+    as a padded last shard — the TPU form of unevenness."""
+
+    def num_shards(self, info: VarInfo, n: int) -> int:
+        if not info.shape or len(info.shape) <= self.split_axis:
+            return 1
+        dim = info.shape[self.split_axis]
+        if dim < 2:
+            return 1
+        for i in range(2, dim):
+            if dim % i:
+                return i
+        return dim
 
 
 class AllReduce(StrategyBuilder):

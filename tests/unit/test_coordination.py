@@ -240,3 +240,30 @@ def test_cluster_strategy_handoff_over_service(tmp_path):
         cluster.terminate()
     got = out.read_text().split("|")
     assert got == [strategy.id, "w"]
+
+
+def test_auth_rejects_wrong_token(server):
+    """A connection with a bad (or missing) token must be refused before
+    it can touch barriers/KV (the strategy-handoff surface)."""
+    with pytest.raises(OSError, match="token rejected|could not connect"):
+        CoordClient("127.0.0.1", server.port, connect_timeout_ms=2000,
+                    token="wrong-" + server.token)
+    # No token: the TCP connect succeeds but the first request is refused
+    # and the connection dropped.
+    c = CoordClient("127.0.0.1", server.port, connect_timeout_ms=2000,
+                    token="")
+    with pytest.raises(OSError):
+        c.put("k", b"v")
+    c.close()
+    # The right token still works afterwards.
+    with CoordClient("127.0.0.1", server.port, token=server.token) as c:
+        c.put("k", b"v")
+        assert c.get("k") == b"v"
+
+
+def test_bind_host_restricts_interface():
+    """bind_host=127.0.0.1 keeps the service off external interfaces."""
+    with CoordServer(bind_host="127.0.0.1") as s:
+        with CoordClient("127.0.0.1", s.port, token=s.token) as c:
+            c.put("x", b"1")
+            assert c.get("x") == b"1"
