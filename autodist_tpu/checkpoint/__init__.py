@@ -1,0 +1,6 @@
+"""Checkpointing and serving export (≙ reference ``autodist/checkpoint/``)."""
+from autodist_tpu.checkpoint.export import (ExportedModel, export_model,
+                                            load_exported)
+from autodist_tpu.checkpoint.saver import Saver
+
+__all__ = ["Saver", "export_model", "load_exported", "ExportedModel"]

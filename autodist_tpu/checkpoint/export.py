@@ -1,0 +1,112 @@
+"""Serving export: a trained model as a portable inference artifact.
+
+Counterpart of the reference's ``SavedModelBuilder``
+(``autodist/checkpoint/saved_model_builder.py:42-59``), which exported a
+SavedModel whose variables were written through the AutoDist saver so a
+distributed run produced a normal single-node serving artifact.  The
+TPU-native artifact is:
+
+* ``params/`` — Orbax checkpoint of the parameters at logical names and
+  unpadded shapes (the Saver's "looks unpartitioned" contract), loadable
+  without this framework;
+* ``apply.stablehlo`` — the inference function serialized with
+  ``jax.export`` (StableHLO with versioned compatibility guarantees),
+  closed over nothing: it takes (params, *inputs);
+* ``meta.json`` — input tree structure/shape/dtype manifest.
+
+Export works from a live distributed runner under ANY strategy (FSDP,
+Parallax, …): parameters are fetched through the unpad/gather path before
+serialization.  ``load_exported`` rehydrates both pieces on a single
+device (a serving host) with no strategy machinery involved.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from autodist_tpu.utils import logging
+
+_APPLY_FILE = "apply.stablehlo"
+_META_FILE = "meta.json"
+_PARAMS_DIR = "params"
+
+
+def _shape_tree(tree):
+    return jax.tree.map(
+        lambda x: {"shape": list(np.shape(x)),
+                   "dtype": str(np.asarray(x).dtype
+                                if not hasattr(x, "dtype") else x.dtype)},
+        tree)
+
+
+def export_model(path: str, apply_fn: Callable, params: Any,
+                 sample_inputs: Sequence[Any], *,
+                 runner: Optional[Any] = None) -> str:
+    """Write a serving artifact to ``path``.
+
+    ``apply_fn(params, *inputs) -> outputs`` is the pure inference
+    function.  ``params`` may be given directly, or fetched from a live
+    ``runner`` (``runner.get_params()`` — unpadded logical layout, any
+    strategy).  ``sample_inputs`` fixes the traced input shapes/dtypes.
+    """
+    from jax import export as jax_export
+
+    if runner is not None:
+        params = runner.get_params()
+    params = jax.device_get(params)
+    os.makedirs(path, exist_ok=True)
+
+    # 1. Parameters at logical names (restorable without the framework).
+    ckpt = ocp.StandardCheckpointer()
+    ckpt.save(os.path.join(os.path.abspath(path), _PARAMS_DIR), params,
+              force=True)
+    ckpt.wait_until_finished()
+
+    # 2. The apply fn as StableHLO, abstracted over (params, *inputs).
+    args = (params,) + tuple(sample_inputs)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        args)
+    exported = jax_export.export(jax.jit(apply_fn))(*abstract)
+    with open(os.path.join(path, _APPLY_FILE), "wb") as f:
+        f.write(exported.serialize())
+
+    # 3. Manifest.
+    with open(os.path.join(path, _META_FILE), "w") as f:
+        json.dump({"inputs": jax.tree.map(
+            lambda s: {"shape": list(s.shape), "dtype": str(s.dtype)},
+            abstract[1:], is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct)),
+            "num_inputs": len(sample_inputs)}, f, indent=2)
+    logging.info("serving export written to %s", path)
+    return path
+
+
+class ExportedModel:
+    """A loaded serving artifact: ``model(*inputs) -> outputs``."""
+
+    def __init__(self, call, params, meta):
+        self._call = call
+        self.params = params
+        self.meta = meta
+
+    def __call__(self, *inputs):
+        return self._call(self.params, *inputs)
+
+
+def load_exported(path: str) -> ExportedModel:
+    """Rehydrate an artifact written by :func:`export_model` on the
+    current (single-device serving) backend."""
+    from jax import export as jax_export
+
+    with open(os.path.join(path, _APPLY_FILE), "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    ckpt = ocp.StandardCheckpointer()
+    params = ckpt.restore(os.path.join(os.path.abspath(path), _PARAMS_DIR))
+    with open(os.path.join(path, _META_FILE)) as f:
+        meta = json.load(f)
+    return ExportedModel(exported.call, params, meta)

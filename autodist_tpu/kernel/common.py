@@ -97,6 +97,28 @@ def local_axis_shard(x, axis_name: str, n: int, axis: int):
 
 
 # --------------------------------------------------------------------------- #
+# Feed contract (reference ``remapper.py:81-123``): leaves with a batch
+# dimension split across the data axis, scalars duplicate to every replica.
+# Single source of truth for every lowering backend and runner.
+# --------------------------------------------------------------------------- #
+def batch_specs(batch, spec):
+    """Per-leaf PartitionSpecs for a host batch: ``spec`` for batched
+    leaves, replicated for scalars (duplicate-feed)."""
+    from jax.sharding import PartitionSpec as P
+    return jax.tree.map(
+        lambda x: P() if jnp.ndim(x) == 0 else spec, batch)
+
+
+def batch_shardings(batch, mesh, spec):
+    """Same rule as :func:`batch_specs`, as ``NamedSharding``s."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+    split = NamedSharding(mesh, spec)
+    return jax.tree.map(
+        lambda x: rep if jnp.ndim(x) == 0 else split, batch)
+
+
+# --------------------------------------------------------------------------- #
 # Pytree path helpers
 # --------------------------------------------------------------------------- #
 def match_var_by_suffix(leaf_name: str, var_names, shape_ok=None):

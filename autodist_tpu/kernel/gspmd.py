@@ -179,18 +179,29 @@ def lower_gspmd(trainable: Trainable, strategy: Strategy, mesh) -> GspmdLowered:
                  "sync_state": {}},
                 dict(metrics))
 
+    def _constrain_batch(batch):
+        # Per-leaf feed rule (scalars duplicate) resolved at trace time —
+        # a fixed in_shardings entry cannot express mixed batch trees.
+        from autodist_tpu.kernel import common
+        return jax.tree.map(
+            jax.lax.with_sharding_constraint, batch,
+            common.batch_shardings(batch, mesh, batch_spec))
+
+    def _step_outer(state, batch, rng):
+        return _step(state, _constrain_batch(batch), rng)
+
     step_fn = jax.jit(
-        _step, donate_argnums=(0,),
-        in_shardings=(state_shardings, batch_sharding, None),
+        _step_outer, donate_argnums=(0,),
+        in_shardings=(state_shardings, None, None),
         out_shardings=(state_shardings, None))
 
     def _eval(state, batch, rng):
         _, _, metrics = trainable.eval_loss(state["params"], state["extra"],
-                                            batch, rng)
+                                            _constrain_batch(batch), rng)
         return dict(metrics)
 
     eval_fn = jax.jit(
-        _eval, in_shardings=(state_shardings, batch_sharding, None))
+        _eval, in_shardings=(state_shardings, None, None))
 
     return GspmdLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
                         state_specs=state_specs,

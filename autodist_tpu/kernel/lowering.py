@@ -476,7 +476,7 @@ def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
     def _step(state, batch, rng):
         sm = jax.shard_map(
             _local_step, mesh=mesh,
-            in_specs=(state_specs, batch_spec, P()),
+            in_specs=(state_specs, common.batch_specs(batch, batch_spec), P()),
             out_specs=(state_specs, P()),
             check_vma=False,
         )
@@ -495,7 +495,7 @@ def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
     def _eval(state, batch, rng):
         return jax.shard_map(
             _local_eval, mesh=mesh,
-            in_specs=(state_specs, batch_spec, P()),
+            in_specs=(state_specs, common.batch_specs(batch, batch_spec), P()),
             out_specs=P(), check_vma=False)(state, batch, rng)
 
     eval_fn = jax.jit(_eval)

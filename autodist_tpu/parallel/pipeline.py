@@ -164,9 +164,10 @@ def lower_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     batch_spec = P(data_axis)
 
     def _step(state, batch, rng):
+        from autodist_tpu.kernel import common
         return jax.shard_map(
             _local_step, mesh=mesh,
-            in_specs=(state_specs, batch_spec, P()),
+            in_specs=(state_specs, common.batch_specs(batch, batch_spec), P()),
             out_specs=(state_specs, P()),
             check_vma=False)(state, batch, rng)
 

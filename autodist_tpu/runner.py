@@ -75,23 +75,28 @@ class DistributedRunner:
 
     # ---------------- feed/fetch (≙ Remapper) -------------------------- #
     def _place_batch(self, batch):
-        """Split the host batch across the data axis (feed contract,
-        reference ``remapper.py:109-123``).  Already-placed global arrays
-        pass through."""
-        sharding = self._batch_sharding
+        """Feed contract (reference ``remapper.py:81-123``): leaves with a
+        batch dimension are *split* across the data axis; scalars (the
+        polymorphic-feed analog of non-batch placeholders — step counts,
+        loss scales) are *duplicated* to every replica.  Already-placed
+        global arrays pass through."""
+        from autodist_tpu.kernel import common
 
-        def place(x):
+        shardings = common.batch_shardings(batch, self.mesh,
+                                           self.lowered.batch_spec)
+
+        def place(x, sharding):
             if isinstance(x, jax.Array) and not x.is_fully_addressable:
                 return x  # already a global array (multi-host path)
             x = np.asarray(x)
             n = self.mesh.shape[const.DATA_AXIS]
-            if x.ndim == 0 or x.shape[0] % n:
+            if x.ndim > 0 and x.shape[0] % n:
                 raise ValueError(
                     f"batch leading dim {x.shape} must be divisible by the "
                     f"data-axis size {n}")
             return jax.device_put(x, sharding)
 
-        return jax.tree.map(place, batch)
+        return jax.tree.map(place, batch, shardings)
 
     # ---------------- the hot loop (≙ WrappedSession.run) --------------- #
     def step(self, batch, *, rng=None):
@@ -273,10 +278,14 @@ class AsyncPSRunner:
                 dict(metrics))
             return grads, metrics
 
-        self._grads_fn = jax.jit(jax.shard_map(
-            local_grads, mesh=self.mesh,
-            in_specs=(P(), P(data_axis), P()),
-            out_specs=(P(), P()), check_vma=False))
+        def grads_step(params, batch, rng_):
+            from autodist_tpu.kernel import common as kcommon
+            return jax.shard_map(
+                local_grads, mesh=self.mesh,
+                in_specs=(P(), kcommon.batch_specs(batch, P(data_axis)), P()),
+                out_specs=(P(), P()), check_vma=False)(params, batch, rng_)
+
+        self._grads_fn = jax.jit(grads_step)
         self._batch_sharding = NamedSharding(self.mesh, P(data_axis))
 
         self.params = jax.tree.map(np.asarray, trainable.params)
@@ -362,9 +371,12 @@ class AsyncPSRunner:
         self._pull()
         if rng is None:
             self.rng, rng = jax.random.split(self.rng)
+
+        from autodist_tpu.kernel import common as kcommon
         batch = jax.tree.map(
-            lambda x: jax.device_put(np.asarray(x), self._batch_sharding),
-            batch)
+            lambda x, s: jax.device_put(np.asarray(x), s), batch,
+            kcommon.batch_shardings(batch, self.mesh,
+                                    self._batch_sharding.spec))
         grads, metrics = self._grads_fn(self.params, batch, rng)
         self._client.queue_put(self.GRADS_QUEUE,
                                _pack_tree(self._host_step,
