@@ -36,23 +36,20 @@ _META_FILE = "meta.json"
 _PARAMS_DIR = "params"
 
 
-def _shape_tree(tree):
-    return jax.tree.map(
-        lambda x: {"shape": list(np.shape(x)),
-                   "dtype": str(np.asarray(x).dtype
-                                if not hasattr(x, "dtype") else x.dtype)},
-        tree)
-
-
 def export_model(path: str, apply_fn: Callable, params: Any,
                  sample_inputs: Sequence[Any], *,
-                 runner: Optional[Any] = None) -> str:
+                 runner: Optional[Any] = None,
+                 platforms: Optional[Sequence[str]] = ("cpu", "tpu")) -> str:
     """Write a serving artifact to ``path``.
 
     ``apply_fn(params, *inputs) -> outputs`` is the pure inference
     function.  ``params`` may be given directly, or fetched from a live
     ``runner`` (``runner.get_params()`` — unpadded logical layout, any
     strategy).  ``sample_inputs`` fixes the traced input shapes/dtypes.
+    ``platforms`` lists the serving backends the artifact must run on
+    (a TPU-trained model usually serves from CPU hosts too; pass ``None``
+    to pin to the exporting backend only, e.g. when ``apply_fn`` contains
+    kernels that lower for a single platform).
     """
     from jax import export as jax_export
 
@@ -72,7 +69,9 @@ def export_model(path: str, apply_fn: Callable, params: Any,
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
         args)
-    exported = jax_export.export(jax.jit(apply_fn))(*abstract)
+    exported = jax_export.export(
+        jax.jit(apply_fn),
+        platforms=list(platforms) if platforms else None)(*abstract)
     with open(os.path.join(path, _APPLY_FILE), "wb") as f:
         f.write(exported.serialize())
 

@@ -144,7 +144,7 @@ def lower_gspmd(trainable: Trainable, strategy: Strategy, mesh) -> GspmdLowered:
         lambda s: NamedSharding(mesh, s), state_specs,
         is_leaf=lambda x: isinstance(x, P))
     batch_spec = P(const.DATA_AXIS)
-    batch_sharding = NamedSharding(mesh, batch_spec)
+
 
     def _init(params, extra):
         return {"step": jnp.zeros((), jnp.int32),

@@ -126,44 +126,155 @@ def _flash_fwd_2d(q, k, v, scale, causal, block_q, block_k, interpret,
     return out, lse[..., 0]
 
 
-def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_k, valid_len):
-    """Blockwise flash backward (recompute from lse), plain JAX.
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, g_ref, dq_ref,
+                   *, scale: float, causal: bool, block_k: int,
+                   seq_len: int, valid_len: int):
+    """One (batch·head, q-block) program: dq via recompute over k blocks."""
+    block_q = q_ref.shape[1]
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)        # [BQ, D]
+    g = g_ref[0].astype(jnp.float32)        # [BQ, D]
+    lse = lse_ref[0]                        # [BQ, 1]
+    delta = delta_ref[0]                    # [BQ, 1]
 
-    All inputs [BH, L_pad, D] (lse [BH, L_pad]); returns (dq, dk, dv)
-    in fp32.  The recompute must re-apply the valid-length mask: padded
-    k rows are zeros, which would otherwise contribute p=exp(-lse) ≠ 0.
+    num_kb = pl.cdiv(seq_len, block_k)
+    if causal:
+        num_kb = jnp.minimum(num_kb, pl.cdiv((iq + 1) * block_q, block_k))
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            rows = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        if valid_len < seq_len:
+            s = jnp.where(cols < valid_len, s, NEG_INF)
+        p = jnp.exp(s - lse)                                   # [BQ, BK]
+        dp = jax.lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
+    dq_ref[0] = jax.lax.fori_loop(0, num_kb, body, dq0)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, g_ref,
+                    dk_ref, dv_ref, *, scale: float, causal: bool,
+                    block_q: int, seq_len: int, valid_len: int):
+    """One (batch·head, k-block) program: dk/dv via recompute over q
+    blocks.  Padded q rows contribute nothing (their g and delta are
+    zero); padded k columns are masked like the forward."""
+    block_k = k_ref.shape[1]
+    head_dim = k_ref.shape[2]
+    ik = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)    # [BK, D]
+    v_blk = v_ref[0].astype(jnp.float32)    # [BK, D]
+
+    num_qb = pl.cdiv(seq_len, block_q)
+    qb0 = (ik * block_k) // block_q if causal else 0
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        g = g_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]     # [BQ, 1]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        if causal:
+            rows = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        if valid_len < seq_len:
+            s = jnp.where(cols < valid_len, s, NEG_INF)
+        p = jnp.exp(s - lse)                                   # [BQ, BK]
+        dv = dv + jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                # [BK, D]
+        dp = jax.lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    zeros = jnp.zeros((block_k, head_dim), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qb0, num_qb, body, (zeros, zeros))
+    dk_ref[0] = dk
+    dv_ref[0] = dv
+
+
+def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
+               interpret, valid_len):
+    """Flash backward as two Pallas kernels (dq over q blocks; dk/dv over
+    k blocks), recomputing probabilities from the saved logsumexp.
+
+    All inputs [BH, L_pad, D] (lse [BH, L_pad]); returns (dq, dk, dv) in
+    fp32.  The recompute re-applies the valid-length mask: padded k rows
+    are zeros, which would otherwise contribute p = exp(-lse) ≠ 0.
     """
     bh, seq_len, head_dim = q.shape
-    num_kb = seq_len // block_k
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
     gf = g.astype(jnp.float32)
-    of = out.astype(jnp.float32)
-    delta = jnp.sum(gf * of, axis=-1)  # [BH, L]
-    rows = jnp.arange(seq_len)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1,
+                    keepdims=True)                          # [BH, L, 1]
+    lse3 = lse[..., None]                                   # [BH, L, 1]
 
-    def body(dq, kb):
-        k_blk = jax.lax.dynamic_slice_in_dim(kf, kb * block_k, block_k, 1)
-        v_blk = jax.lax.dynamic_slice_in_dim(vf, kb * block_k, block_k, 1)
-        s = jnp.einsum("bld,bkd->blk", qf, k_blk) * scale
-        p = jnp.exp(s - lse[..., None])  # [BH, L, BK]
-        cols = kb * block_k + jnp.arange(block_k)
-        if causal:
-            p = jnp.where(rows[:, None] >= cols[None, :], p, 0.0)
-        if valid_len < seq_len:
-            p = jnp.where(cols[None, :] < valid_len, p, 0.0)
-        dv_blk = jnp.einsum("blk,bld->bkd", p, gf)
-        dp = jnp.einsum("bld,bkd->blk", gf, v_blk)
-        ds = p * (dp - delta[..., None]) * scale
-        dq = dq + jnp.einsum("blk,bkd->bld", ds, k_blk)
-        dk_blk = jnp.einsum("blk,bld->bkd", ds, qf)
-        return dq, (dk_blk, dv_blk)
+    full = lambda bh_, i: (bh_, 0, 0)
+    qblk = lambda bh_, i: (bh_, i, 0)
 
-    dq0 = jnp.zeros_like(qf)
-    dq, (dk_blocks, dv_blocks) = jax.lax.scan(body, dq0, jnp.arange(num_kb))
-    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(bh, seq_len, head_dim)
-    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(bh, seq_len, head_dim)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_len=seq_len,
+                          valid_len=valid_len),
+        grid=(bh, seq_len // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), qblk),      # q
+            pl.BlockSpec((1, seq_len, head_dim), full),      # k
+            pl.BlockSpec((1, seq_len, head_dim), full),      # v
+            pl.BlockSpec((1, block_q, 1), qblk),             # lse
+            pl.BlockSpec((1, block_q, 1), qblk),             # delta
+            pl.BlockSpec((1, block_q, head_dim), qblk),      # g
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), qblk),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_len, head_dim),
+                                       jnp.float32),
+        interpret=interpret,
+    )(q, k, v, lse3, delta, g)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, seq_len=seq_len,
+                          valid_len=valid_len),
+        grid=(bh, seq_len // block_k),
+        in_specs=[
+            pl.BlockSpec((1, seq_len, head_dim), full),      # q
+            pl.BlockSpec((1, block_k, head_dim), qblk),      # k
+            pl.BlockSpec((1, block_k, head_dim), qblk),      # v
+            pl.BlockSpec((1, seq_len, 1), full),             # lse
+            pl.BlockSpec((1, seq_len, 1), full),             # delta
+            pl.BlockSpec((1, seq_len, head_dim), full),      # g
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, head_dim), qblk),
+            pl.BlockSpec((1, block_k, head_dim), qblk),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_len, head_dim), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq_len, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, lse3, delta, g)
     return dq, dk, dv
 
 
@@ -185,8 +296,8 @@ def _flash_bhld_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
 def _flash_bhld_bwd(scale, causal, block_q, block_k, interpret, valid_len,
                     res, g):
     q, k, v, out, lse = res
-    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, scale, causal, block_k,
-                            valid_len)
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q,
+                            block_k, interpret, valid_len)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

@@ -39,7 +39,6 @@ class DistributedRunner:
         self.trainable = trainable
         self.lowered = lowered
         self.mesh = lowered.mesh
-        self._batch_sharding = NamedSharding(self.mesh, lowered.batch_spec)
         self.state = lowered.init_state(trainable=trainable)
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._step_times: list[float] = []
