@@ -10,13 +10,16 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
-from autodist_tpu.simulator.cost_model import CostModel, StrategyCost
+from autodist_tpu.simulator.cost_model import (CostModel, SpecMeshMismatch,
+                                               StrategyCost)
 from autodist_tpu.strategy import builders as _builders
 from autodist_tpu.strategy.base import StrategyBuilder
 from autodist_tpu.utils import logging
 
 
 def default_candidates() -> list[StrategyBuilder]:
+    from autodist_tpu.strategy import gspmd_builders
+
     return [
         _builders.AllReduce(),
         _builders.AllReduce(compressor="bf16"),
@@ -24,6 +27,11 @@ def default_candidates() -> list[StrategyBuilder]:
         _builders.PartitionedPS(),
         _builders.Parallax(),
         _builders.ZeRO(),
+        # GSPMD family: FSDP everywhere; TP scores only when the topology
+        # has a model axis (otherwise its spec is rejected by the cost
+        # model and the candidate is skipped).
+        gspmd_builders.FSDPSharded(),
+        gspmd_builders.TensorParallel(),
     ]
 
 
@@ -54,7 +62,11 @@ class AutoStrategy(StrategyBuilder):
             except ValueError as e:
                 logging.debug("candidate %s skipped: %s", name, e)
                 continue
-            cost = model.strategy_cost(trainable, strategy)
+            try:
+                cost = model.strategy_cost(trainable, strategy)
+            except SpecMeshMismatch as e:
+                logging.debug("candidate %s skipped: %s", name, e)
+                continue
             scored.append((name, cost, strategy))
         if not scored:
             raise ValueError("no AutoStrategy candidate produced a strategy")

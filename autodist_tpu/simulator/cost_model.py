@@ -37,6 +37,12 @@ COMPRESSOR_FACTOR = {
 }
 
 
+class SpecMeshMismatch(ValueError):
+    """A GSPMD sharding spec names a mesh axis the topology lacks —
+    the candidate is invalid for this resource spec (AutoStrategy skips
+    it), as opposed to a genuine cost-model error."""
+
+
 @dataclasses.dataclass
 class StrategyCost:
     """Breakdown for one (trainable, strategy, topology) triple."""
@@ -71,8 +77,87 @@ class CostModel:
         self.opt_state_multiplier = opt_state_multiplier
         self.hbm_headroom = hbm_headroom
 
+    @staticmethod
+    def _gspmd_shards(node, mesh) -> tuple[int, bool]:
+        """(device count the node's spec shards one variable over, whether
+        the data axis is among its sharding axes); raises
+        :class:`SpecMeshMismatch` when the spec names an axis the
+        topology lacks."""
+        from autodist_tpu import const
+
+        part = node.partitioner
+        shards, uses_data = 1, False
+        spec = part.spec if part is not None and part.spec is not None \
+            else None
+        if spec is None:
+            if part is not None and part.num_shards > 1:
+                shards = part.num_shards
+            return shards, uses_data
+        for axis in spec:
+            for a in (axis if isinstance(axis, (list, tuple)) else [axis]):
+                if a is None:
+                    continue
+                if a not in mesh:
+                    raise SpecMeshMismatch(
+                        f"{node.var_name}: spec names mesh axis {a!r} "
+                        f"absent from topology {mesh}")
+                shards *= mesh[a]
+                uses_data |= a == const.DATA_AXIS
+        return shards, uses_data
+
+    def _gspmd_cost(self, trainable, strategy) -> StrategyCost:
+        """Pricing for gspmd-lowered strategies.
+
+        * data-axis-sharded (FSDP layout): state at 1/shards; per step the
+          grads reduce-scatter and the params all-gather over the data
+          axis — ring-equivalent *full* tensor volume, same as the
+          collective path's sharded branch.
+        * model-axis-sharded (TP): each device permanently owns its
+          slice; only the slice's gradient syncs over the data axis.
+          Activation collectives on the model axis depend on batch shape
+          the cost model cannot see — they appear in the per-collective
+          latency term only (documented limitation).
+        * replicated: the DP grad allreduce.
+        """
+        mesh = self.spec.resolved_mesh_shape()
+        n = max(strategy.graph_config.replicas, 1)
+        infos = {v.name: v for v in trainable.var_infos()}
+        ring = 2.0 * (n - 1) / n if n > 1 else 0.0
+        total_devices = 1
+        for v in mesh.values():
+            total_devices *= v
+        comm_bytes = mem_bytes = 0.0
+        num_collectives = 0
+        for node in strategy.node_configs:
+            info = infos.get(node.var_name)
+            if info is None:
+                continue
+            bytes_ = float(info.byte_size)
+            shards, uses_data = self._gspmd_shards(node, mesh)
+            if shards > 1:
+                mem_bytes += bytes_ * (2.0 + self.opt_state_multiplier) \
+                    / shards
+                comm_bytes += ring * (bytes_ if uses_data
+                                      else bytes_ / shards)
+                num_collectives += 2
+            else:
+                mem_bytes += bytes_ * (2.0 + self.opt_state_multiplier)
+                comm_bytes += ring * bytes_
+                num_collectives += 1
+        bw = self.chip.ici_gbps * 1e9
+        comm_time = comm_bytes / bw \
+            + COLLECTIVE_ALPHA * num_collectives * (1 if total_devices > 1
+                                                    else 0)
+        hbm = self.chip.hbm_gb * 1e9 * self.hbm_headroom
+        return StrategyCost(comm_bytes=comm_bytes, comm_time_s=comm_time,
+                            num_collectives=num_collectives,
+                            mem_bytes_per_device=mem_bytes,
+                            feasible=mem_bytes <= hbm)
+
     def strategy_cost(self, trainable: Trainable,
                       strategy: Strategy) -> StrategyCost:
+        if strategy.graph_config.lowering == "gspmd":
+            return self._gspmd_cost(trainable, strategy)
         n = max(strategy.graph_config.replicas, 1)
         infos = {v.name: v for v in trainable.var_infos()}
         ring = 2.0 * (n - 1) / n if n > 1 else 0.0

@@ -115,3 +115,58 @@ def test_create_by_name():
     assert isinstance(builders.create("TensorParallel"), TensorParallel)
     with pytest.raises(ValueError, match="unknown strategy builder"):
         builders.create("Bogus")
+
+
+def make_tp_shaped_trainable(dim=256):
+    """Variable names matching the megatron TP rules."""
+    params = {"mlp": {"wi": {"kernel": jnp.zeros((dim, 4 * dim))},
+                      "wo": {"kernel": jnp.zeros((4 * dim, dim))}}}
+
+    def loss_fn(p, batch):
+        h = jax.nn.relu(batch["x"] @ p["mlp"]["wi"]["kernel"])
+        return jnp.mean((h @ p["mlp"]["wo"]["kernel"]) ** 2)
+
+    return Trainable.from_loss_fn(loss_fn, params, optax.adam(1e-3))
+
+
+def test_auto_strategy_includes_gspmd_candidates(rs):
+    """FSDPSharded is scored everywhere; TensorParallel's model-axis
+    specs are rejected (candidate skipped) when the topology lacks a
+    model axis, and scored when it has one."""
+    trainable = make_tp_shaped_trainable()
+    auto = AutoStrategy()
+    auto.build(trainable, rs)
+    names = [n for n, _ in auto.report]
+    assert "FSDPSharded" in names
+    assert "TensorParallel" not in names  # no model axis in topology
+
+    rs2 = ResourceSpec({"topology": {"num_devices": 8, "generation": "v4"},
+                        "mesh": {"data": 4, "model": 2}})
+    auto2 = AutoStrategy()
+    auto2.build(trainable, rs2)
+    names2 = [n for n, _ in auto2.report]
+    assert "TensorParallel" in names2
+
+
+def test_gspmd_fsdp_memory_beats_replicated(rs):
+    from autodist_tpu.strategy.gspmd_builders import FSDPSharded
+
+    trainable = make_dense_trainable(dim=512)
+    cm = CostModel(rs)
+    c_fsdp = cm.strategy_cost(
+        trainable, FSDPSharded(min_size=1).build(trainable, rs))
+    c_ar = cost_for(AllReduce(), trainable, rs)
+    assert c_fsdp.mem_bytes_per_device < c_ar.mem_bytes_per_device
+
+
+def test_auto_strategy_gspmd_pick_trains():
+    """When a GSPMD candidate wins, the facade must lower and run it."""
+    from autodist_tpu.strategy.gspmd_builders import FSDPSharded
+
+    trainable = make_dense_trainable(dim=64)
+    auto = AutoStrategy(candidates=[FSDPSharded(min_size=1)])
+    runner = AutoDist({}, auto).build(trainable)
+    rng = np.random.RandomState(0)
+    batch = {"x": rng.randn(16, 64).astype(np.float32)}
+    m = runner.step(batch)
+    assert np.isfinite(float(np.asarray(m["loss"])))
