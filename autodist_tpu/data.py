@@ -22,7 +22,6 @@ Usage::
 """
 from __future__ import annotations
 
-import collections
 import queue
 import threading
 from typing import Any, Callable, Iterable, Iterator, Optional
@@ -31,7 +30,6 @@ import jax
 import numpy as np
 
 from autodist_tpu import const
-from autodist_tpu.utils import logging
 
 
 def shard_batch(batch, *, process_index: Optional[int] = None,
@@ -86,10 +84,10 @@ class DataLoader:
                 yield self._source(i)
                 i += 1
         else:
-            for i, b in enumerate(self._source):
-                if self.num_batches is not None and i >= self.num_batches:
-                    break
-                yield b
+            import itertools
+            src = self._source if self.num_batches is None \
+                else itertools.islice(self._source, self.num_batches)
+            yield from src
 
     def _place(self, batch):
         from jax.sharding import PartitionSpec as P

@@ -85,8 +85,13 @@ class DistributedRunner:
                                            self.lowered.batch_spec)
 
         def place(x, sharding):
-            if isinstance(x, jax.Array) and not x.is_fully_addressable:
-                return x  # already a global array (multi-host path)
+            if isinstance(x, jax.Array):
+                if not x.is_fully_addressable:
+                    return x  # already a global array (multi-host path)
+                # Already on device (e.g. a prefetching DataLoader):
+                # device_put is a no-op when the sharding matches and an
+                # on-device reshard otherwise — never a host round-trip.
+                return jax.device_put(x, sharding)
             x = np.asarray(x)
             n = self.mesh.shape[const.DATA_AXIS]
             if x.ndim > 0 and x.shape[0] % n:

@@ -128,3 +128,21 @@ def mfu(examples_per_sec: float, flops_per_example: float,
 def transformer_train_flops_per_token(num_params: int) -> float:
     """6N approximation: fwd 2N + bwd 4N FLOPs per token."""
     return 6.0 * num_params
+
+
+def memory_summary(device=None) -> dict:
+    """Per-device HBM usage snapshot (bytes), where the backend exposes
+    it (TPU does; CPU returns {}).  The observability analog of the
+    reference's trace/metadata collection (``runner.py:64-75``) for the
+    memory axis — pair with the cost model's mem_bytes_per_device to
+    validate a strategy's predicted footprint."""
+    import jax
+
+    device = device or jax.local_devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if not stats:
+        return {}
+    out = {k: int(v) for k, v in stats.items() if isinstance(v, (int,))}
+    if "bytes_in_use" in out and "bytes_limit" in out and out["bytes_limit"]:
+        out["utilization"] = out["bytes_in_use"] / out["bytes_limit"]
+    return out

@@ -9,11 +9,41 @@ devices are visible (the driver runs this on real TPU hardware; on a CPU
 dev machine it shrinks the model so the bench stays fast).
 """
 import json
+import signal
+import sys
 import time
 
 import jax
 import numpy as np
 import optax
+
+
+class _Watchdog:
+    """Emit a diagnostic JSON line instead of dying silently if the
+    accelerator backend hangs (tunnelled TPU plugins can stall on init)."""
+
+    def __init__(self, seconds: int, stage: str):
+        self.seconds = seconds
+        self.stage = stage
+
+    def _fire(self, *_):
+        print(json.dumps({
+            "metric": "bert_base_mlm_mfu", "value": 0.0, "unit": "mfu",
+            "vs_baseline": 0.0,
+            "error": f"watchdog: {self.stage} exceeded {self.seconds}s "
+                     "(accelerator backend unresponsive)"}))
+        sys.stdout.flush()
+        sys.exit(3)
+
+    def __enter__(self):
+        if hasattr(signal, "SIGALRM"):
+            signal.signal(signal.SIGALRM, self._fire)
+            signal.alarm(self.seconds)
+        return self
+
+    def __exit__(self, *exc):
+        if hasattr(signal, "SIGALRM"):
+            signal.alarm(0)
 
 
 def mlm_model_flops_per_example(cfg, seq_len: int, num_masked: int) -> float:
@@ -35,7 +65,8 @@ def main():
     from autodist_tpu.resource import ResourceSpec
     from autodist_tpu.utils import profiling
 
-    on_accel = jax.default_backend() != "cpu"
+    with _Watchdog(300, "backend init"):
+        on_accel = jax.default_backend() != "cpu"
     # Measured on v5e (seq 512): plain einsum attention beats the Pallas
     # flash kernel (whose win starts at longer sequences), and synthetic
     # MLM batches are unpadded, so the padding mask — a full [B, H, L, L]

@@ -72,19 +72,33 @@ def run_benchmark(runner, make_batch: Callable[[int], dict], *,
                   peak_flops: Optional[float] = None) -> dict:
     """Timed training loop with windowed examples/sec reports
     (≙ ``TimeHistory``: examples/sec = batch_size × log_steps / elapsed,
-    reference ``examples/benchmark/imagenet.py:84-140``)."""
-    import jax
+    reference ``examples/benchmark/imagenet.py:84-140``).
 
+    Batches ride the prefetching :class:`~autodist_tpu.data.DataLoader`
+    (host→HBM transfer overlaps compute) and each timed step is fenced by
+    fetching a metric scalar to the host — proxied/async backends may
+    return from ``block_until_ready`` before execution finishes."""
+    from autodist_tpu.data import DataLoader
+
+    def fence(metrics):
+        return float(np.asarray(next(iter(metrics.values()))))
+
+    loader = iter(DataLoader(make_batch, runner.mesh, buffer_size=2,
+                             num_batches=warmup_steps + train_steps))
     for step in range(warmup_steps):
-        runner.step(make_batch(step))
-    jax.block_until_ready(runner.state)
+        runner.step(next(loader))
+    # Fence the *state*, not just metrics: the donated-state update can
+    # outlive the metrics buffers and must not bleed into the timed window.
+    state = getattr(runner, "state", None)
+    if state is not None:
+        float(np.asarray(state["step"]))
 
     times = []
     window_start = time.perf_counter()
     for step in range(train_steps):
         t0 = time.perf_counter()
-        metrics = runner.step(make_batch(warmup_steps + step))
-        jax.block_until_ready(metrics)
+        metrics = runner.step(next(loader))
+        fence(metrics)
         times.append(time.perf_counter() - t0)
         if (step + 1) % log_steps == 0:
             elapsed = time.perf_counter() - window_start
