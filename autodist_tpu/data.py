@@ -92,11 +92,14 @@ class DataLoader:
     def _place(self, batch):
         from jax.sharding import PartitionSpec as P
         from autodist_tpu.kernel import common
+        from autodist_tpu.kernel.lowering import replica_axes
 
         if self.global_batches:
             batch = shard_batch(batch)
-        shardings = common.batch_shardings(batch, self.mesh,
-                                           P(const.DATA_AXIS))
+        # Split over the full replica group — ('dcn', 'data') on
+        # multi-slice meshes, matching the lowered batch_spec.
+        spec = P(common.axes_entry(replica_axes(self.mesh)))
+        shardings = common.batch_shardings(batch, self.mesh, spec)
         if jax.process_count() > 1:
             return jax.tree.map(
                 lambda x, s: jax.make_array_from_process_local_data(

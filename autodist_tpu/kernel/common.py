@@ -40,8 +40,18 @@ def padded_shape(shape: tuple[int, ...], axis: int, n: int) -> tuple[int, ...]:
 
 # --------------------------------------------------------------------------- #
 # Inside-shard_map collectives (the synchronizer primitive vocabulary:
-# ≙ reference CollectiveReduce/Gather/accumulator ops, SURVEY.md §2.9)
+# ≙ reference CollectiveReduce/Gather/accumulator ops, SURVEY.md §2.9).
+# Every helper's ``axis_name`` may be a single mesh axis or a tuple of
+# axes (multi-slice: ('dcn', 'data') — outer axis over DCN, inner over
+# ICI; XLA lowers the combined collective hierarchically).
 # --------------------------------------------------------------------------- #
+def axes_entry(axes: tuple):
+    """Replica axes as a PartitionSpec entry / collective axis name: the
+    bare axis for a single-axis group (so user-visible specs stay
+    ``P('data')``), the tuple for multi-axis groups."""
+    return axes if len(axes) > 1 else axes[0]
+
+
 def reduce_scatter_flat(x, axis_name: str, n: int, mean: bool = True):
     """Flatten, pad, and reduce-scatter: each device receives the summed
     (or averaged) 1/n flat chunk.  ≙ the PS conditional accumulator —
@@ -67,7 +77,7 @@ def local_flat_shard(x, axis_name: str, n: int):
     flat = x.reshape(-1)
     flat = pad_axis_to(flat, 0, padded_flat_size(flat.size, n))
     k = flat.size // n
-    i = lax.axis_index(axis_name)
+    i = lax.axis_index(axis_name)  # tuple-capable (first-axis major)
     return lax.dynamic_slice_in_dim(flat, i * k, k, axis=0)
 
 

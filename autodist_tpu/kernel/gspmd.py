@@ -143,7 +143,8 @@ def lower_gspmd(trainable: Trainable, strategy: Strategy, mesh) -> GspmdLowered:
     state_shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), state_specs,
         is_leaf=lambda x: isinstance(x, P))
-    batch_spec = P(const.DATA_AXIS)
+    from autodist_tpu.kernel.lowering import replica_axes
+    batch_spec = P(common.axes_entry(replica_axes(mesh)))
 
 
     def _init(params, extra):

@@ -69,13 +69,20 @@ class VarPlan:
     compressor: str = "none"
     sparse_lookup: bool = False   # vocab-sharded: feed the loss a
                                   # ShardedEmbedding (touched-rows sync)
+    # Replica axes the plan shards over: ('data',), or ('dcn', 'data') on
+    # multi-slice meshes (outer axis rides DCN, inner rides ICI).
+    shard_axes: tuple = (const.DATA_AXIS,)
+
+    @property
+    def _axes_entry(self):
+        return common.axes_entry(self.shard_axes)
 
     @property
     def param_spec(self) -> P:
         if not self.stored_sharded:
             return P()
         spec = [None] * len(self.shape)
-        spec[self.split_axis] = const.DATA_AXIS
+        spec[self.split_axis] = self._axes_entry
         return P(*spec)
 
     def stored_shape(self, n: int) -> tuple[int, ...]:
@@ -87,9 +94,9 @@ class VarPlan:
         if self.update == U_REPLICATED:
             return P()
         if self.update == U_FLAT:
-            return P(const.DATA_AXIS)
+            return P(self._axes_entry)
         spec = [None] * len(self.shape)
-        spec[self.split_axis] = const.DATA_AXIS
+        spec[self.split_axis] = self._axes_entry
         return P(*spec)
 
     def update_shape(self, n: int) -> tuple[int, ...]:
@@ -110,17 +117,37 @@ class Plan:
     bucket_compressor: dict[str, str]      # bucket key -> compressor name
     ssp_staleness: int = 0                 # max PSSynchronizer.staleness:
                                            # the runner's host-side SSP gate
+    repl_axes: tuple = (const.DATA_AXIS,)  # ('dcn', 'data') on multi-slice
+
+    @property
+    def axes_entry(self):
+        """The replica axes as a PartitionSpec entry / collective
+        axis_name (see :func:`common.axes_entry`)."""
+        return common.axes_entry(self.repl_axes)
+
+
+def replica_axes(mesh) -> tuple:
+    """The data-parallel replica axes of a mesh: ('dcn', 'data') when a
+    DCN (cross-slice) axis exists, else ('data',).  Outer-major order
+    matches tiled collective layout."""
+    axes = tuple(a for a in (const.DCN_AXIS, const.DATA_AXIS)
+                 if a in mesh.shape)
+    if const.DATA_AXIS not in axes:
+        raise ValueError(
+            f"mesh {dict(mesh.shape)} has no '{const.DATA_AXIS}' axis")
+    return axes
 
 
 def make_plan(trainable: Trainable, strategy: Strategy, mesh) -> Plan:
     """Resolve a Strategy against a mesh (≙ StrategyCompiler.compile:
     device resolution + node pruning, reference ``strategy/base.py:120-168``).
     """
-    n = mesh.shape[const.DATA_AXIS]
+    repl = replica_axes(mesh)
+    n = math.prod(mesh.shape[a] for a in repl)
     if strategy.graph_config.replicas not in (0, n):
         raise ValueError(
             f"strategy built for {strategy.graph_config.replicas} replicas; "
-            f"mesh data axis has {n}")
+            f"mesh replica axes {repl} have {n}")
     var_plans: dict[str, VarPlan] = {}
     buckets: dict[str, list[str]] = {}
     bucket_comp: dict[str, str] = {}
@@ -162,28 +189,29 @@ def make_plan(trainable: Trainable, strategy: Strategy, mesh) -> Plan:
                                stored_sharded=True, split_axis=split_axis,
                                update=U_AXIS, bucket=None,
                                sparse_lookup=bool(node.is_sparse)
-                               and split_axis == 0)
+                               and split_axis == 0, shard_axes=repl)
             else:
                 plan = VarPlan(info.name, info.shape, info.dtype,
                                stored_sharded=False, split_axis=-1,
-                               update=U_FLAT, bucket=None)
+                               update=U_FLAT, bucket=None, shard_axes=repl)
         else:  # AllReduce
             if split_axis >= 0 and info.shape:
                 plan = VarPlan(info.name, info.shape, info.dtype,
                                stored_sharded=False, split_axis=split_axis,
                                update=U_AXIS, bucket=None,
-                               compressor=sync.compressor)
+                               compressor=sync.compressor, shard_axes=repl)
             else:
                 key = f"g{sync.group}:{sync.compressor}"
                 plan = VarPlan(info.name, info.shape, info.dtype,
                                stored_sharded=False, split_axis=-1,
                                update=U_REPLICATED, bucket=key,
-                               compressor=sync.compressor)
+                               compressor=sync.compressor, shard_axes=repl)
                 buckets.setdefault(key, []).append(info.name)
                 bucket_comp[key] = sync.compressor
         var_plans[info.name] = plan
     return Plan(var_plans=var_plans, num_replicas=n, buckets=buckets,
-                bucket_compressor=bucket_comp, ssp_staleness=ssp_staleness)
+                bucket_compressor=bucket_comp, ssp_staleness=ssp_staleness,
+                repl_axes=repl)
 
 
 # --------------------------------------------------------------------------- #
@@ -329,7 +357,7 @@ def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
     """Build the SPMD program for (trainable, strategy, mesh)."""
     plan = make_plan(trainable, strategy, mesh)
     n = plan.num_replicas
-    data_axis = const.DATA_AXIS
+    data_axis = plan.axes_entry  # 'data', or ('dcn', 'data') multi-slice
     opt = trainable.optimizer
 
     p_specs = _params_specs(plan, trainable.params)

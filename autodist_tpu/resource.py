@@ -83,6 +83,8 @@ class ResourceSpec:
         self.platform: str = topo.get("platform", "auto")
         self.generation: str = topo.get("generation", "auto")
         self._requested_devices: Optional[int] = topo.get("num_devices")
+        # Multi-slice pods: the outer replica axis rides DCN.
+        self.num_slices: int = int(topo.get("num_slices", 1))
         self.mesh_shape: dict[str, int] = dict(spec.get("mesh") or {})
         mh = dict(spec.get("multihost") or {})
         self.coordinator: str = mh.get(
@@ -134,11 +136,20 @@ class ResourceSpec:
         return len(self.devices())
 
     def resolved_mesh_shape(self) -> dict[str, int]:
-        """Mesh shape with defaults filled: unspecified → pure data axis."""
+        """Mesh shape with defaults filled: unspecified → pure data axis
+        (split as ``dcn × data`` when the topology declares slices)."""
         n = self.num_devices()
         shape = dict(self.mesh_shape)
         if not shape:
-            shape = {const.DATA_AXIS: n}
+            if self.num_slices > 1:
+                if n % self.num_slices:
+                    raise ValueError(
+                        f"{n} devices do not divide into "
+                        f"{self.num_slices} slices")
+                shape = {const.DCN_AXIS: self.num_slices,
+                         const.DATA_AXIS: n // self.num_slices}
+            else:
+                shape = {const.DATA_AXIS: n}
         known = math.prod(v for v in shape.values() if v != -1)
         wildcards = [k for k, v in shape.items() if v == -1]
         if wildcards:
@@ -155,11 +166,29 @@ class ResourceSpec:
 
     def make_mesh(self):
         """Build the named device mesh (the resolution step ≙ reference
-        ``DeviceResolver.resolve_to_device_str``, ``resolver.py:47-67``)."""
+        ``DeviceResolver.resolve_to_device_str``, ``resolver.py:47-67``).
+
+        With a ``dcn`` axis on real multi-slice hardware the mesh comes
+        from ``mesh_utils.create_hybrid_device_mesh`` so the dcn axis
+        provably falls on slice boundaries (a naive reshape could put the
+        high-volume data-axis collectives on the slow DCN links);
+        simulated/CPU devices carry no slice topology and keep the
+        deterministic reshape."""
         import jax
         shape = self.resolved_mesh_shape()
-        devs = np.array(self.devices()).reshape(tuple(shape.values()))
-        return jax.sharding.Mesh(devs, tuple(shape.keys()))
+        devs = self.devices()
+        if const.DCN_AXIS in shape and getattr(
+                devs[0], "slice_index", None) is not None:
+            from jax.experimental import mesh_utils
+            axes = list(shape.keys())
+            per_slice = [1 if a == const.DCN_AXIS else shape[a]
+                         for a in axes]
+            across = [shape[a] if a == const.DCN_AXIS else 1 for a in axes]
+            arr = mesh_utils.create_hybrid_device_mesh(
+                per_slice, across, devices=list(devs))
+            return jax.sharding.Mesh(arr, tuple(axes))
+        arr = np.array(devs).reshape(tuple(shape.values()))
+        return jax.sharding.Mesh(arr, tuple(shape.keys()))
 
     def bootstrap(self):
         """Multi-host initialization (counterpart of the reference's

@@ -93,11 +93,15 @@ class DistributedRunner:
                 # on-device reshard otherwise — never a host round-trip.
                 return jax.device_put(x, sharding)
             x = np.asarray(x)
-            n = self.mesh.shape[const.DATA_AXIS]
+            entry = self.lowered.batch_spec[0]
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            n = 1
+            for a in axes:
+                n *= self.mesh.shape[a]
             if x.ndim > 0 and x.shape[0] % n:
                 raise ValueError(
                     f"batch leading dim {x.shape} must be divisible by the "
-                    f"data-axis size {n}")
+                    f"replica count {n} (axes {axes})")
             return jax.device_put(x, sharding)
 
         return jax.tree.map(place, batch, shardings)

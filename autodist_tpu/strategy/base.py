@@ -24,12 +24,14 @@ class StrategyBuilder(abc.ABC):
 
     @staticmethod
     def num_replicas(resource_spec: ResourceSpec) -> int:
+        """Data-parallel replica count: the data axis times the DCN
+        (cross-slice) axis on multi-slice topologies."""
         shape = resource_spec.resolved_mesh_shape()
-        return shape.get(const.DATA_AXIS, 1)
+        return shape.get(const.DATA_AXIS, 1) * shape.get(const.DCN_AXIS, 1)
 
     def _graph_config(self, resource_spec: ResourceSpec) -> GraphConfig:
         shape = resource_spec.resolved_mesh_shape()
-        return GraphConfig(replicas=shape.get(const.DATA_AXIS, 1),
+        return GraphConfig(replicas=self.num_replicas(resource_spec),
                            mesh_axes=dict(shape))
 
 

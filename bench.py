@@ -119,7 +119,7 @@ def main():
     flops_per_example = mlm_model_flops_per_example(cfg, seq_len, num_masked)
     peak = rs.chip.peak_bf16_tflops * 1e12 * n
     mfu = profiling.mfu(examples_per_sec, flops_per_example, peak)
-    print(json.dumps({
+    record = {
         "metric": "bert_base_mlm_mfu",
         "value": round(mfu, 4),
         "unit": "mfu",
@@ -128,7 +128,11 @@ def main():
         "step_ms": round(dt / steps * 1e3, 2),
         "devices": n,
         "chip": rs.chip.name,
-    }))
+    }
+    mem = profiling.memory_summary()
+    if mem.get("bytes_in_use"):
+        record["hbm_gb_in_use"] = round(mem["bytes_in_use"] / 1e9, 2)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,69 @@
+"""Multi-slice (DCN) mesh tests: hierarchical data parallelism.
+
+The reference scaled over a flat NCCL ring; multi-slice TPU pods add an
+outer replica axis over DCN (SURVEY.md §5.8 "multi-slice → DCN
+collectives").  Every strategy must produce identical numerics over a
+``dcn × data`` mesh — the collectives just span both axes and XLA lowers
+them hierarchically.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import (AllReduce, AutoDist, Parallax, PartitionedPS, PS,
+                          Trainable)
+from autodist_tpu.resource import ResourceSpec
+
+from tests.unit.test_end_to_end import (make_batch, make_trainable,
+                                        single_device_reference)
+
+SPEC = {"topology": {"num_devices": 8}, "mesh": {"dcn": 2, "data": 4}}
+
+
+@pytest.mark.parametrize("builder", [AllReduce, PS, PartitionedPS],
+                         ids=["AllReduce", "PS-ZeRO1", "PartitionedPS"])
+def test_multislice_matches_single_device(builder):
+    batches = [make_batch(s) for s in range(3)]
+    expected = single_device_reference(make_trainable(), batches)
+    runner = AutoDist(SPEC, builder()).build(make_trainable())
+    assert runner.lowered.plan.repl_axes == ("dcn", "data")
+    assert runner.lowered.plan.num_replicas == 8
+    for b in batches:
+        runner.step(b)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), rtol=2e-6, atol=2e-6),
+        runner.get_params(), jax.device_get(expected))
+
+
+def test_multislice_sparse_embedding():
+    """Vocab-sharded embedding over dcn x data: touched-rows path spans
+    both axes."""
+    from tests.unit.test_sparse import (make_batch as sp_batch,
+                                        make_trainable as sp_trainable,
+                                        single_device_reference as sp_ref)
+
+    trainable = sp_trainable(optax.adam(1e-2))
+    runner = AutoDist(SPEC, Parallax()).build(trainable)
+    assert runner.lowered.plan.var_plans["embedding"].sparse_lookup
+    batches = [sp_batch(s) for s in range(2)]
+    for b in batches:
+        runner.step(b)
+    got = runner.get_params()
+    want = sp_ref(sp_trainable(optax.adam(1e-2)), batches)
+    np.testing.assert_allclose(np.asarray(got["embedding"]),
+                               np.asarray(want["embedding"]),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_num_slices_topology_shorthand():
+    rs = ResourceSpec({"topology": {"num_devices": 8, "num_slices": 2}})
+    assert rs.resolved_mesh_shape() == {"dcn": 2, "data": 4}
+    runner = AutoDist(rs, AllReduce()).build(make_trainable())
+    m = runner.step(make_batch(0))
+    assert np.isfinite(float(np.asarray(m["loss"])))
+    with pytest.raises(ValueError, match="slices"):
+        ResourceSpec({"topology": {"num_devices": 8, "num_slices": 3}}
+                     ).resolved_mesh_shape()

@@ -116,3 +116,20 @@ def test_eval_step_no_update():
         # evaluate() over several batches
         agg = runner.evaluate([make_batch(s) for s in range(3)])
         assert "loss" in agg and np.isfinite(agg["loss"])
+
+
+def test_memory_summary_shapes():
+    from autodist_tpu.utils import profiling
+
+    # CPU backend exposes no HBM stats -> {}.
+    assert profiling.memory_summary() in ({},) or isinstance(
+        profiling.memory_summary(), dict)
+
+    class FakeDev:
+        def memory_stats(self):
+            return {"bytes_in_use": 500, "bytes_limit": 1000,
+                    "peak_bytes_in_use": 800, "label": "x"}
+
+    out = profiling.memory_summary(FakeDev())
+    assert out["bytes_in_use"] == 500 and out["utilization"] == 0.5
+    assert "label" not in out
