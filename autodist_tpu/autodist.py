@@ -44,11 +44,13 @@ class AutoDist:
         self.resource_spec = resource_spec
         self.strategy_builder = strategy_builder
         self._mesh = None
-        resource_spec.bootstrap()
 
     @property
     def mesh(self):
+        # Bootstrap lazily: async-PS builds never need the global mesh,
+        # so they must not join (and block on) a jax.distributed job.
         if self._mesh is None:
+            self.resource_spec.bootstrap()
             self._mesh = self.resource_spec.make_mesh()
         return self._mesh
 

@@ -113,8 +113,11 @@ class ResourceSpec:
     def devices(self) -> Sequence[Any]:
         """Deterministically ordered global device list (counterpart of the
         reference's sorted node list for cross-worker determinism,
-        ``cluster.py:78-81``)."""
+        ``cluster.py:78-81``).  Touching the live device list in a
+        multihost job requires the distributed backend, so this
+        bootstraps first (idempotent)."""
         import jax
+        self.bootstrap()
         devs = list(jax.devices())
         devs.sort(key=lambda d: d.id)
         if self._requested_devices is not None:
@@ -133,6 +136,14 @@ class ResourceSpec:
         ``resource_spec.py:45-78``).  Falls back to the live device list."""
         if self._requested_devices is not None:
             return self._requested_devices
+        if self.is_multihost and not getattr(self, "_bootstrapped", False):
+            # Counting live devices here would join (and block on) the
+            # jax.distributed job mid-planning — before workers may even
+            # be launched.  Demand an explicit inventory instead.
+            raise ValueError(
+                "multihost planning needs an explicit device inventory: "
+                "declare topology.num_devices (the global count), or "
+                "bootstrap() first")
         return len(self.devices())
 
     def resolved_mesh_shape(self) -> dict[str, int]:
@@ -192,8 +203,13 @@ class ResourceSpec:
 
     def bootstrap(self):
         """Multi-host initialization (counterpart of the reference's
-        cluster start, ``cluster.py:160-210``): connect this process to the
-        coordination service before any mesh use."""
+        cluster start, ``cluster.py:160-210``): connect this process to
+        the coordination service before any mesh use.  Idempotent, and
+        lazy — callers that never touch a global mesh (e.g. the async-PS
+        runner, which trains on a process-local mesh) never join a
+        ``jax.distributed`` job."""
+        if getattr(self, "_bootstrapped", False):
+            return
         if self.is_multihost:
             import jax
             logging.info(
@@ -204,6 +220,9 @@ class ResourceSpec:
                 num_processes=self.num_processes,
                 process_id=self.process_id,
             )
+        # Latch only after success so a transient failure (coordinator not
+        # up yet) can be retried instead of silently running single-host.
+        self._bootstrapped = True
 
     def to_dict(self) -> dict:
         return {
