@@ -14,9 +14,10 @@ from autodist_tpu.autodist import AutoDist
 from autodist_tpu.capture import Trainable, VarInfo
 from autodist_tpu.resource import ResourceSpec
 from autodist_tpu.runner import DistributedRunner
-from autodist_tpu.strategy.builders import (AllReduce, Parallax,
-                                            PartitionedAR, PartitionedPS,
-                                            PS, PSLoadBalancing,
+from autodist_tpu.strategy.builders import (AllReduce, GradAccumulation,
+                                            Parallax, PartitionedAR,
+                                            PartitionedPS, PS,
+                                            PSLoadBalancing,
                                             RandomAxisPartitionAR,
                                             UnevenPartitionedPS, ZeRO)
 from autodist_tpu.strategy.ir import Strategy
@@ -26,5 +27,5 @@ __all__ = [
     "AutoDist", "Trainable", "VarInfo", "ResourceSpec", "DistributedRunner",
     "Strategy", "AllReduce", "PS", "PSLoadBalancing", "PartitionedPS",
     "UnevenPartitionedPS", "PartitionedAR", "RandomAxisPartitionAR",
-    "Parallax", "ZeRO", "AutoStrategy",
+    "Parallax", "ZeRO", "AutoStrategy", "GradAccumulation",
 ]

@@ -107,6 +107,55 @@ def local_axis_shard(x, axis_name: str, n: int, axis: int):
 
 
 # --------------------------------------------------------------------------- #
+# Gradient accumulation: one scan over microbatches, shared by both
+# lowering paths.
+# --------------------------------------------------------------------------- #
+def accumulate_microbatches(micro_fn, params_like, batch, rng, extra,
+                            accum: int):
+    """Scan ``accum`` microbatches; returns (grads, new_extra, metrics).
+
+    ``micro_fn(mb, rng, extra) -> ((loss, (new_extra, metrics)), grads)``
+    — a ``value_and_grad`` over one microbatch.  Batched leaves split
+    into ``accum`` equal slices (error if indivisible); scalars broadcast
+    (duplicate-feed).  Gradients and float metrics average; integer
+    metrics (counts) sum; bool metrics OR — each matching what the
+    equivalent single full batch would report.
+    """
+    def split(x):
+        if jnp.ndim(x) == 0:
+            return jnp.broadcast_to(x, (accum,))
+        if x.shape[0] % accum:
+            raise ValueError(
+                f"per-device batch {x.shape[0]} not divisible by "
+                f"accum_steps={accum}")
+        return x.reshape(accum, x.shape[0] // accum, *x.shape[1:])
+
+    def body(carry, mb_rng):
+        g_acc, extra_c = carry
+        mb, r = mb_rng
+        (_, (new_extra, metrics)), g = micro_fn(mb, r, extra_c)
+        return (jax.tree.map(jnp.add, g_acc, g), new_extra), metrics
+
+    g0 = jax.tree.map(jnp.zeros_like, params_like)
+    (g_sum, new_extra), metric_stack = lax.scan(
+        body, (g0, extra),
+        (jax.tree.map(split, batch), jax.random.split(rng, accum)))
+    grads = jax.tree.map(lambda g: g / accum, g_sum)
+
+    def reduce_metric(m):
+        dt = jnp.result_type(m)
+        if jnp.issubdtype(dt, jnp.inexact):
+            return m.mean(0)
+        if dt == jnp.bool_:
+            return m.any(0)
+        if jnp.issubdtype(dt, jnp.integer):
+            return m.sum(0)
+        return m[-1]
+
+    return grads, new_extra, jax.tree.map(reduce_metric, metric_stack)
+
+
+# --------------------------------------------------------------------------- #
 # Feed contract (reference ``remapper.py:81-123``): leaves with a batch
 # dimension split across the data axis, scalars duplicate to every replica.
 # Single source of truth for every lowering backend and runner.

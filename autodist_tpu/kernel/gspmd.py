@@ -161,14 +161,24 @@ def lower_gspmd(trainable: Trainable, strategy: Strategy, mesh) -> GspmdLowered:
                 x, NamedSharding(mesh, s)),
             tree, specs, is_leaf=lambda x: isinstance(x, P))
 
-    def _step(state, batch, rng):
-        def loss_of(params):
-            loss, new_extra, metrics = trainable.loss(
-                params, state["extra"], batch, rng)
-            return loss, (new_extra, metrics)
+    accum = max(getattr(strategy.graph_config, "accum_steps", 1), 1)
 
-        (loss, (new_extra, metrics)), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(state["params"])
+    def _step(state, batch, rng):
+        def micro(mb, rng_, extra_in):
+            def loss_of(params):
+                loss, new_extra, metrics = trainable.loss(
+                    params, extra_in, mb, rng_)
+                return loss, (new_extra, metrics)
+
+            return jax.value_and_grad(loss_of, has_aux=True)(
+                state["params"])
+
+        if accum == 1:
+            (loss, (new_extra, metrics)), grads = micro(
+                batch, rng, state["extra"])
+        else:
+            grads, new_extra, metrics = common.accumulate_microbatches(
+                micro, state["params"], batch, rng, state["extra"], accum)
         grads = constrain(grads, p_specs)
         updates, new_opt = opt.update(grads, state["opt_state"],
                                       state["params"])

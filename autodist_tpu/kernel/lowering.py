@@ -308,12 +308,15 @@ def _gather_full(plan: Plan, data_axis: str, stored):
 
 
 def _reduce_metrics(tree, data_axis: str):
-    """Cross-replica metric reduction: floats are averaged, integer
-    counts are summed (each is the correct global semantics)."""
+    """Cross-replica metric reduction: floats average, integer counts
+    sum, bool flags OR (each the correct global semantics)."""
     def red(x):
-        if jnp.issubdtype(jnp.result_type(x), jnp.inexact):
+        dt = jnp.result_type(x)
+        if jnp.issubdtype(dt, jnp.inexact):
             return lax.pmean(x, data_axis)
-        if jnp.issubdtype(jnp.result_type(x), jnp.integer):
+        if dt == jnp.bool_:
+            return lax.psum(x.astype(jnp.int32), data_axis) > 0
+        if jnp.issubdtype(dt, jnp.integer):
             return lax.psum(x, data_axis)
         return x
     return jax.tree.map(red, tree)
@@ -402,19 +405,31 @@ def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
 
     init_fn = jax.jit(_init, out_shardings=state_shardings)
 
+    accum = max(getattr(strategy.graph_config, "accum_steps", 1), 1)
+
     # ---------------- train step ------------------------------------------ #
     def _local_step(state, batch, rng):
         params_store = state["params"]
         local_rng = jax.random.fold_in(rng, lax.axis_index(data_axis))
 
-        def stored_loss(stored):
-            loss, new_extra, metrics = trainable.loss(
-                _gather_full(plan, data_axis, stored), state["extra"],
-                batch, local_rng)
-            return loss, (new_extra, metrics)
+        def micro_grads(mb, rng_, extra_in):
+            def stored_loss(stored):
+                loss, new_extra, metrics = trainable.loss(
+                    _gather_full(plan, data_axis, stored), extra_in,
+                    mb, rng_)
+                return loss, (new_extra, metrics)
 
-        grad_fn = jax.value_and_grad(stored_loss, has_aux=True)
-        (loss, (new_extra, metrics)), grads_stored = grad_fn(params_store)
+            return jax.value_and_grad(stored_loss, has_aux=True)(
+                params_store)
+
+        if accum == 1:
+            (loss, (new_extra, metrics)), grads_stored = micro_grads(
+                batch, local_rng, state["extra"])
+        else:
+            grads_stored, new_extra, metrics = \
+                common.accumulate_microbatches(
+                    micro_grads, params_store, batch, local_rng,
+                    state["extra"], accum)
 
         g_by_name = dict(common.flatten_with_names(grads_stored))
         p_by_name = dict(common.flatten_with_names(params_store))

@@ -267,8 +267,28 @@ class Parallax(StrategyBuilder):
 
 
 # ----------------------------------------------------------------------- #
-# TPU-first extensions beyond reference parity: explicit ZeRO staging.
+# TPU-first extensions beyond reference parity.
 # ----------------------------------------------------------------------- #
+class GradAccumulation(StrategyBuilder):
+    """Wrap any builder with gradient accumulation: each step scans
+    ``steps`` microbatches before the one synchronization + optimizer
+    update (global batches beyond device memory; not in the reference —
+    its batch was bounded by what one GPU graph replica held)."""
+
+    def __init__(self, builder: StrategyBuilder, steps: int):
+        if steps < 1:
+            raise ValueError("accumulation steps must be >= 1")
+        if isinstance(builder, str):
+            builder = create(builder)
+        self.builder = builder
+        self.steps = steps
+
+    def build(self, trainable, resource_spec):
+        strategy = self.builder.build(trainable, resource_spec)
+        strategy.graph_config.accum_steps = self.steps
+        return strategy
+
+
 class ZeRO(StrategyBuilder):
     """Weight-update/param sharding by stage: 1 → PS (opt-state sharding),
     2 → PartitionedAR (grad reduce-scatter), 3 → PartitionedPS (FSDP).
@@ -293,6 +313,7 @@ BUILDERS = {
     "RandomAxisPartitionAR": RandomAxisPartitionAR,
     "Parallax": Parallax,
     "ZeRO": ZeRO,
+    "GradAccumulation": GradAccumulation,
 }
 
 

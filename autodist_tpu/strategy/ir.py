@@ -193,6 +193,10 @@ class GraphConfig:
     # "gspmd" = jit + NamedSharding annotations, XLA inserts collectives
     # (for tensor/model-parallel and mixed-axis strategies).
     lowering: str = "collective"
+    # Gradient accumulation: each step scans over this many microbatches
+    # before the (single) synchronization + optimizer update, trading
+    # step latency for global batch sizes that exceed device memory.
+    accum_steps: int = 1
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -201,7 +205,8 @@ class GraphConfig:
     def from_dict(cls, d):
         return cls(replicas=d.get("replicas", 1),
                    mesh_axes=dict(d.get("mesh_axes", {})),
-                   lowering=d.get("lowering", "collective"))
+                   lowering=d.get("lowering", "collective"),
+                   accum_steps=d.get("accum_steps", 1))
 
 
 @dataclasses.dataclass
