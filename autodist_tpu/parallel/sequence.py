@@ -1,0 +1,132 @@
+"""Sequence/context parallelism: train with the sequence dim sharded.
+
+Absent from the reference (SURVEY.md §5.7: sequence length was never a
+sharding axis) — built TPU-first as the §5.7-anticipated extension: the
+``seq`` mesh axis shards activations along the token dimension, ring
+attention (:mod:`autodist_tpu.parallel.ring_attention`) rotates k/v
+blocks around the axis so every token still attends globally, and
+gradients synchronize over (``data`` ×) ``seq`` — per-shard token means
+compose into the exact global objective when shards are equal-sized.
+
+Long-context recipe::
+
+    cfg = TransformerConfig(attention_fn=make_ring_attention_fn(causal=True))
+    # model adds positions via sequence.global_positions(...)
+    init_fn, step_fn, shardings = lower_sequence_parallel(
+        trainable, mesh, seq_leaves=("x", "y"))
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu import const
+from autodist_tpu.kernel import common
+
+
+def global_positions(local_len: int, *, seq_axis: str = const.SEQ_AXIS):
+    """Global token positions of this device's sequence chunk — what a
+    sequence-parallel model feeds its positional embedding (a local
+    ``arange`` would restart at 0 on every shard)."""
+    return lax.axis_index(seq_axis) * local_len + jnp.arange(local_len)
+
+
+def lower_sequence_parallel(trainable, mesh, *,
+                            seq_leaves: Sequence[str] = ("x", "y"),
+                            seq_axis: str = const.SEQ_AXIS,
+                            data_axis: str = const.DATA_AXIS):
+    """Compile a training step with sequences sharded over ``seq_axis``.
+
+    ``seq_leaves`` names the batch keys carrying a ``[B, L, ...]`` token
+    dimension (split over both axes); other leaves split over the data
+    axis only (scalars duplicate).  Parameters and optimizer state are
+    replicated; gradients — each shard's grad of its local token-mean
+    loss — average over (data × seq), which is exactly the full-sequence
+    objective for equal shards.  The model must attend globally through
+    ring attention and use :func:`global_positions`.
+    """
+    if seq_axis not in mesh.shape:
+        raise ValueError(f"mesh {dict(mesh.shape)} has no {seq_axis!r} axis")
+    has_data = data_axis in mesh.shape
+    sync_axes = (data_axis, seq_axis) if has_data else (seq_axis,)
+    opt = trainable.optimizer
+
+    state_specs = {
+        "step": P(),
+        "params": jax.tree.map(lambda _: P(), trainable.params),
+        "opt_state": jax.tree.map(lambda _: P(),
+                                  jax.eval_shape(opt.init, trainable.params)),
+        "extra": jax.tree.map(lambda _: P(), trainable.extra),
+        "sync_state": {},
+    }
+    state_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                   state_specs,
+                                   is_leaf=lambda x: isinstance(x, P))
+
+    def batch_spec_for(name, leaf):
+        if jnp.ndim(leaf) == 0:
+            return P()
+        if name.split("/")[-1] in seq_leaves:
+            return P(data_axis, seq_axis) if has_data else P(None, seq_axis)
+        return P(data_axis) if has_data else P()
+
+    def _init(params, extra):
+        return {"step": jnp.zeros((), jnp.int32),
+                "params": jax.tree.map(jnp.asarray, params),
+                "opt_state": opt.init(jax.tree.map(jnp.asarray, params)),
+                "extra": extra, "sync_state": {}}
+
+    init_fn = jax.jit(_init, out_shardings=state_shardings)
+
+    def _local_step(state, batch, rng):
+        local_rng = jax.random.fold_in(rng, lax.axis_index(sync_axes))
+
+        def loss_of(params):
+            loss, new_extra, metrics = trainable.loss(
+                params, state["extra"], batch, local_rng)
+            return loss, (new_extra, metrics)
+
+        (loss, (new_extra, metrics)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(state["params"])
+        # Per-shard token-mean grads → global mean over data x seq.
+        from autodist_tpu.kernel.lowering import _reduce_metrics
+        grads = jax.tree.map(lambda g: lax.pmean(g, sync_axes), grads)
+        metrics = _reduce_metrics(dict(metrics), sync_axes)
+        # extra (e.g. batch stats) must be SPMD-invariant: average float
+        # leaves defensively (same guard as the collective lowering).
+        new_extra = jax.tree.map(
+            lambda x: lax.pmean(x, sync_axes)
+            if jnp.issubdtype(jnp.result_type(x), jnp.inexact) else x,
+            new_extra)
+        updates, new_opt = opt.update(grads, state["opt_state"],
+                                      state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return ({"step": state["step"] + 1, "params": new_params,
+                 "opt_state": new_opt, "extra": new_extra,
+                 "sync_state": {}}, metrics)
+
+    def _step(state, batch, rng):
+        matched = [name for name, _ in common.flatten_with_names(batch)
+                   if name.split("/")[-1] in seq_leaves]
+        if not matched:
+            # Silently replicating every leaf along seq would make ring
+            # attention treat identical copies as distinct chunks — a
+            # wrong objective with no error.  Demand an explicit match.
+            raise ValueError(
+                f"no batch leaf matches seq_leaves={tuple(seq_leaves)}; "
+                "name the token-dimension leaves explicitly")
+        bspecs = common.tree_from_names(
+            batch, lambda name, leaf: batch_spec_for(name, leaf))
+        return jax.shard_map(
+            _local_step, mesh=mesh,
+            in_specs=(state_specs, bspecs, P()),
+            out_specs=(state_specs, P()),
+            check_vma=False)(state, batch, rng)
+
+    step_fn = jax.jit(_step, donate_argnums=(0,))
+    return init_fn, step_fn, state_shardings
