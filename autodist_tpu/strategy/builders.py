@@ -275,10 +275,13 @@ class GradAccumulation(StrategyBuilder):
     update (global batches beyond device memory; not in the reference —
     its batch was bounded by what one GPU graph replica held)."""
 
-    def __init__(self, builder: StrategyBuilder, steps: int):
+    def __init__(self, builder: "StrategyBuilder | str | None" = None,
+                 steps: int = 2):
         if steps < 1:
             raise ValueError("accumulation steps must be >= 1")
-        if isinstance(builder, str):
+        if builder is None:
+            builder = PSLoadBalancing()  # the AutoDist default builder
+        elif isinstance(builder, str):
             builder = create(builder)
         self.builder = builder
         self.steps = steps

@@ -73,3 +73,10 @@ def test_benchmark_ncf_tiny():
                      "--train-steps", "4", "--log-steps", "2",
                      "--warmup-steps", "1")
     assert "ncf/AllReduce" in out
+
+
+def test_long_context_sequence_parallel():
+    out = run_script("examples/long_context.py", "--steps", "2",
+                     "--seq-len", "64", "--seq-parallel", "4",
+                     "--hidden", "32", "--layers", "1", timeout=300)
+    assert "long-context" in out and "sp=4" in out
