@@ -114,5 +114,42 @@ class Saver:
                                                jnp.int32)
         return runner
 
+    def install_preemption_hook(self, runner, *, signals=None,
+                                portable: bool = False):
+        """Checkpoint on termination signals (TPU-VM preemptions deliver
+        SIGTERM) before the default handling proceeds — the natural
+        extension of the reference's fail-fast-then-restart-from-
+        checkpoint model (SURVEY.md §5.3: detection only, no recovery;
+        here the checkpoint that makes the restart cheap is guaranteed).
+
+        Returns the previous handlers so callers can uninstall."""
+        import signal as _signal
+
+        signals = signals or (_signal.SIGTERM,)
+        previous = {}
+
+        def handler(signum, frame):
+            logging.warning(
+                "signal %d: writing preemption checkpoint at step %d",
+                signum, runner.step_count)
+            try:
+                self.save(runner, portable=portable, force=True)
+            finally:
+                prev = previous.get(signum)
+                if callable(prev):
+                    prev(signum, frame)
+                elif prev == _signal.SIG_IGN:
+                    pass  # the process was ignoring this signal: keep that
+                else:
+                    # SIG_DFL, or None (handler installed from C — not
+                    # callable from Python): fall back to default
+                    # termination so the signal is never swallowed.
+                    _signal.signal(signum, _signal.SIG_DFL)
+                    _signal.raise_signal(signum)
+
+        for sig in signals:
+            previous[sig] = _signal.signal(sig, handler)
+        return previous
+
     def close(self):
         self._mgr.close()

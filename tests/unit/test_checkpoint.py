@@ -72,3 +72,46 @@ def test_latest_step_and_missing(tmp_path):
     assert saver.latest_step() is None
     with pytest.raises(FileNotFoundError):
         saver.restore_params()
+
+
+def test_preemption_hook_checkpoints_on_sigterm(tmp_path):
+    """A SIGTERM (TPU preemption) must flush a checkpoint before the
+    process obeys the signal; run in a subprocess to observe the death."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    script = tmp_path / "preempt.py"
+    script.write_text(f"""
+import os, signal
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import sys
+sys.path.insert(0, {repo!r})
+from autodist_tpu import AutoDist, PS
+from autodist_tpu.checkpoint.saver import Saver
+from tests.unit.test_end_to_end import make_batch, make_trainable
+
+runner = AutoDist({{}}, PS()).build(make_trainable())
+runner.step(make_batch(0))
+runner.step(make_batch(1))
+saver = Saver({str(tmp_path / 'ckpt')!r})
+saver.install_preemption_hook(runner)
+os.kill(os.getpid(), signal.SIGTERM)   # simulate preemption
+raise SystemExit("signal did not terminate the process")
+""")
+    proc = subprocess.run([sys.executable, str(script)], cwd=repo,
+                          capture_output=True, text=True, timeout=240)
+    assert proc.returncode != 0  # died by/after the signal, not SystemExit 0
+    assert "signal did not terminate" not in proc.stdout + proc.stderr
+
+    # The checkpoint written by the handler restores at step 2.
+    saver = Saver(str(tmp_path / "ckpt"))
+    assert saver.latest_step() == 2
+    runner2 = AutoDist({}, PS()).build(make_trainable())
+    saver.restore(runner2)
+    assert runner2.step_count == 2
