@@ -1,0 +1,86 @@
+"""Docs stay honest: the tutorial code actually runs.
+
+The reference's tutorials bit-rotted against its own API more than once;
+these tests execute the documented snippets (the custom-builder example
+from ``docs/usage/tutorials/customize-strategy.md`` and the quickstart
+flow) against the live API so a signature change breaks CI, not a user.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import AutoDist, Trainable
+from autodist_tpu.strategy.base import StrategyBuilder
+from autodist_tpu.strategy.ir import (AllReduceSynchronizer, NodeConfig,
+                                      PartitionerConfig, PSSynchronizer,
+                                      Strategy)
+
+
+class BigVarsSharded(StrategyBuilder):
+    """Verbatim from docs/usage/tutorials/customize-strategy.md."""
+
+    def __init__(self, threshold_bytes=1 << 20):
+        self.threshold = threshold_bytes
+
+    def build(self, trainable, resource_spec):
+        n = self.num_replicas(resource_spec)
+        nodes = []
+        for info in trainable.var_infos():
+            if info.byte_size > self.threshold and info.shape:
+                node = NodeConfig(
+                    var_name=info.name,
+                    synchronizer=PSSynchronizer(),
+                    partitioner=PartitionerConfig(
+                        partition_str=",".join(
+                            [str(n)] + ["1"] * (len(info.shape) - 1))))
+            else:
+                node = NodeConfig(var_name=info.name,
+                                  synchronizer=AllReduceSynchronizer())
+            nodes.append(node)
+        return Strategy(node_configs=nodes,
+                        graph_config=self._graph_config(resource_spec))
+
+
+def _trainable():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    params = {
+        # > 1 MB: 512x600 fp32 = 1.2 MB -> sharded branch
+        "big": jax.random.normal(k1, (512, 600), jnp.float32) * 0.02,
+        "small": jax.random.normal(k2, (8,), jnp.float32),
+    }
+
+    def loss_fn(p, batch):
+        pred = batch["x"] @ p["big"]
+        return jnp.mean((pred - batch["y"]) ** 2) + jnp.sum(p["small"] ** 2)
+
+    return Trainable.from_loss_fn(loss_fn, params, optax.sgd(0.1))
+
+
+def test_custom_builder_from_docs_trains():
+    trainable = _trainable()
+    ad = AutoDist({"topology": {"num_devices": 8}}, BigVarsSharded())
+    strategy = ad.strategy_builder.build(trainable, ad.resource_spec)
+    node = strategy.node_config_for("big")
+    assert node.synchronizer.kind == "ps"
+    assert node.partitioner.partition_str == "8,1"
+    assert strategy.node_config_for("small").synchronizer.kind == "allreduce"
+
+    runner = ad.build(trainable)
+    batch = {"x": np.ones((16, 512), np.float32),
+             "y": np.zeros((16, 600), np.float32)}
+    m0 = runner.step(batch)
+    m1 = runner.step(batch)
+    assert float(m1["loss"]) < float(m0["loss"])
+
+
+def test_quickstart_flow_runs():
+    trainable = _trainable()
+    runner = AutoDist({"topology": {"num_devices": 8}}).build(trainable)
+    batch = {"x": np.ones((8, 512), np.float32),
+             "y": np.zeros((8, 600), np.float32)}
+    metrics = runner.step(batch)
+    assert "loss" in metrics
+    params = runner.get_params()
+    assert params["big"].shape == (512, 600)
