@@ -20,7 +20,9 @@ import optax
 
 class _Watchdog:
     """Emit a diagnostic JSON line instead of dying silently if the
-    accelerator backend hangs (tunnelled TPU plugins can stall on init)."""
+    accelerator backend hangs (tunnelled TPU plugins can stall at *any*
+    point — init, compile, or execute — so the alarm covers the whole
+    run and ``stage`` tracks where it was when it fired)."""
 
     def __init__(self, seconds: int, stage: str):
         self.seconds = seconds
@@ -30,18 +32,19 @@ class _Watchdog:
         print(json.dumps({
             "metric": "bert_base_mlm_mfu", "value": 0.0, "unit": "mfu",
             "vs_baseline": 0.0,
-            "error": f"watchdog: {self.stage} exceeded {self.seconds}s "
+            "error": f"watchdog: no result after {self.seconds}s; "
+                     f"stuck in stage {self.stage!r} "
                      "(accelerator backend unresponsive)"}))
         sys.stdout.flush()
         sys.exit(3)
 
-    def __enter__(self):
+    def arm(self):
         if hasattr(signal, "SIGALRM"):
             signal.signal(signal.SIGALRM, self._fire)
             signal.alarm(self.seconds)
         return self
 
-    def __exit__(self, *exc):
+    def disarm(self):
         if hasattr(signal, "SIGALRM"):
             signal.alarm(0)
 
@@ -65,8 +68,12 @@ def main():
     from autodist_tpu.resource import ResourceSpec
     from autodist_tpu.utils import profiling
 
-    with _Watchdog(300, "backend init"):
-        on_accel = jax.default_backend() != "cpu"
+    # One alarm for the whole bench: a healthy run finishes well inside
+    # the budget; a wedged tunnel gets a diagnostic JSON line instead of
+    # silence.  (jax.default_backend() alone can hang: the tunnel client
+    # initializes even under JAX_PLATFORMS=cpu.)
+    dog = _Watchdog(2400, "backend init").arm()
+    on_accel = jax.default_backend() != "cpu"
     # Measured on v5e (seq 512): plain einsum attention beats the Pallas
     # flash kernel (whose win starts at longer sequences), and synthetic
     # MLM batches are unpadded, so the padding mask — a full [B, H, L, L]
@@ -83,7 +90,6 @@ def main():
 
     rs = ResourceSpec({})
     n = rs.num_devices()
-    batch = batch_per_chip * n
 
     rng = jax.random.PRNGKey(0)
     import dataclasses
@@ -96,9 +102,11 @@ def main():
         step."""
         return float(np.asarray(x))
 
-    data = bert.synthetic_mlm_batch(0, batch, seq_len, num_masked,
-                                    cfg.vocab_size)
-    data.pop("input_mask", None)  # unpadded: no mask pass over scores
+    def make_batch(b):
+        data = bert.synthetic_mlm_batch(0, b * n, seq_len, num_masked,
+                                        cfg.vocab_size)
+        data.pop("input_mask", None)  # unpadded: no mask pass over scores
+        return data
 
     def build_runner(attention_fn):
         # init batch is shape-only (params are batch-size independent);
@@ -111,7 +119,7 @@ def main():
         # BERT chunk=256 (reference bert.py:62)
         return AutoDist(rs, AllReduce(chunk_size=256)).build(trainable)
 
-    def timed(runner, k):
+    def timed(runner, data, k):
         metrics = runner.step(data)  # compile
         fence(metrics["loss"])
         t0 = time.perf_counter()
@@ -120,39 +128,56 @@ def main():
         fence(metrics["loss"])
         return time.perf_counter() - t0
 
-    # Self-tuning attention choice: on v5e at seq 512 plain einsum beats
-    # this repo's Pallas flash kernel (attention is ~10% of BERT FLOPs;
-    # flash wins at longer sequences), but the margin is hardware/compiler
-    # dependent — measure a few steps of each and score the winner.
+    # Self-tuning over {attention impl} x {per-chip batch}: on v5e at seq
+    # 512 plain einsum beats this repo's Pallas flash kernel (attention is
+    # ~10% of BERT FLOPs; flash wins at longer sequences) and larger
+    # batches fill the MXU better until HBM runs out — but both margins
+    # are hardware/compiler dependent, so measure a few steps of each
+    # config and score the winner by examples/sec.  A config that OOMs
+    # just loses its probe.
     from autodist_tpu.ops import make_attention_fn
-    candidates = {"einsum": None}
+    attn_impls = {"einsum": None}
     if on_accel:
-        candidates["flash"] = make_attention_fn(causal=False)
-    probes = {}
-    runners = {}
-    for name, attn in candidates.items():
+        attn_impls["flash"] = make_attention_fn(causal=False)
+    if on_accel:
+        # 3 configs = 3 compiles: einsum at both batches, flash only at
+        # the big one (flash at the base batch already measured slower
+        # than einsum on v5e, BASELINE.md round-3 table).
+        candidates = [("einsum", batch_per_chip),
+                      ("einsum", 2 * batch_per_chip),
+                      ("flash", 2 * batch_per_chip)]
+    else:
+        candidates = [("einsum", batch_per_chip)]
+    rates = {}     # config -> examples/sec from the probe
+    runners = {}   # attention name -> runner (shared across batch sizes)
+    batches = {b: make_batch(b) for _, b in candidates}
+    for name, b in candidates:
+        dog.stage = f"probe {name}/b{b} (build+compile+steps)"
         try:
-            runners[name] = build_runner(attn)
-            probes[name] = timed(runners[name], 5 if on_accel else 1)
+            if name not in runners:
+                runners[name] = build_runner(attn_impls[name])
+            dt = timed(runners[name], batches[b], 5 if on_accel else 1)
+            rates[(name, b)] = b * n * (5 if on_accel else 1) / dt
         except Exception as e:  # pragma: no cover - probe must not kill bench
-            print(f"# bench probe {name} failed: {e}", flush=True)
-            runners.pop(name, None)
-    if not probes:
+            print(f"# bench probe {name}/b{b} failed: {e}", flush=True)
+    if not rates:
         print(json.dumps({
             "metric": "bert_base_mlm_mfu", "value": 0.0, "unit": "mfu",
-            "vs_baseline": 0.0, "error": "every attention probe failed"}))
+            "vs_baseline": 0.0, "error": "every bench probe failed"}))
         sys.exit(4)
-    best = min(probes, key=probes.get)
-    runner = runners[best]
+    best, best_b = max(rates, key=rates.get)
+    runner, data, batch = runners[best], batches[best_b], best_b * n
     for name in list(runners):
         if name != best:
             del runners[name]  # free the loser's params/opt state in HBM
 
+    dog.stage = f"scored run ({best}/b{best_b})"
     t0 = time.perf_counter()
     for _ in range(steps):
         metrics = runner.step(data)
     fence(metrics["loss"])
     dt = time.perf_counter() - t0
+    dog.stage = "memory stats + report"
 
     examples_per_sec = batch * steps / dt
     flops_per_example = mlm_model_flops_per_example(cfg, seq_len, num_masked)
@@ -168,10 +193,12 @@ def main():
         "devices": n,
         "chip": rs.chip.name,
         "attention": best,
+        "batch_per_chip": best_b,
     }
     mem = profiling.memory_summary()
     if mem.get("bytes_in_use"):
         record["hbm_gb_in_use"] = round(mem["bytes_in_use"] / 1e9, 2)
+    dog.disarm()
     print(json.dumps(record))
 
 

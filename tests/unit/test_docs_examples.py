@@ -1,46 +1,45 @@
 """Docs stay honest: the tutorial code actually runs.
 
 The reference's tutorials bit-rotted against its own API more than once;
-these tests execute the documented snippets (the custom-builder example
-from ``docs/usage/tutorials/customize-strategy.md`` and the quickstart
-flow) against the live API so a signature change breaks CI, not a user.
+these tests execute the documented snippets against the live API so a
+signature change breaks CI, not a user.  The custom-builder class is
+*extracted from the markdown itself* (``docs/usage/tutorials/
+customize-strategy.md``), so editing the doc re-tests the doc.
 """
+import pathlib
+import re
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 from autodist_tpu import AutoDist, Trainable
-from autodist_tpu.strategy.base import StrategyBuilder
-from autodist_tpu.strategy.ir import (AllReduceSynchronizer, NodeConfig,
-                                      PartitionerConfig, PSSynchronizer,
-                                      Strategy)
+
+DOCS = pathlib.Path(__file__).resolve().parents[2] / "docs"
 
 
-class BigVarsSharded(StrategyBuilder):
-    """Verbatim from docs/usage/tutorials/customize-strategy.md."""
+def _snippet_defining(md_path, name):
+    """First ```python block in ``md_path`` that defines ``name``."""
+    text = (DOCS / md_path).read_text()
+    for block in re.findall(r"```python\n(.*?)```", text, re.DOTALL):
+        if f"class {name}" in block or f"def {name}" in block:
+            return block
+    raise AssertionError(f"no python block defining {name} in {md_path}")
 
-    def __init__(self, threshold_bytes=1 << 20):
-        self.threshold = threshold_bytes
 
-    def build(self, trainable, resource_spec):
-        n = self.num_replicas(resource_spec)
-        nodes = []
-        for info in trainable.var_infos():
-            if info.byte_size > self.threshold and info.shape:
-                node = NodeConfig(
-                    var_name=info.name,
-                    synchronizer=PSSynchronizer(),
-                    partitioner=PartitionerConfig(
-                        partition_str=",".join(
-                            [str(n)] + ["1"] * (len(info.shape) - 1))))
-            else:
-                node = NodeConfig(var_name=info.name,
-                                  synchronizer=AllReduceSynchronizer())
-            nodes.append(node)
-        return Strategy(node_configs=nodes,
-                        graph_config=self._graph_config(resource_spec))
+def _exec_doc_builder():
+    src = _snippet_defining("usage/tutorials/customize-strategy.md",
+                            "BigVarsSharded")
+    # The doc shows the imports in a separate block; provide them the way
+    # the tutorial's first code block does.
+    ns = {}
+    exec("from autodist_tpu.strategy.ir import (Strategy, NodeConfig, "
+         "GraphConfig, AllReduceSynchronizer, PSSynchronizer, "
+         "PartitionerConfig)\n"
+         "from autodist_tpu.strategy.base import StrategyBuilder\n"
+         "from autodist_tpu import AutoDist\n" + src, ns)
+    return ns["BigVarsSharded"]
 
 
 def _trainable():
@@ -59,6 +58,7 @@ def _trainable():
 
 
 def test_custom_builder_from_docs_trains():
+    BigVarsSharded = _exec_doc_builder()
     trainable = _trainable()
     ad = AutoDist({"topology": {"num_devices": 8}}, BigVarsSharded())
     strategy = ad.strategy_builder.build(trainable, ad.resource_spec)
