@@ -9,44 +9,93 @@ devices are visible (the driver runs this on real TPU hardware; on a CPU
 dev machine it shrinks the model so the bench stays fast).
 """
 import json
-import signal
+import os
+import subprocess
 import sys
+import tempfile
 import time
 
 import jax
 import numpy as np
 import optax
 
+# The monitor runs as a separate *process*: a SIGALRM watchdog cannot
+# preempt a C call that never returns to the interpreter (observed: a
+# wedged tunnel client blocks inside PJRT client init and the alarm
+# handler runs only when something else unblocks the call), so in-process
+# schemes can die silently — exactly what the driver must never see.
+_MONITOR_SRC = r"""
+import json, os, signal, sys, time
+ppid, stage_path, secs = int(sys.argv[1]), sys.argv[2], float(sys.argv[3])
+deadline = time.time() + secs
+while time.time() < deadline:
+    time.sleep(1.0)
+    try:
+        os.kill(ppid, 0)          # parent finished -> it killed us already,
+    except OSError:               # or died on its own: stay silent either way
+        sys.exit(0)
+try:
+    with open(stage_path) as f:
+        stage = f.read().strip() or "?"
+except OSError:
+    stage = "?"
+print(json.dumps({
+    "metric": "bert_base_mlm_mfu", "value": 0.0, "unit": "mfu",
+    "vs_baseline": 0.0,
+    "error": f"watchdog: no result after {int(secs)}s; stuck in stage "
+             f"{stage!r} (accelerator backend unresponsive)"}), flush=True)
+try:
+    os.kill(ppid, signal.SIGKILL)
+except OSError:
+    pass
+"""
+
 
 class _Watchdog:
-    """Emit a diagnostic JSON line instead of dying silently if the
-    accelerator backend hangs (tunnelled TPU plugins can stall at *any*
-    point — init, compile, or execute — so the alarm covers the whole
-    run and ``stage`` tracks where it was when it fired)."""
+    """Whole-run hang watchdog in a child process sharing our stdout: if
+    the bench produces no result within the budget, the child prints a
+    diagnostic JSON line (with the live stage label) and kills the bench."""
 
     def __init__(self, seconds: int, stage: str):
         self.seconds = seconds
+        fd, self._stage_path = tempfile.mkstemp(prefix="bench_stage_")
+        os.close(fd)
+        self._proc = None
         self.stage = stage
 
-    def _fire(self, *_):
-        print(json.dumps({
-            "metric": "bert_base_mlm_mfu", "value": 0.0, "unit": "mfu",
-            "vs_baseline": 0.0,
-            "error": f"watchdog: no result after {self.seconds}s; "
-                     f"stuck in stage {self.stage!r} "
-                     "(accelerator backend unresponsive)"}))
-        sys.stdout.flush()
-        sys.exit(3)
+    @property
+    def stage(self):
+        return self._stage
+
+    @stage.setter
+    def stage(self, value: str):
+        self._stage = value
+        try:
+            with open(self._stage_path, "w") as f:
+                f.write(value)
+        except OSError:
+            pass
 
     def arm(self):
-        if hasattr(signal, "SIGALRM"):
-            signal.signal(signal.SIGALRM, self._fire)
-            signal.alarm(self.seconds)
+        self._proc = subprocess.Popen(
+            [sys.executable, "-c", _MONITOR_SRC,
+             str(os.getpid()), self._stage_path, str(self.seconds)],
+            stdout=None, stderr=subprocess.DEVNULL)  # inherit our stdout
         return self
 
     def disarm(self):
-        if hasattr(signal, "SIGALRM"):
-            signal.alarm(0)
+        """Kill + reap the monitor.  Call *before* printing the result
+        line: after wait() returns the child has either never fired or
+        already flushed its error line, so the real record — printed
+        after — is the last JSON line on stdout either way."""
+        if self._proc is not None:
+            self._proc.kill()
+            self._proc.wait()
+            self._proc = None
+        try:
+            os.unlink(self._stage_path)
+        except OSError:
+            pass
 
 
 def mlm_model_flops_per_example(cfg, seq_len: int, num_masked: int) -> float:
@@ -63,16 +112,23 @@ def mlm_model_flops_per_example(cfg, seq_len: int, num_masked: int) -> float:
 
 
 def main():
-    from autodist_tpu import AllReduce, AutoDist
-    from autodist_tpu.models import bert
-    from autodist_tpu.resource import ResourceSpec
-    from autodist_tpu.utils import profiling
-
     # One alarm for the whole bench: a healthy run finishes well inside
     # the budget; a wedged tunnel gets a diagnostic JSON line instead of
     # silence.  (jax.default_backend() alone can hang: the tunnel client
     # initializes even under JAX_PLATFORMS=cpu.)
     dog = _Watchdog(2400, "backend init").arm()
+    try:
+        _bench(dog)
+    finally:
+        dog.disarm()   # every exit path reaps the monitor + stage file
+
+
+def _bench(dog):
+    from autodist_tpu import AllReduce, AutoDist
+    from autodist_tpu.models import bert
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.utils import profiling
+
     on_accel = jax.default_backend() != "cpu"
     # Measured on v5e (seq 512): plain einsum attention beats the Pallas
     # flash kernel (whose win starts at longer sequences), and synthetic

@@ -19,27 +19,17 @@ from autodist_tpu import AutoDist, Trainable
 DOCS = pathlib.Path(__file__).resolve().parents[2] / "docs"
 
 
-def _snippet_defining(md_path, name):
-    """First ```python block in ``md_path`` that defines ``name``."""
-    text = (DOCS / md_path).read_text()
-    for block in re.findall(r"```python\n(.*?)```", text, re.DOTALL):
-        if f"class {name}" in block or f"def {name}" in block:
-            return block
-    raise AssertionError(f"no python block defining {name} in {md_path}")
-
-
 def _exec_doc_builder():
-    src = _snippet_defining("usage/tutorials/customize-strategy.md",
-                            "BigVarsSharded")
-    # The doc shows the imports in a separate block; provide them the way
-    # the tutorial's first code block does.
+    """Exec the tutorial's code blocks — imports included — in order,
+    up to and including the one defining ``BigVarsSharded``, so a rename
+    anywhere in the documented preamble breaks this test too."""
+    text = (DOCS / "usage/tutorials/customize-strategy.md").read_text()
     ns = {}
-    exec("from autodist_tpu.strategy.ir import (Strategy, NodeConfig, "
-         "GraphConfig, AllReduceSynchronizer, PSSynchronizer, "
-         "PartitionerConfig)\n"
-         "from autodist_tpu.strategy.base import StrategyBuilder\n"
-         "from autodist_tpu import AutoDist\n" + src, ns)
-    return ns["BigVarsSharded"]
+    for block in re.findall(r"```python\n(.*?)```", text, re.DOTALL):
+        exec(block, ns)
+        if "BigVarsSharded" in ns:
+            return ns["BigVarsSharded"]
+    raise AssertionError("no python block defines BigVarsSharded")
 
 
 def _trainable():
