@@ -15,6 +15,11 @@ from common import BenchmarkLogger, base_parser, run_benchmark
 CHUNK_SIZES = {"vgg16": 25, "resnet101": 200, "inceptionv3": 30}
 DEFAULT_CHUNK = 512
 
+# Textbook forward-pass GFLOPs per image at the canonical input size
+# (224px; inception 299px), for MFU estimation (training ~ 3x fwd).
+FWD_GFLOPS = {"resnet50": 4.1, "resnet101": 7.8, "vgg16": 15.5,
+              "densenet121": 2.9, "inceptionv3": 5.7}
+
 
 def build_model(name: str):
     from autodist_tpu.models import densenet, inception, resnet, vgg
@@ -32,6 +37,8 @@ def main():
     ap.add_argument("--model", default="resnet50",
                     choices=["resnet18", "resnet50", "resnet101", "vgg16",
                              "densenet121", "inceptionv3"])
+    ap.add_argument("--json", action="store_true",
+                    help="also print one machine-readable headline line")
     args = ap.parse_args()
 
     import jax
@@ -64,13 +71,29 @@ def main():
     y = rng.randint(0, 1000, (batch,)).astype(np.int32)
 
     logger = BenchmarkLogger(args.benchmark_log_dir)
+    flops_per_example = peak_flops = None
+    if args.model in FWD_GFLOPS and args.preset != "tiny":
+        flops_per_example = 3.0 * FWD_GFLOPS[args.model] * 1e9
+        peak_flops = rs.chip.peak_bf16_tflops * 1e12 * n
     summary = run_benchmark(
         runner, lambda step: {"x": x, "y": y}, batch_size=batch,
         train_steps=args.train_steps, warmup_steps=args.warmup_steps,
-        log_steps=args.log_steps, logger=logger)
+        log_steps=args.log_steps, logger=logger,
+        flops_per_example=flops_per_example, peak_flops=peak_flops)
     print(f"{args.model}/{args.strategy}: "
           f"{summary['examples_per_sec']:.1f} examples/s "
           f"({summary['step_ms_mean']:.1f} ms/step, {n} devices)")
+    if args.json:
+        import json
+        record = {
+            "metric": f"{args.model}_images_per_sec_per_chip",
+            "value": round(summary["examples_per_sec"] / n, 2),
+            "unit": "examples/sec/chip", "strategy": args.strategy,
+            "devices": n, "chip": rs.chip.name, "image_size": image_size,
+            "batch_per_chip": batch // n}
+        if summary.get("mfu") is not None:
+            record["mfu_est"] = round(summary["mfu"], 4)
+        print(json.dumps(record))
     logger.close()
 
 
