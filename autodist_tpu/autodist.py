@@ -123,6 +123,15 @@ class AutoDist:
         asynchrony cannot live inside one SPMD program); everything else
         gets the SPMD :class:`~autodist_tpu.runner.DistributedRunner`."""
         strategy = strategy or self.build_or_load_strategy(trainable)
+        # A measuring builder (AutoStrategy measure_top_k) may already
+        # hold the winning strategy's compiled runner — reuse it instead
+        # of recompiling the identical program.
+        take_cached = getattr(self.strategy_builder, "take_cached_runner",
+                              None)
+        if take_cached is not None and not runner_kwargs and rng is None:
+            cached = take_cached(strategy.id)
+            if cached is not None:
+                return cached
         from autodist_tpu.strategy.ir import PSSynchronizer
         async_nodes = [
             nc for nc in strategy.node_configs

@@ -37,26 +37,60 @@ def default_candidates() -> list[StrategyBuilder]:
 
 class AutoStrategy(StrategyBuilder):
     """Chooses among candidate builders with the analytic cost model
-    (≙ the reference's declared AutoStrategy direction, SURVEY.md §2.3).
+    (≙ the reference's declared AutoStrategy direction, SURVEY.md §2.3),
+    optionally refined by *measurement* — the reference's AutoSync plan
+    trained a simulator on measured step times
+    (``autodist/simulator/dataset/README.md``); here the hardware itself
+    is the simulator: compile the top-k analytic picks, time a few real
+    steps each, keep the fastest.
 
     ``auto = AutoStrategy(); AutoDist(spec, auto).build(trainable)`` —
-    after ``build``, ``auto.report`` holds the scored candidates.
+    after ``build``, ``auto.report`` holds the scored candidates and
+    ``auto.measured`` the per-candidate step times (when enabled).
+
+    Args:
+      candidates: builder instances to choose among (default: the zoo).
+      measure_top_k: when > 1, lower + time this many of the analytically
+        best feasible candidates and pick the measured winner.  Costs one
+        compile per measured candidate; single-process only (the chief
+        plans before workers exist in multihost flows).
+      example_batch: a host batch pytree for the timed steps (required
+        when ``measure_top_k > 1``).
+      measure_steps: timed steps per candidate (after one compile step).
     """
 
     def __init__(self, candidates: Optional[Sequence[StrategyBuilder]] = None,
-                 **cost_model_kwargs):
+                 measure_top_k: int = 0, example_batch=None,
+                 measure_steps: int = 3, **cost_model_kwargs):
         self.candidates = list(candidates) if candidates is not None \
             else default_candidates()
         if not self.candidates:
             raise ValueError("AutoStrategy needs at least one candidate")
+        if measure_top_k > 1 and example_batch is None:
+            raise ValueError("measure_top_k needs an example_batch to time")
+        self.measure_top_k = measure_top_k
+        self.example_batch = example_batch
+        self.measure_steps = measure_steps
         self.cost_model_kwargs = cost_model_kwargs
         self.report: list[tuple[str, StrategyCost]] = []
+        self.measured: dict[str, float] = {}
+        self._winner_runner = None
+        self._winner_strategy_id = None
 
     def build(self, trainable, resource_spec):
         model = CostModel(resource_spec, **self.cost_model_kwargs)
+        self.measured = {}
+        self._winner_runner = None
+        self._winner_strategy_id = None
         scored = []
+        seen_names: dict[str, int] = {}
         for builder in self.candidates:
             name = type(builder).__name__
+            # Two configs of one builder class (e.g. AllReduce with and
+            # without compression) must stay distinct in report/measured.
+            seen_names[name] = seen_names.get(name, 0) + 1
+            if seen_names[name] > 1:
+                name = f"{name}#{seen_names[name]}"
             try:
                 strategy = builder.build(trainable, resource_spec)
             except ValueError as e:
@@ -85,5 +119,106 @@ class AutoStrategy(StrategyBuilder):
                 "no candidate strategy fits in device memory "
                 f"(best: {best_name} needs "
                 f"{best_cost.mem_bytes_per_device / 1e9:.2f} GB/device)")
+        if self.measure_top_k > 1:
+            measured = self._measure(trainable, resource_spec, scored)
+            if measured is not None:
+                best_name, best_strategy = measured
         logging.info("auto-strategy picked %s", best_name)
         return best_strategy
+
+    def take_cached_runner(self, strategy_id: str):
+        """Hand the measured winner's already-compiled runner to the
+        facade (consulted by :meth:`AutoDist.build`) so the winning
+        executable is not thrown away and recompiled.  State is re-
+        initialized first: the measured steps must not leak into the
+        returned runner (from-init numeric equality is a product
+        guarantee; re-init is a placement, not a recompile)."""
+        if (self._winner_runner is not None
+                and self._winner_strategy_id == strategy_id):
+            import jax
+
+            runner, self._winner_runner = self._winner_runner, None
+            runner.state = runner.lowered.init_state(
+                trainable=runner.trainable)
+            runner._host_step = 0
+            # step() splits self.rng each call — restore the fresh-build
+            # default so rng-consuming losses (dropout) also match a
+            # from-init build exactly.
+            runner.rng = jax.random.PRNGKey(0)
+            return runner
+        return None
+
+    # ------------------------------------------------------------------ #
+    def _measure(self, trainable, resource_spec, scored):
+        """Time real steps of the analytically-best feasible candidates;
+        return ``(name, strategy)`` of the measured winner, or ``None``
+        when measurement is unavailable (multihost planning) or every
+        candidate failed to run.  Keeps at most two runners alive (the
+        best-so-far and the one being timed) and caches the winner's
+        runner for :meth:`take_cached_runner`."""
+        import time
+
+        import numpy as np
+
+        from autodist_tpu.autodist import AutoDist
+
+        if getattr(resource_spec, "is_multihost", False):
+            logging.warning("auto-strategy: measurement skipped in "
+                            "multihost planning (chief plans before "
+                            "workers exist); using analytic ranking")
+            return None
+        ad = AutoDist(resource_spec, self)
+
+        def fence(metrics):
+            # Same invariant as examples/benchmark/common.py: the
+            # Trainable contract guarantees scalar metrics, not a "loss"
+            # key specifically.
+            return float(np.asarray(next(iter(metrics.values()))))
+
+        def fence_state(runner):
+            # The donated-state update can outlive the metrics buffers
+            # (examples/benchmark/common.py:90-94) and its tail — e.g. a
+            # PS param all-gather — differs per candidate, so both window
+            # edges must fence state, not just metrics.
+            state = getattr(runner, "state", None)
+            if state is not None and "step" in state:
+                float(np.asarray(state["step"]))
+
+        best = None   # (dt, name, strategy, runner)
+        top = [t for t in scored if t[1].feasible][: self.measure_top_k]
+        for name, _, strategy in top:
+            runner = None
+            try:
+                runner = ad.build(trainable, strategy)
+                fence(runner.step(self.example_batch))   # compile step
+                fence_state(runner)
+                t0 = time.perf_counter()
+                for _ in range(self.measure_steps):
+                    metrics = runner.step(self.example_batch)
+                fence(metrics)
+                fence_state(runner)
+                dt = (time.perf_counter() - t0) / self.measure_steps
+                self.measured[name] = dt
+                logging.info("auto-strategy measured %-18s %7.3f ms/step",
+                             name, dt * 1e3)
+                if best is None or dt < best[0]:
+                    best, runner = (dt, name, strategy, runner), best and best[3]
+            except Exception as e:  # a candidate that cannot run loses
+                logging.warning("auto-strategy measure %s failed: %s",
+                                name, e)
+            finally:
+                # Free the loser before the next compile; close() tears
+                # down any host-side machinery (async-PS thread, in-
+                # process CoordServer) that `del` would leak.
+                if runner is not None and hasattr(runner, "close"):
+                    runner.close()
+                del runner
+        if best is None:
+            return None
+        _, name, strategy, winner_runner = best
+        if hasattr(winner_runner, "lowered"):  # resettable → cacheable
+            self._winner_runner = winner_runner
+            self._winner_strategy_id = strategy.id
+        elif hasattr(winner_runner, "close"):  # not cacheable: tear down
+            winner_runner.close()
+        return name, strategy

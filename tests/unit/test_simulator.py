@@ -170,3 +170,65 @@ def test_auto_strategy_gspmd_pick_trains():
     batch = {"x": rng.randn(16, 64).astype(np.float32)}
     m = runner.step(batch)
     assert np.isfinite(float(np.asarray(m["loss"])))
+
+
+def test_auto_strategy_measured_refinement():
+    """measure_top_k times real steps of the analytic top-k and picks the
+    measured winner (the hardware-as-simulator AutoSync realization)."""
+    from autodist_tpu.strategy.builders import PSLoadBalancing
+
+    trainable = make_dense_trainable(dim=64)
+    rng = np.random.RandomState(0)
+    batch = {"x": rng.randn(16, 64).astype(np.float32)}
+    auto = AutoStrategy(candidates=[AllReduce(), PSLoadBalancing()],
+                        measure_top_k=2, example_batch=batch,
+                        measure_steps=2)
+    runner = AutoDist({}, auto).build(trainable)
+    # Both candidates were timed; the pick is one of them.
+    assert set(auto.measured) == {"AllReduce", "PSLoadBalancing"}
+    assert all(t > 0 for t in auto.measured.values())
+    # The cached winner runner was handed over with *fresh* state: the
+    # timed measurement steps must not leak into user training.
+    assert runner.step_count == 0
+    m = runner.step(batch)
+    assert np.isfinite(float(np.asarray(m["loss"])))
+    # From-init equality with an unmeasured build of the same trainable.
+    fresh = AutoDist({}, AllReduce()).build(make_dense_trainable(dim=64))
+    m_fresh = fresh.step(batch)
+    if "AllReduce" == min(auto.measured, key=auto.measured.get):
+        np.testing.assert_allclose(np.asarray(m["loss"]),
+                                   np.asarray(m_fresh["loss"]), rtol=1e-6)
+
+
+def test_auto_strategy_measure_requires_batch():
+    with pytest.raises(ValueError):
+        AutoStrategy(measure_top_k=2)
+
+
+def test_measured_winner_rng_reset_from_init():
+    """The cached winner's rng stream must match a fresh build's: an
+    rng-consuming loss (dropout-style) trains identically whether or not
+    measurement steps ran first."""
+    import jax
+
+    def make():
+        params = {"w": jnp.ones((32, 32), jnp.float32) * 0.1}
+
+        def loss_fn(p, batch, rng):
+            keep = jax.random.bernoulli(rng, 0.8, batch["x"].shape)
+            return jnp.mean(((batch["x"] * keep) @ p["w"]) ** 2)
+
+        return Trainable.from_loss_fn(loss_fn, params, optax.sgd(0.1),
+                                      with_rng=True)
+
+    rng = np.random.RandomState(0)
+    batch = {"x": rng.randn(16, 32).astype(np.float32)}
+    auto = AutoStrategy(candidates=[AllReduce()], measure_top_k=2,
+                        example_batch=batch, measure_steps=1)
+    measured_runner = AutoDist({}, auto).build(make())
+    fresh_runner = AutoDist({}, AllReduce()).build(make())
+    for _ in range(3):
+        m_meas = measured_runner.step(batch)
+        m_fresh = fresh_runner.step(batch)
+        np.testing.assert_allclose(np.asarray(m_meas["loss"]),
+                                   np.asarray(m_fresh["loss"]), rtol=1e-6)
