@@ -1,6 +1,8 @@
 """Pallas TPU kernels and sharding-aware ops for the hot paths."""
-from autodist_tpu.ops.flash_attention import flash_attention, make_attention_fn
+from autodist_tpu.ops.flash_attention import (flash_attention,
+                                              flash_attention_with_lse,
+                                              make_attention_fn)
 from autodist_tpu.ops.sparse import ShardedEmbedding, embedding_lookup
 
-__all__ = ["flash_attention", "make_attention_fn", "ShardedEmbedding",
-           "embedding_lookup"]
+__all__ = ["flash_attention", "flash_attention_with_lse",
+           "make_attention_fn", "ShardedEmbedding", "embedding_lookup"]

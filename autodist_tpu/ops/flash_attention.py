@@ -216,18 +216,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, g_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q, block_k,
-               interpret, valid_len):
+               interpret, valid_len, g_lse=None):
     """Flash backward as two Pallas kernels (dq over q blocks; dk/dv over
     k blocks), recomputing probabilities from the saved logsumexp.
 
     All inputs [BH, L_pad, D] (lse [BH, L_pad]); returns (dq, dk, dv) in
     fp32.  The recompute re-applies the valid-length mask: padded k rows
     are zeros, which would otherwise contribute p = exp(-lse) ≠ 0.
+
+    ``g_lse`` [BH, L_pad] is the cotangent of the logsumexp output when
+    the caller consumes it (ring-merge).  d(lse)/d(s) is exactly the
+    softmax ``p``, so it folds into the existing kernels as
+    ``ds = p·(dp − (delta − g_lse))·scale`` — an adjustment of delta,
+    not a new kernel.  (lse does not depend on v, and dv = pᵀg is
+    correctly unaffected.)
     """
     bh, seq_len, head_dim = q.shape
     gf = g.astype(jnp.float32)
     delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1,
                     keepdims=True)                          # [BH, L, 1]
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)[..., None]
     lse3 = lse[..., None]                                   # [BH, L, 1]
 
     full = lambda bh_, i: (bh_, 0, 0)
@@ -304,24 +313,46 @@ def _flash_bhld_bwd(scale, causal, block_q, block_k, interpret, valid_len,
 _flash_bhld.defvjp(_flash_bhld_fwd, _flash_bhld_bwd)
 
 
-def flash_attention(q, k, v, *, causal: bool = False,
-                    scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128,
-                    interpret: Optional[bool] = None):
-    """Fused attention over ``[batch, length, heads, head_dim]`` inputs.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bhld_lse(q, k, v, scale, causal, block_q, block_k, interpret,
+                    valid_len):
+    """Like :func:`_flash_bhld` but also returns the logsumexp — the
+    chunk primitive for ring flash attention, whose merge consumes (and
+    therefore differentiates through) lse."""
+    return _flash_fwd_2d(q, k, v, scale, causal, block_q, block_k,
+                         interpret, valid_len)
 
-    ``interpret=None`` auto-selects the Pallas interpreter off-TPU (the
-    simulated CPU mesh used by the test harness).
-    """
+
+def _flash_bhld_lse_fwd(q, k, v, scale, causal, block_q, block_k,
+                        interpret, valid_len):
+    out, lse = _flash_fwd_2d(q, k, v, scale, causal, block_q, block_k,
+                             interpret, valid_len)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_bhld_lse_bwd(scale, causal, block_q, block_k, interpret,
+                        valid_len, res, cotangents):
+    q, k, v, out, lse = res
+    g, g_lse = cotangents
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, scale, causal, block_q,
+                            block_k, interpret, valid_len, g_lse=g_lse)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_bhld_lse.defvjp(_flash_bhld_lse_fwd, _flash_bhld_lse_bwd)
+
+
+def _layout_bhld(q, k, v, scale, block_q, block_k, interpret):
+    """Shared wrapper plumbing: pick blocks (8-aligned), zero-pad the
+    sequence to a common block multiple (masked inside the kernel), and
+    fold heads into batch — so any length lowers on TPU without
+    materializing [L, L] scores.  Returns the kernel inputs plus the
+    facts needed to undo the layout."""
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
     b, l, h, d = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-
-    # Blocks are 8-aligned; the sequence is zero-padded to a common block
-    # multiple (masked inside the kernel), so any length lowers on TPU
-    # without materializing [L, L] scores.
     bq = _aligned_block(l, block_q)
     bk = _aligned_block(l, block_k)
     lcm = bq * bk // math.gcd(bq, bk)
@@ -333,10 +364,47 @@ def flash_attention(q, k, v, *, causal: bool = False,
             x = jnp.pad(x, ((0, 0), (0, l_pad - l), (0, 0)))
         return x
 
-    out = _flash_bhld(to_bhld(q), to_bhld(k), to_bhld(v), float(scale),
-                      bool(causal), bq, bk, bool(interpret), int(l))
-    out = out[:, :l] if l_pad != l else out
+    args = (to_bhld(q), to_bhld(k), to_bhld(v), float(scale))
+    return args, (bq, bk, bool(interpret)), (b, l, h, d)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused attention over ``[batch, length, heads, head_dim]`` inputs.
+
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU (the
+    simulated CPU mesh used by the test harness).
+    """
+    (qb, kb, vb, s), (bq, bk, interp), (b, l, h, d) = _layout_bhld(
+        q, k, v, scale, block_q, block_k, interpret)
+    out = _flash_bhld(qb, kb, vb, s, bool(causal), bq, bk, interp, int(l))
+    out = out[:, :l]
     return jnp.moveaxis(out.reshape(b, h, l, d), 1, 2)
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: Optional[bool] = None):
+    """Fused attention returning ``(out, lse)`` over ``[batch, length,
+    heads, head_dim]`` inputs; ``lse`` is ``[batch, length, heads]``.
+
+    The chunk primitive for ring flash attention
+    (``parallel/ring_attention.py``): per-kv-chunk results merge exactly
+    via ``lse_m = logaddexp(lse_a, lse_b); out_m = out_a·e^{lse_a−lse_m}
+    + out_b·e^{lse_b−lse_m}`` — and the merge's lse cotangent is handled
+    by the kernel's VJP.
+    """
+    (qb, kb, vb, s), (bq, bk, interp), (b, l, h, d) = _layout_bhld(
+        q, k, v, scale, block_q, block_k, interpret)
+    out, lse = _flash_bhld_lse(qb, kb, vb, s, bool(causal), bq, bk,
+                               interp, int(l))
+    out, lse = out[:, :l], lse[:, :l]
+    out = jnp.moveaxis(out.reshape(b, h, l, d), 1, 2)
+    lse = jnp.moveaxis(lse.reshape(b, h, l), 1, 2)       # [B, L, H]
+    return out, lse
 
 
 def make_attention_fn(causal: bool, *, block_q: int = 128,

@@ -88,6 +88,84 @@ def ring_self_attention(q, k, v, *, axis_name: str, causal: bool = False,
     return (o / norm).astype(q.dtype)
 
 
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _merge_chunks(o_a, lse_a, o_b, lse_b):
+    """Combine two normalized attention partials exactly:
+    softmax(s ∪ t)·v = softmax-weighted average of the chunk outputs,
+    weighted by e^{lse−lse_merged}.  ``NEG_INF`` lse (empty chunk)
+    contributes weight 0 once any real chunk has arrived."""
+    m = jnp.maximum(lse_a, lse_b)
+    w_a = jnp.exp(lse_a - m)
+    w_b = jnp.exp(lse_b - m)
+    denom = w_a + w_b
+    o = (o_a * w_a[..., None] + o_b * w_b[..., None]) / denom[..., None]
+    return o, m + jnp.log(denom)
+
+
+def ring_flash_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                         scale: Optional[float] = None,
+                         block_q: int = 128, block_k: int = 128):
+    """Ring attention with the Pallas flash kernel as the per-chunk
+    compute: never materializes [Lc, Lc] scores in HBM, so the win over
+    :func:`ring_self_attention` grows with the local chunk length.
+
+    Causality needs no dynamic masking inside the kernel: with uniform
+    sequence shards, every (q-chunk, kv-chunk) pair is statically one of
+    full (kv before q), diagonal (the local causal triangle), or skip
+    (kv after q) — selected per ring step with ``lax.switch`` on the
+    rotating source index.  Chunks merge by logsumexp
+    (:func:`_merge_chunks`); the flash kernel's VJP propagates the
+    merge's lse cotangent, so the whole ring differentiates.
+
+    Args/shapes as :func:`ring_self_attention` ([B, Lc, H, D] shards
+    inside ``shard_map``).
+    """
+    from autodist_tpu.ops.flash_attention import flash_attention_with_lse
+
+    p = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Lc, H, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+    kw = dict(scale=scale, block_q=block_q, block_k=block_k)
+
+    def full_chunk(q, k_blk, v_blk):
+        o, lse = flash_attention_with_lse(q, k_blk, v_blk, causal=False,
+                                          **kw)
+        return o.astype(jnp.float32), lse  # match skip branch under switch
+
+    def diag_chunk(q, k_blk, v_blk):
+        o, lse = flash_attention_with_lse(q, k_blk, v_blk, causal=True,
+                                          **kw)
+        return o.astype(jnp.float32), lse
+
+    def skip_chunk(q, k_blk, v_blk):
+        return (jnp.zeros((B, Lc, H, D), jnp.float32),
+                jnp.full((B, Lc, H), NEG_INF, jnp.float32))
+
+    o0 = jnp.zeros((B, Lc, H, D), jnp.float32)
+    lse0 = jnp.full((B, Lc, H), NEG_INF, jnp.float32)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def body(carry, step):
+        o, lse, k_blk, v_blk = carry
+        if causal:
+            src = (my - step) % p          # owner of this kv block
+            case = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+            o_c, lse_c = lax.switch(
+                case, [full_chunk, diag_chunk, skip_chunk], q, k_blk, v_blk)
+        else:
+            o_c, lse_c = full_chunk(q, k_blk, v_blk)
+        o, lse = _merge_chunks(o, lse, o_c, lse_c)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (o, lse, k_blk, v_blk), None
+
+    (o, _, _, _), _ = lax.scan(body, (o0, lse0, k, v), jnp.arange(p))
+    return o.astype(q.dtype)
+
+
 def make_ring_attention_fn(*, seq_axis: str = "seq", causal: bool = False):
     """Adapter: a ``TransformerConfig.attention_fn`` that runs ring
     attention when traced inside a ``shard_map`` carrying ``seq_axis``."""
@@ -100,19 +178,36 @@ def make_ring_attention_fn(*, seq_axis: str = "seq", causal: bool = False):
     return attention_fn
 
 
+def make_ring_flash_attention_fn(*, seq_axis: str = "seq",
+                                 causal: bool = False, block_q: int = 128,
+                                 block_k: int = 128):
+    """Like :func:`make_ring_attention_fn` with the Pallas flash kernel
+    per chunk — the long-chunk configuration (HBM-bound per-chunk scores
+    are what the fused kernel removes)."""
+
+    def attention_fn(q, k, v, mask, dropout_rng):
+        del mask, dropout_rng
+        return ring_flash_attention(q, k, v, axis_name=seq_axis,
+                                    causal=causal, block_q=block_q,
+                                    block_k=block_k)
+
+    return attention_fn
+
+
 def sequence_sharded_attention(q, k, v, mesh, *, causal=False,
-                               seq_axis="seq", batch_axis=None):
+                               seq_axis="seq", batch_axis=None,
+                               flash=False):
     """Convenience wrapper: shard q/k/v along sequence and run the ring.
 
     Host-level entry (outside shard_map) for testing and for models that
     want sequence parallelism without the full strategy stack.
-    """
+    ``flash=True`` uses the Pallas per-chunk kernel."""
     from jax.sharding import PartitionSpec as P
 
+    ring = ring_flash_attention if flash else ring_self_attention
     spec = P(batch_axis, seq_axis)
     fn = jax.shard_map(
-        functools.partial(ring_self_attention, axis_name=seq_axis,
-                          causal=causal),
+        functools.partial(ring, axis_name=seq_axis, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
