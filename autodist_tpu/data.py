@@ -136,3 +136,146 @@ class DataLoader:
 def synthetic(make_batch: Callable[[int], Any]) -> Callable[[int], Any]:
     """Adapter marking a ``step -> batch`` function as a loader source."""
     return make_batch
+
+
+# --------------------------------------------------------------------------- #
+# Token-file IO (native mmap reader)
+# --------------------------------------------------------------------------- #
+_dio_lib = None
+_dio_lock = threading.Lock()
+
+
+def _load_dio():
+    """Load the native data-IO library (declaring its C signatures once)."""
+    global _dio_lib
+    with _dio_lock:
+        if _dio_lib is not None:
+            return _dio_lib
+        import ctypes
+
+        from autodist_tpu.runtime.nativelib import load_native
+        lib = load_native("libautodist_dataio.so", "dataio.cc")
+        lib.dio_open.restype = ctypes.c_void_p
+        lib.dio_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.dio_num_items.restype = ctypes.c_longlong
+        lib.dio_num_items.argtypes = [ctypes.c_void_p]
+        lib.dio_gather.restype = ctypes.c_int
+        lib.dio_gather.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_int, ctypes.c_longlong,
+                                   ctypes.c_void_p]
+        lib.dio_prefetch.restype = ctypes.c_int
+        lib.dio_prefetch.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_int, ctypes.c_longlong]
+        lib.dio_close.argtypes = [ctypes.c_void_p]
+        _dio_lib = lib
+        return lib
+
+
+class TokenFile:
+    """Random-window reader over a flat binary token array on disk.
+
+    Native path (``runtime/native/dataio.cc``): windows are memcpy'd out
+    of an mmap and upcoming windows are warmed with ``madvise(WILLNEED)``
+    — the counterpart of the reference feeding training through TF's
+    C++ tf.data runtime (SURVEY.md §2.9).  ``native=None`` auto-falls
+    back to a numpy memmap with identical semantics when the C++
+    toolchain is unavailable; ``True`` requires the native path.
+    """
+
+    def __init__(self, path: str, dtype=np.int32, *,
+                 native: Optional[bool] = None):
+        self.path = path
+        self.dtype = np.dtype(dtype)
+        self._lib = None
+        self._h = None
+        self._mm = None
+        if native is None or native:
+            try:
+                import ctypes
+
+                lib = _load_dio()
+                h = lib.dio_open(path.encode(), self.dtype.itemsize)
+                if not h:
+                    raise OSError(f"dio_open failed for {path!r} "
+                                  "(missing/empty, or size not a multiple "
+                                  f"of itemsize {self.dtype.itemsize})")
+                self._lib, self._h = lib, ctypes.c_void_p(h)
+                import weakref
+
+                weakref.finalize(self, lib.dio_close, self._h)
+            except Exception:
+                if native:  # explicitly requested — do not mask
+                    raise
+        if self._h is None:
+            self._mm = np.memmap(path, dtype=self.dtype, mode="r")
+
+    def __len__(self) -> int:
+        if self._h is not None:
+            return int(self._lib.dio_num_items(self._h))
+        return len(self._mm)
+
+    def gather(self, offsets, window: int) -> np.ndarray:
+        """``[n, window]`` array of the windows starting at ``offsets``."""
+        offsets = np.ascontiguousarray(offsets, np.int64)
+        out = np.empty((len(offsets), window), self.dtype)
+        if self._h is not None:
+            import ctypes
+
+            rc = self._lib.dio_gather(
+                self._h, offsets.ctypes.data_as(ctypes.c_void_p),
+                len(offsets), window,
+                out.ctypes.data_as(ctypes.c_void_p))
+            if rc != 0:
+                raise IndexError(
+                    f"window out of bounds (file has {len(self)} items)")
+            return out
+        n = len(self._mm)
+        for i, off in enumerate(offsets):
+            # off > n - window, not off + window > n: the sum can wrap
+            # int64 for adversarial offsets.
+            if off < 0 or window > n or off > n - window:
+                raise IndexError(
+                    f"window out of bounds (file has {n} items)")
+            out[i] = self._mm[off:off + window]
+        return out
+
+    def prefetch(self, offsets, window: int) -> None:
+        """Warm the page cache for upcoming windows (no-op on the numpy
+        fallback — the OS readahead is all it has)."""
+        if self._h is not None:
+            import ctypes
+
+            offsets = np.ascontiguousarray(offsets, np.int64)
+            self._lib.dio_prefetch(
+                self._h, offsets.ctypes.data_as(ctypes.c_void_p),
+                len(offsets), window)
+
+
+def lm_window_loader(path: str, *, batch_size: int, seq_len: int,
+                     dtype=np.int32, seed: int = 0,
+                     native: Optional[bool] = None
+                     ) -> Callable[[int], Any]:
+    """``step -> {"x", "y"}`` source over random windows of a token file
+    (``y`` is ``x`` shifted one token).  Batch t+1's pages are prefetched
+    while batch t is being consumed; feed through :class:`DataLoader`
+    for the device-side half of the pipeline."""
+    tokens = TokenFile(path, dtype, native=native)
+    n = len(tokens)
+    if n < seq_len + 1:
+        raise ValueError(f"{path!r} has {n} tokens < seq_len+1")
+    rng = np.random.RandomState(seed)
+    pending: list[np.ndarray] = []
+
+    def sample():
+        return rng.randint(0, n - seq_len, size=batch_size).astype(np.int64)
+
+    def source(step: int):
+        offs = pending.pop() if pending else sample()
+        nxt = sample()
+        pending.append(nxt)
+        tokens.prefetch(nxt, seq_len + 1)
+        w = tokens.gather(offs, seq_len + 1)
+        return {"x": np.ascontiguousarray(w[:, :-1]),
+                "y": np.ascontiguousarray(w[:, 1:])}
+
+    return source

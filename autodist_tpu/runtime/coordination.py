@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 import weakref
 from typing import Optional
@@ -30,32 +29,17 @@ from typing import Optional
 from autodist_tpu import const
 from autodist_tpu.utils import logging
 
-_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libautodist_coord.so")
-_SRC_PATH = os.path.join(_NATIVE_DIR, "coord.cc")
-
-_build_lock = threading.Lock()
 _lib = None
 
 OK, TIMEOUT, ERROR = 0, 1, 2
-
-
-def _ensure_built() -> str:
-    """Compile the native library if missing or older than its source."""
-    with _build_lock:
-        if (not os.path.exists(_LIB_PATH)
-                or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC_PATH)):
-            logging.info("building native coordination library in %s",
-                         _NATIVE_DIR)
-            subprocess.run(["make", "-s"], cwd=_NATIVE_DIR, check=True)
-    return _LIB_PATH
 
 
 def _load():
     global _lib
     if _lib is not None:
         return _lib
-    lib = ctypes.CDLL(_ensure_built())
+    from autodist_tpu.runtime.nativelib import load_native
+    lib = load_native("libautodist_coord.so", "coord.cc")
     lib.coord_server_start.restype = ctypes.c_void_p
     lib.coord_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                        ctypes.c_char_p]

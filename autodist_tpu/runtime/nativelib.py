@@ -1,0 +1,41 @@
+"""Shared loader for the native C++ libraries in ``runtime/native/``.
+
+One build-if-stale-then-CDLL bootstrap (each binding used to carry its
+own copy): build the *explicit* make target for the requested library —
+never the default target, so one library's missing source can't break
+another's build — then load it.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from autodist_tpu.utils import logging
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+
+_lock = threading.Lock()
+_loaded: dict[str, ctypes.CDLL] = {}
+
+
+def load_native(lib_name: str, src_name: str) -> ctypes.CDLL:
+    """``load_native("libautodist_coord.so", "coord.cc")`` — compile via
+    ``make -s <lib_name>`` when the .so is missing or older than its
+    source, then ``CDLL`` it (cached per process)."""
+    with _lock:
+        if lib_name in _loaded:
+            return _loaded[lib_name]
+        lib_path = os.path.join(NATIVE_DIR, lib_name)
+        src_path = os.path.join(NATIVE_DIR, src_name)
+        if (not os.path.exists(lib_path)
+                or (os.path.exists(src_path)
+                    and os.path.getmtime(lib_path)
+                    < os.path.getmtime(src_path))):
+            logging.info("building native library %s", lib_name)
+            subprocess.run(["make", "-s", lib_name], cwd=NATIVE_DIR,
+                           check=True)
+        lib = ctypes.CDLL(lib_path)
+        _loaded[lib_name] = lib
+        return lib

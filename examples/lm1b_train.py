@@ -28,6 +28,9 @@ def main():
     ap.add_argument("--seq-len", type=int, default=20)
     ap.add_argument("--vocab-size", type=int, default=10_000)
     ap.add_argument("--strategy", default="Parallax")
+    ap.add_argument("--data", default=None,
+                    help="flat binary int32 token file (native mmap "
+                         "reader); default: synthetic tokens")
     args = ap.parse_args()
 
     trainable = make_lm1b_trainable(
@@ -36,12 +39,30 @@ def main():
         batch_size=args.batch_size)
     runner = AutoDist({}, args.strategy).build(trainable)
 
-    rng = np.random.RandomState(0)
+    if args.data:
+        from autodist_tpu.data import lm_window_loader
+        raw = lm_window_loader(args.data, batch_size=args.batch_size,
+                               seq_len=args.seq_len, seed=0)
+
+        def source(step):
+            b = raw(step)
+            if step == 0:  # gather clamps silently; fail loudly instead
+                hi = max(int(b["x"].max()), int(b["y"].max()))
+                if hi >= args.vocab_size:
+                    raise SystemExit(
+                        f"--data contains token id {hi} >= --vocab-size "
+                        f"{args.vocab_size}; pass the tokenizer's size")
+            return b
+    else:
+        rng = np.random.RandomState(0)
+
+        def source(step):
+            x = rng.randint(0, args.vocab_size,
+                            (args.batch_size, args.seq_len)).astype(np.int32)
+            return {"x": x, "y": np.roll(x, -1, axis=1)}
+
     for step in range(args.steps):
-        x = rng.randint(0, args.vocab_size,
-                        (args.batch_size, args.seq_len)).astype(np.int32)
-        y = np.roll(x, -1, axis=1)
-        metrics = runner.step({"x": x, "y": y})
+        metrics = runner.step(source(step))
         if step % 5 == 0 or step == args.steps - 1:
             print(f"step {step}: loss={float(np.asarray(metrics['loss'])):.4f}")
 

@@ -28,6 +28,8 @@ def main():
                     help="seq-axis size (default: all devices)")
     ap.add_argument("--hidden", type=int, default=128)
     ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--flash", action="store_true",
+                    help="Pallas flash kernel per ring chunk")
     args = ap.parse_args()
 
     import jax
@@ -62,8 +64,14 @@ def main():
                 B, Ll, 3, heads, H // heads)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
             if self.sharded:
-                o = ring_self_attention(q, k, v, axis_name="seq",
-                                        causal=True)
+                if args.flash:
+                    from autodist_tpu.parallel.ring_attention import (
+                        ring_flash_attention)
+                    o = ring_flash_attention(q, k, v, axis_name="seq",
+                                             causal=True)
+                else:
+                    o = ring_self_attention(q, k, v, axis_name="seq",
+                                            causal=True)
             else:  # init-time trace outside the mesh
                 s = jnp.einsum("blhd,bmhd->bhlm", q, k) / np.sqrt(
                     H // heads)
