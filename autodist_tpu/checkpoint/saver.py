@@ -35,17 +35,29 @@ class Saver:
     """Save/restore for :class:`~autodist_tpu.runner.DistributedRunner`
     state (≙ reference ``autodist.checkpoint.saver.Saver``)."""
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, *, async_save: bool = False):
+        """``async_save=True`` returns from :meth:`save` as soon as state
+        is staged off the devices (Orbax copies device→host synchronously,
+        then commits to disk in background), so checkpointing overlaps the
+        next training steps — safe with buffer donation, since the staged
+        copy no longer aliases device memory.  :meth:`wait` (or the next
+        save/restore/close) joins the in-flight write."""
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
+        self._async = async_save
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=5,
                                                  create=True))
 
     # ------------------------------------------------------------------ #
-    def save(self, runner, *, portable: bool = False, force: bool = False):
-        """Write a checkpoint at the runner's current step."""
+    def save(self, runner, *, portable: bool = False, force: bool = False,
+             blocking: Optional[bool] = None):
+        """Write a checkpoint at the runner's current step.
+
+        ``blocking`` overrides the constructor's ``async_save`` for this
+        call (the preemption hook forces ``blocking=True`` — the process
+        is about to die)."""
         step = runner.step_count
         if portable:
             # Host arrays: the portable layout is sharding-free on disk
@@ -61,17 +73,28 @@ class Saver:
         payload = {k: v for k, v in payload.items() if v is not None}
         self._mgr.save(step, args=ocp.args.StandardSave(payload),
                        force=force)
-        self._mgr.wait_until_finished()
-        logging.info("checkpoint step %d saved to %s (portable=%s)",
-                     step, self.directory, portable)
+        block = (not self._async) if blocking is None else blocking
+        if block:
+            self._mgr.wait_until_finished()
+            logging.info("checkpoint step %d saved to %s (portable=%s)",
+                         step, self.directory, portable)
+        else:  # commit still in flight — "saved" would be premature
+            logging.info("checkpoint step %d staged (async) for %s "
+                         "(portable=%s)", step, self.directory, portable)
         return step
 
+    def wait(self):
+        """Join any in-flight async save (no-op when idle)."""
+        self._mgr.wait_until_finished()
+
     def latest_step(self) -> Optional[int]:
+        self._mgr.wait_until_finished()
         return self._mgr.latest_step()
 
     def restore(self, runner, step: Optional[int] = None):
         """Restore into the runner's layout (same strategy/mesh —
         exact resume including optimizer state)."""
+        self.wait()  # an explicit step may name an in-flight async save
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
@@ -90,6 +113,7 @@ class Saver:
     def restore_params(self, step: Optional[int] = None) -> dict:
         """Load a portable checkpoint as plain host arrays (≙ restoring an
         AutoDist checkpoint into vanilla single-node TF)."""
+        self.wait()  # an explicit step may name an in-flight async save
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
@@ -133,7 +157,8 @@ class Saver:
                 "signal %d: writing preemption checkpoint at step %d",
                 signum, runner.step_count)
             try:
-                self.save(runner, portable=portable, force=True)
+                self.save(runner, portable=portable, force=True,
+                          blocking=True)
             finally:
                 prev = previous.get(signum)
                 if callable(prev):

@@ -115,3 +115,29 @@ raise SystemExit("signal did not terminate the process")
     runner2 = AutoDist({}, PS()).build(make_trainable())
     saver.restore(runner2)
     assert runner2.step_count == 2
+
+
+def test_async_save_snapshot_is_donation_safe(tmp_path):
+    """Async save must capture the state *at save time*: training
+    continues immediately after save() (donating/reusing the state
+    buffers), yet the restored checkpoint equals the pre-continuation
+    snapshot."""
+    runner = train_some(AllReduce(), steps=2)
+    snapshot = jax.device_get(runner.get_params())
+    step_at_save = runner.step_count
+
+    saver = Saver(str(tmp_path), async_save=True)
+    saver.save(runner)                       # returns before disk commit
+    for s in range(3):                       # donated buffers get reused
+        runner.step(make_batch(10 + s))
+
+    runner2 = AutoDist({}, AllReduce()).build(make_trainable())
+    # explicit step naming the (possibly still in-flight) async save must
+    # join the commit, not race it
+    saver.restore(runner2, step=step_at_save)
+    assert saver.latest_step() == step_at_save
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), runner2.get_params(), snapshot)
+    # and the restored runner resumes from the saved step, not the later one
+    assert runner2.step_count == step_at_save
+    saver.close()
