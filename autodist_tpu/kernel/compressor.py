@@ -17,8 +17,11 @@ instance state).
 """
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -30,6 +33,12 @@ class Compressor:
 
     def init_state(self, leaf):
         return None
+
+    # Flat-state API used by the bucketed lowering: per-bucket state is
+    # one flat fp32 vector per device (EF residual; PowerSGD additionally
+    # packs its warm-started Q behind the residual).
+    def init_state_flat(self, total: int) -> np.ndarray:
+        return np.zeros(total, np.float32)
 
     def allreduce(self, grad, state, axis_name):
         return lax.pmean(grad, axis_name), state
@@ -44,13 +53,22 @@ class Compressor:
             Compressor._registry[cls.name] = cls
 
     @classmethod
+    def parse_arg(cls, arg: str) -> dict:
+        raise ValueError(
+            f"compressor {cls.name!r} takes no ':{arg}' argument")
+
+    @classmethod
     def create(cls, name: str, **kw) -> "Compressor":
         if name in ("", "none", None):
             return Compressor()
-        if name not in cls._registry:
+        base, _, arg = name.partition(":")
+        if base not in cls._registry:
             raise ValueError(
                 f"unknown compressor {name!r}; have {sorted(cls._registry)}")
-        return cls._registry[name](**kw)
+        sub = cls._registry[base]
+        if arg:
+            kw = {**kw, **sub.parse_arg(arg)}
+        return sub(**kw)
 
 
 class CastCompressor(Compressor):
@@ -108,6 +126,89 @@ class BF16EFCompressor(_ErrorFeedback):
 
     def _wire(self, x):
         return x.astype(jnp.bfloat16)
+
+
+def _orthonormalize(p, rel_eps=1e-5):
+    """Modified Gram-Schmidt over the (few) columns of ``p``.
+
+    A column whose post-projection norm collapses relative to its
+    pre-projection norm is linearly dependent on the earlier ones (the
+    gradient matrix has rank < r): normalizing it would amplify fp
+    residue into a unit junk direction that is *not* orthogonal, so the
+    column is zeroed instead — a zero column simply contributes nothing
+    to the approximation."""
+    cols = []
+    for i in range(p.shape[1]):
+        c0 = p[:, i]
+        c = c0
+        for cj in cols:
+            c = c - jnp.dot(cj, c) * cj
+        norm = jnp.linalg.norm(c)
+        keep = norm > rel_eps * (jnp.linalg.norm(c0) + 1e-30)
+        cols.append(jnp.where(keep, c / jnp.maximum(norm, 1e-30), 0.0))
+    return jnp.stack(cols, axis=1)
+
+
+class PowerSGDCompressor(Compressor):
+    """Rank-``r`` PowerSGD with error feedback and warm-started Q
+    (Vogels et al., NeurIPS'19) — a *working* realization of the
+    reference's commented-out PowerSGD (``compressor.py:208-284``).
+
+    The flat bucket reshapes to a ~square [n, m] matrix; one power-
+    iteration step with the previous Q produces a rank-r factorization of
+    the *mean* gradient: ``P = mean(M·Q)`` (orthonormalized), ``Q' =
+    mean(Mᵀ·P)``, approx ``= P·Q'ᵀ``.  Wire bytes per step: ``(n + m)·r``
+    instead of ``n·m`` — the aggressive-compression slot for DCN-bound
+    multi-slice training, where int8's 4× is not enough.  The local
+    quantization error (``corrected − approx``) feeds back next step;
+    warm-starting Q makes the power iteration converge across steps.
+
+    Name form ``powersgd`` (rank 2) or ``powersgd:<rank>``.
+    """
+
+    name = "powersgd"
+    stateful = True
+
+    def __init__(self, rank: int = 2):
+        if rank < 1:
+            raise ValueError("powersgd rank must be >= 1")
+        self.rank = rank
+
+    @classmethod
+    def parse_arg(cls, arg: str) -> dict:
+        return {"rank": int(arg)}
+
+    @staticmethod
+    def _dims(total: int) -> tuple[int, int]:
+        nrow = max(1, math.isqrt(max(total - 1, 0)) + 1)  # ceil(sqrt)
+        return nrow, -(-total // nrow)
+
+    def init_state_flat(self, total: int) -> np.ndarray:
+        _, m = self._dims(total)
+        # Deterministic start (same on every device — Q stays replicated
+        # because its update is a pmean); any generic matrix works.
+        rng = np.random.RandomState(total % (2**31 - 1))
+        q = rng.randn(m, self.rank).astype(np.float32)
+        q /= np.maximum(np.linalg.norm(q, axis=0, keepdims=True), 1e-8)
+        return np.concatenate([np.zeros(total, np.float32), q.reshape(-1)])
+
+    def init_state(self, leaf):
+        return jnp.asarray(self.init_state_flat(max(int(np.prod(leaf.shape)), 1)))
+
+    def allreduce(self, grad, state, axis_name):
+        shape, dtype = grad.shape, grad.dtype
+        flat = grad.astype(jnp.float32).reshape(-1)
+        total = flat.shape[0]
+        nrow, m = self._dims(total)
+        residual, q = state[:total], state[total:].reshape(m, self.rank)
+        corrected = flat + residual
+        mat = jnp.pad(corrected, (0, nrow * m - total)).reshape(nrow, m)
+        p = lax.pmean(mat @ q, axis_name)          # wire: nrow * r
+        p = _orthonormalize(p)
+        q = lax.pmean(mat.T @ p, axis_name)        # wire: m * r
+        approx = (p @ q.T).reshape(-1)[:total]
+        new_state = jnp.concatenate([corrected - approx, q.reshape(-1)])
+        return approx.reshape(shape).astype(dtype), new_state
 
 
 class Int8EFCompressor(_ErrorFeedback):

@@ -270,17 +270,18 @@ def _opt_state_specs(plan: Plan, trainable: Trainable, n: int):
     return jax.tree_util.tree_map_with_path(spec_for, opt_shapes), opt_shapes
 
 
-def _sync_state_shapes(plan: Plan, trainable: Trainable, n: int):
-    """Global shapes for compressor (error-feedback) state: one residual
-    per bucket with a leading device axis (per-device local state)."""
-    sizes = {}
+def _sync_state_init(plan: Plan, trainable: Trainable):
+    """Per-bucket compressor-state init rows (device axis added at init):
+    the EF residual, plus whatever the compressor packs behind it
+    (PowerSGD's warm-started Q)."""
+    rows = {}
     by_name = {v.name: v for v in trainable.var_infos()}
     for key, names in plan.buckets.items():
         comp = Compressor.create(plan.bucket_compressor.get(key, "none"))
         if comp.stateful:
             total = sum(by_name[nm].size for nm in names)
-            sizes[key] = (n, total)
-    return sizes
+            rows[key] = np.asarray(comp.init_state_flat(total), np.float32)
+    return rows
 
 
 # --------------------------------------------------------------------------- #
@@ -365,14 +366,14 @@ def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
 
     p_specs = _params_specs(plan, trainable.params)
     o_specs, _ = _opt_state_specs(plan, trainable, n)
-    sync_shapes = _sync_state_shapes(plan, trainable, n)
+    sync_init = _sync_state_init(plan, trainable)
     extra_specs = jax.tree.map(lambda _: P(), trainable.extra)
     state_specs = {
         "step": P(),
         "params": p_specs,
         "opt_state": o_specs,
         "extra": extra_specs,
-        "sync_state": {k: P(data_axis) for k in sync_shapes},
+        "sync_state": {k: P(data_axis) for k in sync_init},
     }
     state_shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), state_specs,
@@ -393,8 +394,8 @@ def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
         params_store = common.tree_from_names(params, store)
         u_params = _update_space(plan, jax.tree.map(jnp.asarray, params), n)
         opt_state = opt.init(u_params)
-        sync_state = {k: jnp.zeros(shp, jnp.float32)
-                      for k, shp in sync_shapes.items()}
+        sync_state = {k: jnp.tile(jnp.asarray(row)[None], (n, 1))
+                      for k, row in sync_init.items()}
         return {
             "step": jnp.zeros((), jnp.int32),
             "params": params_store,

@@ -34,6 +34,9 @@ COMPRESSOR_FACTOR = {
     "fp16": 0.5, "bf16": 0.5,
     "fp16_ef": 0.5, "bf16_ef": 0.5,
     "int8_ef": 0.25,
+    # (n + m)·r vs n·m bytes, ~2r/sqrt(total): a static stand-in for a
+    # data-dependent ratio; at BERT-scale buckets it is ≲ 0.01.
+    "powersgd": 0.02,
 }
 
 
@@ -174,7 +177,8 @@ class CostModel:
             sharded = node.partitioner is not None
             sync = node.synchronizer
             factor = COMPRESSOR_FACTOR.get(
-                getattr(sync, "compressor", "none"), 1.0)
+                (getattr(sync, "compressor", "none") or "none")
+                .partition(":")[0], 1.0)
             # Touched-rows pricing only applies when the lowering actually
             # takes the sparse path: PS + vocab(axis-0) partitioning
             # (lowering.py make_plan's sparse_lookup gate).

@@ -45,7 +45,8 @@ class AllReduceSynchronizer:
     """
 
     kind: str = "allreduce"
-    compressor: str = "none"     # none | fp16 | bf16 | fp16_ef | bf16_ef | int8_ef
+    compressor: str = "none"     # none | fp16 | bf16 | fp16_ef | bf16_ef
+                                 # | int8_ef | powersgd[:rank]
     group: int = 0               # bucket id for flatten-concat merging
 
     def to_dict(self):
