@@ -5,9 +5,10 @@ Counterpart of the reference ``Compressor`` hierarchy
 (identity, ``compressor.py:146-166``), ``HorovodCompressor`` (fp-cast,
 ``compressor.py:169-201``), ``HorovodCompressorEF`` (error feedback,
 ``compressor.py:120-143``).  The reference's commented-out PowerSGD
-(``compressor.py:208-284``) is realized here as an int8 shared-scale
-quantized allreduce (EQuARX-style, PAPERS.md 2506.17615) — a strictly
-stronger replacement that works on ICI.
+(``compressor.py:208-284``) is covered twice over: an int8 shared-scale
+quantized allreduce (EQuARX-style, PAPERS.md 2506.17615) fills the 4x
+slot on ICI, and :class:`PowerSGDCompressor` is a *working* rank-r
+PowerSGD for the ~100x DCN-bound slot.
 
 Compressors run *inside* ``shard_map``: ``allreduce(grad, state, axis)``
 returns the averaged gradient and new per-device compressor state (error
@@ -218,8 +219,8 @@ class Int8EFCompressor(_ErrorFeedback):
     summable.  The psum wire dtype is fp16: integer levels in [-127, 127]
     are exact in fp16, and sums stay exact up to 2048 — i.e. ≥16 replicas —
     at half the fp32 wire width.  (EQuARX-style, PAPERS.md 2506.17615;
-    replaces the reference's dead PowerSGD code path.  A true int8-wire
-    ring allreduce is a Pallas-kernel follow-up.)
+    for compression beyond 4x see :class:`PowerSGDCompressor`.  A true
+    int8-wire ring allreduce is a Pallas-kernel follow-up.)
     """
 
     name = "int8_ef"

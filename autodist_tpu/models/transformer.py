@@ -35,6 +35,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False
     attention_fn: Optional[Callable] = None  # (q, k, v, mask, dropout_rng) -> out
+    # (local_len) -> position ids; None = arange.  Sequence-parallel
+    # models pass parallel.sequence.global_positions so shards embed
+    # their true offsets instead of restarting at 0.
+    position_fn: Optional[Callable] = None
     causal: bool = False
 
     @property
@@ -138,7 +142,9 @@ class TransformerLM(nn.Module):
         pos_embed = self.param(
             "pos_embed", nn.initializers.normal(0.02),
             (cfg.max_len, cfg.hidden_size), jnp.float32)
-        x = embed(tokens) + pos_embed[None, :L].astype(cfg.dtype)
+        pos = (pos_embed[cfg.position_fn(L)] if cfg.position_fn is not None
+               else pos_embed[:L])
+        x = embed(tokens) + pos[None].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
         causal = nn.make_causal_mask(tokens, dtype=jnp.bool_)
         x = Encoder(cfg, name="encoder")(x, causal, deterministic)
