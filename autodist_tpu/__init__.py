@@ -22,10 +22,11 @@ from autodist_tpu.strategy.builders import (AllReduce, GradAccumulation,
                                             UnevenPartitionedPS, ZeRO)
 from autodist_tpu.strategy.ir import Strategy
 from autodist_tpu.simulator import AutoStrategy
+from autodist_tpu.train import fit
 
 __all__ = [
     "AutoDist", "Trainable", "VarInfo", "ResourceSpec", "DistributedRunner",
     "Strategy", "AllReduce", "PS", "PSLoadBalancing", "PartitionedPS",
     "UnevenPartitionedPS", "PartitionedAR", "RandomAxisPartitionAR",
-    "Parallax", "ZeRO", "AutoStrategy", "GradAccumulation",
+    "Parallax", "ZeRO", "AutoStrategy", "GradAccumulation", "fit",
 ]

@@ -37,7 +37,10 @@ class TransformerConfig:
     attention_fn: Optional[Callable] = None  # (q, k, v, mask, dropout_rng) -> out
     # (local_len) -> position ids; None = arange.  Sequence-parallel
     # models pass parallel.sequence.global_positions so shards embed
-    # their true offsets instead of restarting at 0.
+    # their true offsets instead of restarting at 0.  max_len must cover
+    # the GLOBAL sequence (shards x local_len): ids beyond it clamp in
+    # the gather — silently wrong embeddings, unlike the default slice
+    # path which fails loudly on a shape mismatch.
     position_fn: Optional[Callable] = None
     causal: bool = False
 

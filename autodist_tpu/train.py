@@ -1,0 +1,121 @@
+"""High-level training loop: the reference's Keras ``Model.fit`` tier.
+
+The reference's integration case c7 drove training through
+``Model.fit``/``evaluate`` on top of the distributed session
+(``tests/integration/cases/c7.py``); :func:`fit` is that convenience for
+this framework — loader prefetch, periodic eval, periodic/final
+checkpointing, throughput logging, and preemption-safe resume in one
+call, all composed from the public pieces (``DataLoader``, ``Saver``,
+``runner.step/evaluate``).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+from autodist_tpu.utils import logging
+
+
+def fit(runner, source: Iterable | Callable[[int], Any], *,
+        steps: int,
+        eval_source: Optional[Iterable | Callable[[int], Any]] = None,
+        eval_every: int = 0, eval_batches: int = 10,
+        saver=None, save_every: int = 0,
+        resume: bool = True,
+        log_every: int = 100,
+        prefetch: int = 2) -> dict:
+    """Train ``runner`` for ``steps`` optimizer steps.
+
+    Args:
+      runner: a built runner (``AutoDist(...).build(trainable)``).
+      source: host-batch source — an iterable, or ``step -> batch``.
+      eval_source / eval_every / eval_batches: run
+        ``runner.evaluate`` over ``eval_batches`` batches every
+        ``eval_every`` steps (0 = never).  Pass a callable or a
+        re-iterable (e.g. a list) — a one-shot iterator is exhausted
+        after the first eval round.
+      saver: a :class:`~autodist_tpu.checkpoint.Saver`; when given, a
+        final checkpoint is always written, plus one every
+        ``save_every`` steps (0 = final only).  With ``resume=True``
+        training continues from the saver's latest step — restarted
+        preempted jobs pick up where they left off.
+      log_every: throughput/loss log cadence (0 = silent).
+      prefetch: device-prefetch depth (see :class:`DataLoader`).
+
+    Returns a history dict: ``{"steps", "loss", "eval", "examples_per_sec"}``.
+    """
+    from autodist_tpu.data import DataLoader
+
+    if saver is not None and resume and saver.latest_step() is not None:
+        saver.restore(runner)
+        logging.info("fit: resumed at step %d", runner.step_count)
+    start = runner.step_count
+    remaining = steps - start
+    history: dict[str, Any] = {"steps": steps, "loss": [], "eval": [],
+                               "examples_per_sec": 0.0}
+    if remaining <= 0:
+        logging.info("fit: nothing to do (at step %d >= %d)", start, steps)
+        return history
+
+    if callable(source) and start:
+        # Resumed jobs continue the data stream, not replay it; iterable
+        # sources are consumed wherever they stand and are the caller's
+        # responsibility to fast-forward.
+        inner = source
+        source = lambda i: inner(start + i)  # noqa: E731
+    loader = iter(DataLoader(source, runner.mesh, buffer_size=prefetch,
+                             num_batches=remaining))
+    import time
+
+    t0 = time.perf_counter()
+    examples = window_examples = 0
+    t_window = t0
+    for batch in loader:
+        metrics = runner.step(batch)
+        step = runner.step_count
+        bsz = _batch_size(batch)
+        examples += bsz
+        window_examples += bsz
+        if log_every and step % log_every == 0:
+            loss = float(np.asarray(metrics.get("loss", np.nan)))
+            dt = time.perf_counter() - t_window
+            rate = window_examples / dt if dt > 0 else float("nan")
+            history["loss"].append((step, loss))
+            logging.info("fit: step %d loss %.4f (%.1f examples/s)",
+                         step, loss, rate)
+            window_examples, t_window = 0, time.perf_counter()
+        if eval_every and eval_source is not None and step % eval_every == 0:
+            ev = runner.evaluate(_iter_source(eval_source, eval_batches),
+                                 num_batches=eval_batches)
+            if not ev:
+                logging.warning(
+                    "fit: eval at step %d saw no batches — a one-shot "
+                    "iterator eval_source is exhausted; pass a callable "
+                    "or a re-iterable (list)", step)
+            history["eval"].append((step, ev))
+            logging.info("fit: step %d eval %s", step,
+                         {k: round(float(v), 4) for k, v in ev.items()})
+        if saver is not None and save_every and step % save_every == 0:
+            saver.save(runner)
+
+    if saver is not None and saver.latest_step() != runner.step_count:
+        saver.save(runner, force=True)
+    total = time.perf_counter() - t0
+    history["examples_per_sec"] = examples / total if total > 0 else 0.0
+    return history
+
+
+def _batch_size(batch) -> int:
+    import jax
+
+    for leaf in jax.tree.leaves(batch):
+        if np.ndim(leaf) > 0:
+            return int(np.shape(leaf)[0])
+    return 0
+
+
+def _iter_source(source, n: int):
+    if callable(source):
+        return (source(i) for i in range(n))
+    return source
