@@ -20,6 +20,8 @@ from autodist_tpu.strategy.builders import (AllReduce, GradAccumulation,
                                             PSLoadBalancing,
                                             RandomAxisPartitionAR,
                                             UnevenPartitionedPS, ZeRO)
+from autodist_tpu.strategy.gspmd_builders import (FSDPSharded, Sharded,
+                                                  TensorParallel)
 from autodist_tpu.strategy.ir import Strategy
 from autodist_tpu.simulator import AutoStrategy
 from autodist_tpu.train import fit
@@ -29,4 +31,5 @@ __all__ = [
     "Strategy", "AllReduce", "PS", "PSLoadBalancing", "PartitionedPS",
     "UnevenPartitionedPS", "PartitionedAR", "RandomAxisPartitionAR",
     "Parallax", "ZeRO", "AutoStrategy", "GradAccumulation", "fit",
+    "Sharded", "TensorParallel", "FSDPSharded",
 ]

@@ -22,6 +22,7 @@ def default_candidates() -> list[StrategyBuilder]:
 
     return [
         _builders.AllReduce(),
+        _builders.AllReduce(chunk_size=512),   # reference's large-model default
         _builders.AllReduce(compressor="bf16"),
         _builders.PSLoadBalancing(),
         _builders.PartitionedPS(),
@@ -82,8 +83,11 @@ class AutoStrategy(StrategyBuilder):
         self.measured = {}
         self._winner_runner = None
         self._winner_strategy_id = None
+        import json
+
         scored = []
         seen_names: dict[str, int] = {}
+        seen_content: set[str] = set()
         for builder in self.candidates:
             name = type(builder).__name__
             # Two configs of one builder class (e.g. AllReduce with and
@@ -96,6 +100,18 @@ class AutoStrategy(StrategyBuilder):
             except ValueError as e:
                 logging.debug("candidate %s skipped: %s", name, e)
                 continue
+            # Distinct configs can emit byte-identical strategies (e.g.
+            # two AllReduce chunk sizes on a model with few tensors):
+            # keep only the first, so measurement slots never time the
+            # same compiled program twice.
+            content = json.dumps([n.to_dict() for n in strategy.node_configs]
+                                 + [strategy.graph_config.to_dict()],
+                                 sort_keys=True)
+            if content in seen_content:
+                logging.debug("candidate %s skipped: identical strategy",
+                              name)
+                continue
+            seen_content.add(content)
             try:
                 cost = model.strategy_cost(trainable, strategy)
             except SpecMeshMismatch as e:

@@ -130,6 +130,7 @@ def main():
     dt = time.perf_counter() - t0
     tokens_per_sec = args.batch_size * L * args.steps / dt
     print(f"long-context: seq={L} dp={dp} sp={sp} "
+          f"attn={'flash' if args.flash else 'einsum'} "
           f"loss={loss:.4f} tokens/s={tokens_per_sec:,.0f}")
 
 

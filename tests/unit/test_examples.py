@@ -80,3 +80,11 @@ def test_long_context_sequence_parallel():
                      "--seq-len", "64", "--seq-parallel", "4",
                      "--hidden", "32", "--layers", "1", timeout=300)
     assert "long-context" in out and "sp=4" in out
+
+
+def test_long_context_ring_flash():
+    out = run_script("examples/long_context.py", "--steps", "2",
+                     "--seq-len", "64", "--seq-parallel", "4",
+                     "--hidden", "32", "--layers", "1", "--flash",
+                     timeout=300)
+    assert "attn=flash" in out and "sp=4" in out
