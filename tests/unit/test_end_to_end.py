@@ -194,3 +194,20 @@ def test_control_flow_model_matches_single_device(name, builder):
         lambda a, e: np.testing.assert_allclose(
             np.asarray(a), np.asarray(e), rtol=2e-6, atol=2e-6),
         runner.get_params(), jax.device_get(expected))
+
+
+def test_rerun_bit_identical_determinism():
+    """§5.2 invariant: rebuilding and rerunning the same (trainable,
+    strategy, data) is bit-identical — no nondeterministic collectives,
+    no uninitialized state, stable device order."""
+    import jax
+
+    def run():
+        runner = AutoDist({}, Parallax()).build(make_trainable(seed=3))
+        for s in range(3):
+            runner.step(make_batch(s))
+        return runner.get_params()
+
+    a, b = run(), run()
+    jax.tree.map(lambda x, y: np.testing.assert_array_equal(
+        np.asarray(x), np.asarray(y)), a, b)
