@@ -200,8 +200,6 @@ def test_rerun_bit_identical_determinism():
     """§5.2 invariant: rebuilding and rerunning the same (trainable,
     strategy, data) is bit-identical — no nondeterministic collectives,
     no uninitialized state, stable device order."""
-    import jax
-
     def run():
         runner = AutoDist({}, Parallax()).build(make_trainable(seed=3))
         for s in range(3):
