@@ -141,3 +141,29 @@ def test_async_save_snapshot_is_donation_safe(tmp_path):
     # and the restored runner resumes from the saved step, not the later one
     assert runner2.step_count == step_at_save
     saver.close()
+
+
+def test_portable_restore_elastic_mesh_shrink(tmp_path):
+    """Elasticity: a portable checkpoint from an 8-device run restores
+    into a 4-device runner (different mesh size AND strategy) and
+    continues training — the restart path after losing capacity."""
+    runner8 = AutoDist({"topology": {"num_devices": 8}},
+                       PartitionedPS()).build(make_trainable())
+    for s in range(2):
+        runner8.step(make_batch(s))
+    expect = runner8.get_params()
+    saver = Saver(str(tmp_path))
+    saver.save(runner8, portable=True)
+
+    runner4 = AutoDist({"topology": {"num_devices": 4}},
+                       AllReduce()).build(make_trainable(seed=9))
+    saver.restore_portable(runner4)
+    assert runner4.step_count == 2
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-6),
+        runner4.get_params(), expect)
+    # and it trains on the smaller mesh (batch must divide 4 now)
+    b = make_batch(5)
+    m = runner4.step(b)
+    assert np.isfinite(float(np.asarray(m["loss"])))
+    saver.close()
