@@ -212,6 +212,99 @@ class PowerSGDCompressor(Compressor):
         return approx.reshape(shape).astype(dtype), new_state
 
 
+class Int8RingCompressor(Compressor):
+    """TRUE int8-wire allreduce: a hand-built ``ppermute`` ring with
+    per-hop requantization (EQuARX's block-quantized ring, PAPERS.md
+    2506.17615) — every byte on the fabric is int8 (+1 fp32 scale per
+    chunk per hop), unlike :class:`Int8EFCompressor` whose psum rides an
+    fp16 wire.
+
+    Phase 1, ring reduce-scatter (p−1 hops): each hop dequantizes the
+    incoming partial chunk, adds the local fp32 contribution, requantizes
+    and forwards; after p−1 hops device d holds the full fp32 sum of
+    chunk (d+1) mod p.  Phase 2, ring all-gather (p−1 hops): the owned
+    chunk is quantized once and circulated.  Error feedback keeps each
+    device's *own* first-quantization error as next step's residual
+    (per-hop requantization noise is unattributable and grows ~O(√p) —
+    the EQuARX trade).
+    """
+
+    name = "int8_ring"
+    stateful = True
+
+    def init_state(self, leaf):
+        # allreduce adds the residual to the *flattened* gradient.
+        return jnp.zeros(max(int(np.prod(leaf.shape)), 1), jnp.float32)
+
+    @staticmethod
+    def _quant(x):
+        scale = jnp.maximum(jnp.max(jnp.abs(x)) / 127.0, 1e-20)
+        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        return q, scale
+
+    def allreduce(self, grad, state, axis_name):
+        p = lax.axis_size(axis_name)
+        me = lax.axis_index(axis_name)
+        shape, dtype = grad.shape, grad.dtype
+        flat = grad.astype(jnp.float32).reshape(-1)
+        total = flat.shape[0]
+        corrected = flat + state
+        if p == 1:
+            return corrected.reshape(shape).astype(dtype), jnp.zeros_like(state)
+        chunk = -(-total // p)
+        rows = jnp.pad(corrected, (0, p * chunk - total)).reshape(p, chunk)
+
+        # Every device's contribution enters the ring in its quantized
+        # form, so the EF residual (rows − deq0) is exactly what was
+        # lost locally; only per-hop requantization noise stays
+        # uncompensated.
+        q0, s0 = jax.vmap(self._quant)(rows)
+        deq0 = q0.astype(jnp.float32) * s0[:, None]
+        new_state = (rows - deq0).reshape(-1)[:total]
+
+        fwd = [(i, (i + 1) % p) for i in range(p)]
+
+        # ---- ring reduce-scatter -------------------------------------- #
+        # At hop h, this device forwards the partial sum of chunk
+        # (me - h) mod p and receives chunk (me - h - 1) mod p.
+        def rs_hop(carry, h):
+            q, s, _ = carry                    # payload in flight (wire)
+            q = lax.ppermute(q, axis_name, fwd)
+            s = lax.ppermute(s, axis_name, fwd)
+            c = (me - h - 1) % p               # chunk just received
+            acc = q.astype(jnp.float32) * s + jnp.take(deq0, c, axis=0)
+            qn, sn = self._quant(acc)
+            return (qn, sn, acc), None
+
+        start = (jnp.take(q0, me, axis=0), jnp.take(s0, me),
+                 jnp.zeros((chunk,), jnp.float32))
+        (_, _, owned), _ = lax.scan(rs_hop, start, jnp.arange(p - 1))
+        # owned: fp32 sum of chunk (me+1)%p
+
+        # ---- ring all-gather ------------------------------------------ #
+        q_own, s_own = self._quant(owned)
+
+        def ag_hop(carry, _):
+            q, s = carry
+            q = lax.ppermute(q, axis_name, fwd)
+            s = lax.ppermute(s, axis_name, fwd)
+            return (q, s), (q, s)
+
+        (_, _), (qs, ss) = lax.scan(ag_hop, (q_own, s_own),
+                                    jnp.arange(p - 1))
+        # Rows in arrival order: k=0 is our own chunk, k>=1 came from
+        # device (me - k): chunk position (me - k + 1) mod p.
+        all_q = jnp.concatenate([q_own[None], qs], axis=0)     # [p, chunk]
+        all_s = jnp.concatenate([s_own[None], ss], axis=0)     # [p]
+        gathered = all_q.astype(jnp.float32) * all_s[:, None]
+        # Arrival k holds chunk position (me - k + 1) mod p; position j
+        # therefore takes arrival (me + 1 - j) mod p.
+        inv = (me + 1 - jnp.arange(p)) % p
+        out_rows = jnp.take(gathered, inv, axis=0)
+        mean = out_rows.reshape(-1)[:total] / p
+        return mean.reshape(shape).astype(dtype), new_state
+
+
 class Int8EFCompressor(_ErrorFeedback):
     """Shared-scale int8 quantized allreduce with error feedback.
 

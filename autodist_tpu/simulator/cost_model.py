@@ -33,7 +33,10 @@ COMPRESSOR_FACTOR = {
     "none": 1.0,
     "fp16": 0.5, "bf16": 0.5,
     "fp16_ef": 0.5, "bf16_ef": 0.5,
-    "int8_ef": 0.25,
+    # int8_ef quantizes to int8 levels but its psum rides an fp16 wire;
+    # int8_ring is the true-int8-wire ring.
+    "int8_ef": 0.5,
+    "int8_ring": 0.25,
     # (n + m)·r vs n·m bytes, ~2r/sqrt(total): a static stand-in for a
     # data-dependent ratio; at BERT-scale buckets it is ≲ 0.01.
     "powersgd": 0.02,

@@ -46,7 +46,7 @@ class AllReduceSynchronizer:
 
     kind: str = "allreduce"
     compressor: str = "none"     # none | fp16 | bf16 | fp16_ef | bf16_ef
-                                 # | int8_ef | powersgd[:rank]
+                                 # | int8_ef | int8_ring | powersgd[:rank]
     group: int = 0               # bucket id for flatten-concat merging
 
     def to_dict(self):
