@@ -20,6 +20,30 @@ _lock = threading.Lock()
 _loaded: dict[str, ctypes.CDLL] = {}
 
 
+def _build_dir() -> str:
+    """Where to run make: the package's native dir when writable, else a
+    per-user cache (read-only installs — system site-packages, container
+    layers — can't take the .so next to the sources)."""
+    if os.access(NATIVE_DIR, os.W_OK):
+        return NATIVE_DIR
+    import shutil
+
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.join(os.path.expanduser("~"), ".cache")),
+        "autodist_tpu", "native")
+    os.makedirs(cache, exist_ok=True)
+    for fn in os.listdir(NATIVE_DIR):
+        if not (fn.endswith(".cc") or fn == "Makefile"):
+            continue
+        src = os.path.join(NATIVE_DIR, fn)
+        dst = os.path.join(cache, fn)
+        if (not os.path.exists(dst)
+                or os.path.getmtime(dst) < os.path.getmtime(src)):
+            shutil.copy2(src, dst)
+    return cache
+
+
 def load_native(lib_name: str, src_name: str) -> ctypes.CDLL:
     """``load_native("libautodist_coord.so", "coord.cc")`` — compile via
     ``make -s <lib_name>`` when the .so is missing or older than its
@@ -27,14 +51,16 @@ def load_native(lib_name: str, src_name: str) -> ctypes.CDLL:
     with _lock:
         if lib_name in _loaded:
             return _loaded[lib_name]
-        lib_path = os.path.join(NATIVE_DIR, lib_name)
-        src_path = os.path.join(NATIVE_DIR, src_name)
+        build_dir = _build_dir()
+        lib_path = os.path.join(build_dir, lib_name)
+        src_path = os.path.join(build_dir, src_name)
         if (not os.path.exists(lib_path)
                 or (os.path.exists(src_path)
                     and os.path.getmtime(lib_path)
                     < os.path.getmtime(src_path))):
-            logging.info("building native library %s", lib_name)
-            subprocess.run(["make", "-s", lib_name], cwd=NATIVE_DIR,
+            logging.info("building native library %s in %s", lib_name,
+                         build_dir)
+            subprocess.run(["make", "-s", lib_name], cwd=build_dir,
                            check=True)
         lib = ctypes.CDLL(lib_path)
         _loaded[lib_name] = lib
