@@ -133,3 +133,25 @@ def test_memory_summary_shapes():
     out = profiling.memory_summary(FakeDev())
     assert out["bytes_in_use"] == 500 and out["utilization"] == 0.5
     assert "label" not in out
+
+
+def test_native_build_falls_back_to_user_cache(monkeypatch, tmp_path):
+    """Read-only installs (system site-packages, container layers) build
+    the native libraries in XDG_CACHE_HOME instead of next to the
+    sources."""
+    import os
+
+    from autodist_tpu.runtime import nativelib as nl
+
+    real_access = os.access
+    monkeypatch.setattr(
+        nl.os, "access",
+        lambda p, m: False if p == nl.NATIVE_DIR else real_access(p, m))
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    nl._loaded.clear()
+    lib = nl.load_native("libautodist_dataio.so", "dataio.cc")
+    assert lib is not None
+    cache = tmp_path / "autodist_tpu" / "native"
+    assert (cache / "libautodist_dataio.so").exists()
+    assert (cache / "dataio.cc").exists()   # sources copied for make
+    nl._loaded.clear()                      # don't leak the cache CDLL
