@@ -74,3 +74,29 @@ def test_quickstart_flow_runs():
     assert "loss" in metrics
     params = runner.get_params()
     assert params["big"].shape == (512, 600)
+
+
+def test_documented_public_api_imports():
+    """Every entry point the migration guide and tutorials name must be
+    importable from where the docs say it lives."""
+    from autodist_tpu import (AllReduce, AutoDist, AutoStrategy,  # noqa: F401
+                              DistributedRunner, FSDPSharded,
+                              GradAccumulation, Parallax, PartitionedAR,
+                              PartitionedPS, PS, PSLoadBalancing,
+                              RandomAxisPartitionAR, ResourceSpec, Sharded,
+                              Strategy, TensorParallel, Trainable,
+                              UnevenPartitionedPS, VarInfo, ZeRO, fit)
+    from autodist_tpu.checkpoint import (Saver, export_model,  # noqa: F401
+                                         load_exported)
+    from autodist_tpu.data import (DataLoader, TokenFile,  # noqa: F401
+                                   lm_window_loader, shard_batch)
+    from autodist_tpu.ops import (flash_attention,  # noqa: F401
+                                  flash_attention_with_lse,
+                                  make_attention_fn)
+    from autodist_tpu.parallel.ring_attention import (  # noqa: F401
+        make_ring_attention_fn, make_ring_flash_attention_fn,
+        ring_flash_attention, ring_self_attention)
+    from autodist_tpu.parallel.sequence import (  # noqa: F401
+        global_positions, lower_sequence_parallel)
+    from autodist_tpu.runtime import (Cluster, Coordinator,  # noqa: F401
+                                      make_global_batch)
