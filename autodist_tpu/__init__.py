@@ -11,7 +11,7 @@ instead of a rewritten TF graph over SSH/gRPC/NCCL.
 __version__ = "0.1.0"
 
 from autodist_tpu.autodist import AutoDist
-from autodist_tpu.capture import Trainable, VarInfo
+from autodist_tpu.capture import PipelineTrainable, Trainable, VarInfo
 from autodist_tpu.resource import ResourceSpec
 from autodist_tpu.runner import DistributedRunner
 from autodist_tpu.strategy.builders import (AllReduce, GradAccumulation,
@@ -22,14 +22,19 @@ from autodist_tpu.strategy.builders import (AllReduce, GradAccumulation,
                                             UnevenPartitionedPS, ZeRO)
 from autodist_tpu.strategy.gspmd_builders import (FSDPSharded, Sharded,
                                                   TensorParallel)
+from autodist_tpu.strategy.parallel_builders import (ExpertParallel,
+                                                     Pipeline,
+                                                     SequenceParallel)
 from autodist_tpu.strategy.ir import Strategy
 from autodist_tpu.simulator import AutoStrategy
 from autodist_tpu.train import fit
 
 __all__ = [
-    "AutoDist", "Trainable", "VarInfo", "ResourceSpec", "DistributedRunner",
+    "AutoDist", "Trainable", "PipelineTrainable", "VarInfo", "ResourceSpec",
+    "DistributedRunner",
     "Strategy", "AllReduce", "PS", "PSLoadBalancing", "PartitionedPS",
     "UnevenPartitionedPS", "PartitionedAR", "RandomAxisPartitionAR",
     "Parallax", "ZeRO", "AutoStrategy", "GradAccumulation", "fit",
     "Sharded", "TensorParallel", "FSDPSharded",
+    "SequenceParallel", "Pipeline", "ExpertParallel",
 ]

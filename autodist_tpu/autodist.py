@@ -107,9 +107,19 @@ class AutoDist:
         if kind == "gspmd":
             from autodist_tpu.kernel.gspmd import lower_gspmd
             return lower_gspmd(trainable, strategy, self.mesh)
+        if kind == "sequence":
+            from autodist_tpu.parallel.sequence import lower_sequence_ir
+            return lower_sequence_ir(trainable, strategy, self.mesh)
+        if kind == "pipeline":
+            from autodist_tpu.parallel.pipeline import lower_pipeline_ir
+            return lower_pipeline_ir(trainable, strategy, self.mesh)
+        if kind == "expert":
+            from autodist_tpu.parallel.moe import lower_expert_ir
+            return lower_expert_ir(trainable, strategy, self.mesh)
         if kind != "collective":
             raise ValueError(
-                f"unknown lowering {kind!r}; expected 'collective' or 'gspmd'")
+                f"unknown lowering {kind!r}; expected one of 'collective', "
+                "'gspmd', 'sequence', 'pipeline', 'expert'")
         return lower(trainable, strategy, self.mesh)
 
     def build(self, trainable: Trainable,

@@ -162,3 +162,50 @@ class Trainable:
 
     def var_names(self) -> list[str]:
         return [v.name for v in self.var_infos()]
+
+
+class PipelineTrainable(Trainable):
+    """A trainable declared in pipeline-stage form.
+
+    The reference's strategy IR anticipated per-*node* (not just
+    per-variable) distribution choices (``strategy.proto:40-42``); the
+    TPU realization is stage-structured capture: the user declares
+
+    * ``stage_fn(stage_params, activation) -> activation`` — one pipeline
+      stage (all stages share this structure; per-stage weights live in
+      the leading dimension of ``stacked_params``);
+    * ``stacked_params`` — pytree whose leaves carry a leading
+      ``num_stages`` dimension;
+    * ``loss_head(outputs, batch) -> (loss, metrics)`` — the loss on the
+      last stage's outputs.
+
+    The inherited ``loss`` is the *sequential* execution (stage 0..S-1 in
+    order on one device): the single-device reference semantics golden
+    tests and AutoStrategy compare against.  The pipeline lowering
+    (``parallel/pipeline.py``) runs the same computation as a microbatched
+    schedule over the ``pipe`` mesh axis.
+    """
+
+    def __init__(self, stage_fn, stacked_params, loss_head, optimizer, *,
+                 num_stages: int, batch_key: str = "x", **kw):
+        sizes = set()
+        for l in jax.tree_util.tree_leaves(stacked_params):
+            shape = getattr(l, "shape", ())
+            sizes.add(shape[0] if len(shape) else None)
+        if sizes != {num_stages}:
+            raise ValueError(
+                f"stacked_params leading dims {sorted(sizes, key=str)} != "
+                f"num_stages {num_stages}")
+        self.stage_fn = stage_fn
+        self.loss_head = loss_head
+        self.num_stages = num_stages
+        self.batch_key = batch_key
+
+        def sequential_loss(params, extra, batch, rng):
+            x = batch[batch_key]
+            for i in range(num_stages):
+                x = stage_fn(jax.tree_util.tree_map(lambda p: p[i], params), x)
+            loss, metrics = loss_head(x, batch)
+            return loss, extra, dict(metrics, loss=loss)
+
+        super().__init__(sequential_loss, stacked_params, optimizer, **kw)

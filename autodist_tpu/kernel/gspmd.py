@@ -13,7 +13,6 @@ Chosen when ``Strategy.graph_config.lowering == "gspmd"`` (e.g. the
 """
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Optional
 
 import jax
@@ -25,6 +24,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from autodist_tpu import const
 from autodist_tpu.capture import Trainable, path_to_name
 from autodist_tpu.kernel import common
+from autodist_tpu.kernel import lowering as lowering_mod
 from autodist_tpu.strategy.ir import Strategy
 from autodist_tpu.utils import logging
 
@@ -48,27 +48,10 @@ def _node_spec(node, ndim: int) -> P:
     return P()
 
 
-@dataclasses.dataclass
-class GspmdLowered:
-    """Same contract as :class:`autodist_tpu.kernel.lowering.Lowered`."""
-
-    mesh: Any
-    init_fn: Any
-    step_fn: Any
-    state_specs: Any
-    state_shardings: Any
-    batch_spec: Any
-    plan: Any = None
-    eval_fn: Any = None
-
-    def init_state(self, params=None, extra=None, trainable=None):
-        params = params if params is not None else trainable.params
-        extra = extra if extra is not None else (
-            trainable.extra if trainable else None)
-        return self.init_fn(params, extra)
-
-    def unpad_params(self, params):
-        return params  # GSPMD shards unevenly without padding
+class GspmdLowered(lowering_mod.SimpleLowered):
+    """Same contract as :class:`autodist_tpu.kernel.lowering.Lowered`
+    (GSPMD shards unevenly without padding, so ``unpad_params`` is the
+    identity)."""
 
 
 def lower_gspmd(trainable: Trainable, strategy: Strategy, mesh) -> GspmdLowered:

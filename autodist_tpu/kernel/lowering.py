@@ -356,6 +356,45 @@ class Lowered:
 
         return common.tree_from_names(params, unpad)
 
+    def batch_spec_tree(self, batch):
+        """Per-leaf feed PartitionSpecs (the remapper feed contract:
+        batched leaves split, scalars duplicate)."""
+        return common.batch_specs(batch, self.batch_spec)
+
+
+@dataclasses.dataclass
+class SimpleLowered:
+    """Lowered-contract container for backends whose parameters carry no
+    storage padding (gspmd / sequence / pipeline / expert lowerings).
+
+    ``batch_spec_fn(batch) -> spec tree`` overrides the uniform feed rule
+    for lowerings with per-leaf placement (sequence parallelism splits
+    token leaves over ``data x seq`` and the rest over ``data`` only)."""
+
+    mesh: Any
+    init_fn: Any
+    step_fn: Any
+    state_specs: Any
+    state_shardings: Any
+    batch_spec: Any
+    plan: Any = None
+    eval_fn: Any = None
+    batch_spec_fn: Any = None
+
+    def init_state(self, params=None, extra=None, trainable=None):
+        params = params if params is not None else trainable.params
+        extra = extra if extra is not None else (
+            trainable.extra if trainable else None)
+        return self.init_fn(params, extra)
+
+    def unpad_params(self, params):
+        return params
+
+    def batch_spec_tree(self, batch):
+        if self.batch_spec_fn is not None:
+            return self.batch_spec_fn(batch)
+        return common.batch_specs(batch, self.batch_spec)
+
 
 def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
     """Build the SPMD program for (trainable, strategy, mesh)."""

@@ -84,20 +84,24 @@ def last_stage_value(value, axis_name: str = const.PIPE_AXIS):
                     axis_name)
 
 
-def lower_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
-                   optimizer, mesh, *, num_microbatches: int,
-                   data_axis: str = const.DATA_AXIS,
-                   pipe_axis: str = const.PIPE_AXIS):
-    """Build a complete pipelined SPMD train step.
+def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
+                    optimizer, mesh, *, num_microbatches: int,
+                    data_axis: str = const.DATA_AXIS,
+                    pipe_axis: str = const.PIPE_AXIS,
+                    accum: int = 1, batch_key: str = "x"):
+    """Shared construction for the direct API and the Strategy-IR entry;
+    returns a :class:`~autodist_tpu.kernel.lowering.SimpleLowered`.
 
-    ``stacked_params``: pytree whose leaves have a leading stage dimension
-    ``S == mesh.shape[pipe_axis]`` (sharded onto the pipe axis).
-    ``loss_head(outputs, batch) -> (loss, metrics)`` runs on the last stage.
+    ``accum > 1`` composes gradient accumulation *around* the pipeline:
+    each accumulation slice runs the full microbatched schedule, so one
+    optimizer step consumes ``accum x num_microbatches`` microbatches
+    (the reconciliation of ``GraphConfig.accum_steps`` with pipeline
+    microbatching)."""
+    from autodist_tpu.kernel import common
+    from autodist_tpu.kernel.lowering import SimpleLowered
 
-    Returns ``(init_fn, step_fn, state_shardings)`` with the same state
-    dict layout as the other lowerings.
-    """
     S = mesh.shape[pipe_axis]
+    has_data = data_axis in mesh.shape
     p_specs = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
     state_specs = {"step": P(), "params": p_specs, "opt_state": p_specs,
                    "extra": None, "sync_state": {}}
@@ -115,7 +119,7 @@ def lower_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                                    state_specs,
                                    is_leaf=lambda x: isinstance(x, P))
 
-    def _init(params):
+    def _init(params, extra=None):
         return {"step": jnp.zeros((), jnp.int32),
                 "params": jax.tree.map(jnp.asarray, params),
                 "opt_state": optimizer.init(jax.tree.map(jnp.asarray, params)),
@@ -123,48 +127,64 @@ def lower_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
 
     init_fn = jax.jit(_init, out_shardings=state_shardings)
 
+    def _forward_loss(sp, batch):
+        """Masked local loss+metrics of one batch slice (nonzero on the
+        last stage only; gradients reach earlier stages through the
+        transposed ppermute ring.  A psum here would double-scale
+        cotangents under check_vma=False; values are broadcast after the
+        grad instead)."""
+        outputs = pipeline_apply(stage_fn, sp, batch[batch_key],
+                                 axis_name=pipe_axis,
+                                 num_microbatches=num_microbatches)
+        loss, metrics = loss_head(outputs, batch)
+        idx = lax.axis_index(pipe_axis)
+        masked = jnp.where(idx == S - 1, loss, 0.0)
+        return masked, dict(metrics, loss=loss)
+
+    def _broadcast_metrics(metrics):
+        """Last-stage-masked psum over pipe (value broadcast), then mean
+        over the data axis when one exists."""
+        idx = lax.axis_index(pipe_axis)
+        metrics = jax.tree.map(
+            lambda m: lax.psum(
+                jnp.where(idx == S - 1, m, jnp.zeros_like(m)), pipe_axis),
+            metrics)
+        if has_data:
+            metrics = jax.tree.map(lambda m: lax.pmean(m, data_axis),
+                                   metrics)
+        return metrics
+
     def _local_step(state, batch, rng):
         stage_params = jax.tree.map(lambda p: p[0], state["params"])
 
-        def loss_of(sp):
-            outputs = pipeline_apply(stage_fn, sp, batch["x"],
-                                     axis_name=pipe_axis,
-                                     num_microbatches=num_microbatches)
-            loss, metrics = loss_head(outputs, batch)
-            # Differentiate the *masked local* loss: it is nonzero only on
-            # the last stage, and gradients reach earlier stages through
-            # the transposed ppermute ring.  (A psum here would double-
-            # scale cotangents under check_vma=False; the value is
-            # broadcast after the grad instead.)
-            S_ = lax.axis_size(pipe_axis)
-            idx = lax.axis_index(pipe_axis)
-            masked = jnp.where(idx == S_ - 1, loss, 0.0)
-            return masked, metrics
+        def micro_grads(mb, rng_, extra_in):
+            def loss_of(sp):
+                masked, metrics = _forward_loss(sp, mb)
+                return masked, (extra_in, metrics)
 
-        (masked_loss, metrics), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(stage_params)
-        idx = lax.axis_index(pipe_axis)
-        S_ = lax.axis_size(pipe_axis)
-        loss = lax.psum(masked_loss, pipe_axis)  # value broadcast only
-        metrics = jax.tree.map(
-            lambda m: lax.psum(
-                jnp.where(idx == S_ - 1, m, jnp.zeros_like(m)), pipe_axis),
-            metrics)
-        grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
+            return jax.value_and_grad(loss_of, has_aux=True)(stage_params)
+
+        if accum == 1:
+            (_, (_, metrics)), grads = micro_grads(batch, rng, None)
+        else:
+            grads, _, metrics = common.accumulate_microbatches(
+                micro_grads, stage_params, batch, rng, None, accum)
+
+        metrics = _broadcast_metrics(metrics)
+        if has_data:
+            grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
         grads = jax.tree.map(lambda g: g[None], grads)
 
         updates, new_opt = optimizer.update(grads, state["opt_state"],
                                             state["params"])
         new_params = optax.apply_updates(state["params"], updates)
-        metrics = jax.tree.map(lambda m: lax.pmean(m, data_axis), metrics)
         return ({"step": state["step"] + 1, "params": new_params,
                  "opt_state": new_opt, "extra": None, "sync_state": {}},
-                dict(metrics, loss=lax.pmean(loss, data_axis)))
+                metrics)
 
-    batch_spec = P(data_axis)
+    batch_spec = P(data_axis) if has_data else P()
 
     def _step(state, batch, rng):
-        from autodist_tpu.kernel import common
         return jax.shard_map(
             _local_step, mesh=mesh,
             in_specs=(state_specs, common.batch_specs(batch, batch_spec), P()),
@@ -172,4 +192,64 @@ def lower_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
             check_vma=False)(state, batch, rng)
 
     step_fn = jax.jit(_step, donate_argnums=(0,))
-    return init_fn, step_fn, state_shardings
+
+    def _local_eval(state, batch, rng):
+        sp = jax.tree.map(lambda p: p[0], state["params"])
+        _, metrics = _forward_loss(sp, batch)
+        return _broadcast_metrics(metrics)
+
+    def _eval(state, batch, rng):
+        return jax.shard_map(
+            _local_eval, mesh=mesh,
+            in_specs=(state_specs, common.batch_specs(batch, batch_spec), P()),
+            out_specs=P(), check_vma=False)(state, batch, rng)
+
+    eval_fn = jax.jit(_eval)
+
+    return SimpleLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
+                         state_specs=state_specs,
+                         state_shardings=state_shardings,
+                         batch_spec=batch_spec, eval_fn=eval_fn)
+
+
+def lower_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
+                   optimizer, mesh, *, num_microbatches: int,
+                   data_axis: str = const.DATA_AXIS,
+                   pipe_axis: str = const.PIPE_AXIS):
+    """Build a complete pipelined SPMD train step.
+
+    ``stacked_params``: pytree whose leaves have a leading stage dimension
+    ``S == mesh.shape[pipe_axis]`` (sharded onto the pipe axis).
+    ``loss_head(outputs, batch) -> (loss, metrics)`` runs on the last stage.
+
+    Returns ``(init_fn, step_fn, state_shardings)`` with the same state
+    dict layout as the other lowerings.
+    """
+    built = _build_pipeline(stage_fn, stacked_params, loss_head, optimizer,
+                            mesh, num_microbatches=num_microbatches,
+                            data_axis=data_axis, pipe_axis=pipe_axis)
+    return built.init_fn, built.step_fn, built.state_shardings
+
+
+def lower_pipeline_ir(trainable, strategy, mesh):
+    """Strategy-IR entry: lower a ``lowering == "pipeline"`` strategy
+    (built by :class:`~autodist_tpu.strategy.parallel_builders.Pipeline`)
+    for a :class:`~autodist_tpu.capture.PipelineTrainable`."""
+    from autodist_tpu.capture import PipelineTrainable
+
+    if not isinstance(trainable, PipelineTrainable):
+        raise TypeError(
+            "the pipeline strategy lowers stage-structured trainables; "
+            "declare one with PipelineTrainable(stage_fn, stacked_params, "
+            "loss_head, optimizer, num_stages=S)")
+    cfg = strategy.graph_config
+    S = mesh.shape.get(const.PIPE_AXIS)
+    if S != trainable.num_stages:
+        raise ValueError(
+            f"mesh pipe axis has {S} stages; trainable declares "
+            f"{trainable.num_stages}")
+    return _build_pipeline(
+        trainable.stage_fn, trainable.params, trainable.loss_head,
+        trainable.optimizer, mesh,
+        num_microbatches=int(cfg.parallel.get("num_microbatches", 1)),
+        accum=max(cfg.accum_steps, 1), batch_key=trainable.batch_key)

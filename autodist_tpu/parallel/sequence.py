@@ -36,20 +36,12 @@ def global_positions(local_len: int, *, seq_axis: str = const.SEQ_AXIS):
     return lax.axis_index(seq_axis) * local_len + jnp.arange(local_len)
 
 
-def lower_sequence_parallel(trainable, mesh, *,
-                            seq_leaves: Sequence[str] = ("x", "y"),
-                            seq_axis: str = const.SEQ_AXIS,
-                            data_axis: str = const.DATA_AXIS):
-    """Compile a training step with sequences sharded over ``seq_axis``.
+def _build_sequence(trainable, mesh, *, seq_leaves: Sequence[str],
+                    seq_axis: str, data_axis: str, accum: int = 1):
+    """Shared construction for both the direct API and the Strategy-IR
+    lowering; returns a :class:`~autodist_tpu.kernel.lowering.SimpleLowered`."""
+    from autodist_tpu.kernel.lowering import SimpleLowered, _reduce_metrics
 
-    ``seq_leaves`` names the batch keys carrying a ``[B, L, ...]`` token
-    dimension (split over both axes); other leaves split over the data
-    axis only (scalars duplicate).  Parameters and optimizer state are
-    replicated; gradients — each shard's grad of its local token-mean
-    loss — average over (data × seq), which is exactly the full-sequence
-    objective for equal shards.  The model must attend globally through
-    ring attention and use :func:`global_positions`.
-    """
     if seq_axis not in mesh.shape:
         raise ValueError(f"mesh {dict(mesh.shape)} has no {seq_axis!r} axis")
     has_data = data_axis in mesh.shape
@@ -75,6 +67,19 @@ def lower_sequence_parallel(trainable, mesh, *,
             return P(data_axis, seq_axis) if has_data else P(None, seq_axis)
         return P(data_axis) if has_data else P()
 
+    def batch_spec_fn(batch):
+        matched = [name for name, _ in common.flatten_with_names(batch)
+                   if name.split("/")[-1] in seq_leaves]
+        if not matched:
+            # Silently replicating every leaf along seq would make ring
+            # attention treat identical copies as distinct chunks — a
+            # wrong objective with no error.  Demand an explicit match.
+            raise ValueError(
+                f"no batch leaf matches seq_leaves={tuple(seq_leaves)}; "
+                "name the token-dimension leaves explicitly")
+        return common.tree_from_names(
+            batch, lambda name, leaf: batch_spec_for(name, leaf))
+
     def _init(params, extra):
         return {"step": jnp.zeros((), jnp.int32),
                 "params": jax.tree.map(jnp.asarray, params),
@@ -86,15 +91,23 @@ def lower_sequence_parallel(trainable, mesh, *,
     def _local_step(state, batch, rng):
         local_rng = jax.random.fold_in(rng, lax.axis_index(sync_axes))
 
-        def loss_of(params):
-            loss, new_extra, metrics = trainable.loss(
-                params, state["extra"], batch, local_rng)
-            return loss, (new_extra, metrics)
+        def micro_grads(mb, rng_, extra_in):
+            def loss_of(params):
+                loss, new_extra, metrics = trainable.loss(
+                    params, extra_in, mb, rng_)
+                return loss, (new_extra, metrics)
 
-        (loss, (new_extra, metrics)), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(state["params"])
+            return jax.value_and_grad(loss_of, has_aux=True)(
+                state["params"])
+
+        if accum == 1:
+            (loss, (new_extra, metrics)), grads = micro_grads(
+                batch, local_rng, state["extra"])
+        else:
+            grads, new_extra, metrics = common.accumulate_microbatches(
+                micro_grads, state["params"], batch, local_rng,
+                state["extra"], accum)
         # Per-shard token-mean grads → global mean over data x seq.
-        from autodist_tpu.kernel.lowering import _reduce_metrics
         grads = jax.tree.map(lambda g: lax.pmean(g, sync_axes), grads)
         metrics = _reduce_metrics(dict(metrics), sync_axes)
         # extra (e.g. batch stats) must be SPMD-invariant: average float
@@ -111,22 +124,64 @@ def lower_sequence_parallel(trainable, mesh, *,
                  "sync_state": {}}, metrics)
 
     def _step(state, batch, rng):
-        matched = [name for name, _ in common.flatten_with_names(batch)
-                   if name.split("/")[-1] in seq_leaves]
-        if not matched:
-            # Silently replicating every leaf along seq would make ring
-            # attention treat identical copies as distinct chunks — a
-            # wrong objective with no error.  Demand an explicit match.
-            raise ValueError(
-                f"no batch leaf matches seq_leaves={tuple(seq_leaves)}; "
-                "name the token-dimension leaves explicitly")
-        bspecs = common.tree_from_names(
-            batch, lambda name, leaf: batch_spec_for(name, leaf))
         return jax.shard_map(
             _local_step, mesh=mesh,
-            in_specs=(state_specs, bspecs, P()),
+            in_specs=(state_specs, batch_spec_fn(batch), P()),
             out_specs=(state_specs, P()),
             check_vma=False)(state, batch, rng)
 
     step_fn = jax.jit(_step, donate_argnums=(0,))
-    return init_fn, step_fn, state_shardings
+
+    def _local_eval(state, batch, rng):
+        _, _, metrics = trainable.eval_loss(
+            state["params"], state["extra"], batch,
+            jax.random.fold_in(rng, lax.axis_index(sync_axes)))
+        return _reduce_metrics(dict(metrics), sync_axes)
+
+    def _eval(state, batch, rng):
+        return jax.shard_map(
+            _local_eval, mesh=mesh,
+            in_specs=(state_specs, batch_spec_fn(batch), P()),
+            out_specs=P(), check_vma=False)(state, batch, rng)
+
+    eval_fn = jax.jit(_eval)
+
+    base_spec = P((data_axis, seq_axis) if has_data else (seq_axis,))
+    return SimpleLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
+                         state_specs=state_specs,
+                         state_shardings=state_shardings,
+                         batch_spec=base_spec, eval_fn=eval_fn,
+                         batch_spec_fn=batch_spec_fn)
+
+
+def lower_sequence_parallel(trainable, mesh, *,
+                            seq_leaves: Sequence[str] = ("x", "y"),
+                            seq_axis: str = const.SEQ_AXIS,
+                            data_axis: str = const.DATA_AXIS):
+    """Compile a training step with sequences sharded over ``seq_axis``.
+
+    ``seq_leaves`` names the batch keys carrying a ``[B, L, ...]`` token
+    dimension (split over both axes); other leaves split over the data
+    axis only (scalars duplicate).  Parameters and optimizer state are
+    replicated; gradients — each shard's grad of its local token-mean
+    loss — average over (data × seq), which is exactly the full-sequence
+    objective for equal shards.  The model must attend globally through
+    ring attention and use :func:`global_positions`.
+    """
+    built = _build_sequence(trainable, mesh, seq_leaves=seq_leaves,
+                            seq_axis=seq_axis, data_axis=data_axis)
+    return built.init_fn, built.step_fn, built.state_shardings
+
+
+def lower_sequence_ir(trainable, strategy, mesh):
+    """Strategy-IR entry: lower a ``lowering == "sequence"`` strategy
+    (built by :class:`~autodist_tpu.strategy.parallel_builders.SequenceParallel`)
+    — the serializable form of sequence parallelism that flows through
+    ``AutoDist.build``, the chief→worker handoff, and ``Saver``."""
+    cfg = strategy.graph_config
+    seq_leaves = tuple(cfg.parallel.get("seq_leaves", ("x", "y")))
+    return _build_sequence(
+        trainable, mesh, seq_leaves=seq_leaves,
+        seq_axis=cfg.parallel.get("seq_axis", const.SEQ_AXIS),
+        data_axis=const.DATA_AXIS,
+        accum=max(cfg.accum_steps, 1))

@@ -78,11 +78,12 @@ class DistributedRunner:
         batch dimension are *split* across the data axis; scalars (the
         polymorphic-feed analog of non-batch placeholders — step counts,
         loss scales) are *duplicated* to every replica.  Already-placed
-        global arrays pass through."""
-        from autodist_tpu.kernel import common
-
-        shardings = common.batch_shardings(batch, self.mesh,
-                                           self.lowered.batch_spec)
+        global arrays pass through.  Placement is per-leaf, from the
+        lowering's spec tree (sequence parallelism splits token leaves
+        over ``data x seq``)."""
+        specs = self.lowered.batch_spec_tree(batch)
+        shardings = jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                                 specs, is_leaf=lambda x: isinstance(x, P))
 
         def place(x, sharding):
             if isinstance(x, jax.Array):
@@ -93,15 +94,18 @@ class DistributedRunner:
                 # on-device reshard otherwise — never a host round-trip.
                 return jax.device_put(x, sharding)
             x = np.asarray(x)
-            entry = self.lowered.batch_spec[0]
-            axes = entry if isinstance(entry, tuple) else (entry,)
-            n = 1
-            for a in axes:
-                n *= self.mesh.shape[a]
-            if x.ndim > 0 and x.shape[0] % n:
-                raise ValueError(
-                    f"batch leading dim {x.shape} must be divisible by the "
-                    f"replica count {n} (axes {axes})")
+            for dim, entry in enumerate(sharding.spec):
+                if dim >= x.ndim:
+                    break
+                axes = entry if isinstance(entry, tuple) else (
+                    (entry,) if entry else ())
+                n = 1
+                for a in axes:
+                    n *= self.mesh.shape[a]
+                if n > 1 and x.shape[dim] % n:
+                    raise ValueError(
+                        f"batch dim {dim} of shape {x.shape} must be "
+                        f"divisible by the shard count {n} (axes {axes})")
             return jax.device_put(x, sharding)
 
         return jax.tree.map(place, batch, shardings)

@@ -329,8 +329,11 @@ def create(name: str, **kw) -> StrategyBuilder:
     if name in ("Sharded", "TensorParallel", "FSDPSharded"):
         from autodist_tpu.strategy import gspmd_builders
         return getattr(gspmd_builders, name)(**kw)
+    if name in ("SequenceParallel", "Pipeline", "ExpertParallel"):
+        from autodist_tpu.strategy import parallel_builders
+        return getattr(parallel_builders, name)(**kw)
     if name not in BUILDERS:
         raise ValueError(
             f"unknown strategy builder {name!r}; have "
-            f"{sorted(BUILDERS) + ['AutoStrategy', 'Sharded', 'TensorParallel', 'FSDPSharded']}")
+            f"{sorted(BUILDERS) + ['AutoStrategy', 'Sharded', 'TensorParallel', 'FSDPSharded', 'SequenceParallel', 'Pipeline', 'ExpertParallel']}")
     return BUILDERS[name](**kw)

@@ -192,12 +192,26 @@ class GraphConfig:
     # Lowering path: "collective" = explicit per-variable collectives inside
     # one shard_map (the synchronizer semantics of the reference);
     # "gspmd" = jit + NamedSharding annotations, XLA inserts collectives
-    # (for tensor/model-parallel and mixed-axis strategies).
+    # (for tensor/model-parallel and mixed-axis strategies);
+    # "sequence" | "pipeline" | "expert" = the advanced-parallelism
+    # lowerings (ring-attention sequence parallel, microbatched pipeline,
+    # MoE expert parallel) — the strategy.proto:40-42 extension point the
+    # reference anticipated, realized as first-class serializable
+    # strategies.
     lowering: str = "collective"
     # Gradient accumulation: each step scans over this many microbatches
     # before the (single) synchronization + optimizer update, trading
     # step latency for global batch sizes that exceed device memory.
+    # Composes with the pipeline lowering: each accumulation slice runs
+    # the full microbatched pipeline schedule (accum_steps outer scans x
+    # parallel.num_microbatches pipeline ticks per optimizer update).
     accum_steps: int = 1
+    # Knobs of the advanced-parallelism lowerings, JSON-serializable:
+    #   sequence: {"seq_leaves": ["x", "y"]}
+    #   pipeline: {"num_microbatches": 4}
+    #   expert:   {} (no lowering knobs; routing capacity lives at the
+    #   model's expert_parallel_ffn call)
+    parallel: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -207,7 +221,8 @@ class GraphConfig:
         return cls(replicas=d.get("replicas", 1),
                    mesh_axes=dict(d.get("mesh_axes", {})),
                    lowering=d.get("lowering", "collective"),
-                   accum_steps=d.get("accum_steps", 1))
+                   accum_steps=d.get("accum_steps", 1),
+                   parallel=dict(d.get("parallel", {})))
 
 
 @dataclasses.dataclass

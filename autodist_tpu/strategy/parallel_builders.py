@@ -1,0 +1,160 @@
+"""Strategy builders for the advanced parallelisms.
+
+The reference's strategy IR anticipated per-node distribution choices
+beyond per-variable synchronizers (``strategy.proto:40-42``: node configs
+"could be any node in the graph"); these builders realize that extension
+point TPU-first: pipeline, sequence/context, and expert parallelism are
+*serializable strategies* — they flow through ``AutoDist.build``, the
+chief→worker strategy handoff, ``Saver``, and ``AutoStrategy`` exactly
+like the reference-parity DP/PS/AR strategies, instead of being library
+functions outside the IR.
+
+Each builder emits node configs for every variable (so strategy
+pretty-printing and serialization stay uniform) plus a ``GraphConfig``
+whose ``lowering`` selects the backend and whose ``parallel`` dict holds
+the schedule knobs.
+"""
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence
+
+from autodist_tpu import const
+from autodist_tpu.strategy.base import StrategyBuilder
+from autodist_tpu.strategy.ir import (AllReduceSynchronizer, NodeConfig,
+                                      PartitionerConfig, Strategy)
+
+
+class SequenceParallel(StrategyBuilder):
+    """Sequence/context parallelism over the ``seq`` mesh axis.
+
+    The mesh must declare a ``seq`` axis (e.g. ``mesh: {data: 2, seq: 4}``);
+    token-dimension batch leaves (named by ``seq_leaves``) are split over
+    ``data x seq``, parameters replicate, and gradients synchronize over
+    both axes.  The model must attend globally (ring attention,
+    :mod:`autodist_tpu.parallel.ring_attention`) and position tokens with
+    :func:`autodist_tpu.parallel.sequence.global_positions`.
+    """
+
+    def __init__(self, seq_leaves: Sequence[str] = ("x", "y")):
+        self.seq_leaves = tuple(seq_leaves)
+
+    def build(self, trainable, resource_spec):
+        shape = resource_spec.resolved_mesh_shape()
+        if const.SEQ_AXIS not in shape:
+            raise ValueError(
+                f"SequenceParallel needs a {const.SEQ_AXIS!r} mesh axis; "
+                f"spec resolves to {shape} — declare e.g. "
+                "mesh: {data: ..., seq: ...}")
+        nodes = [NodeConfig(var_name=i.name,
+                            synchronizer=AllReduceSynchronizer(),
+                            is_sparse=i.is_sparse)
+                 for i in trainable.var_infos()]
+        cfg = self._graph_config(resource_spec)
+        cfg.lowering = "sequence"
+        cfg.parallel = {"seq_leaves": list(self.seq_leaves)}
+        return Strategy(node_configs=nodes, graph_config=cfg)
+
+
+class Pipeline(StrategyBuilder):
+    """Microbatched pipeline parallelism over the ``pipe`` mesh axis.
+
+    Lowers a :class:`~autodist_tpu.capture.PipelineTrainable` (stacked
+    stage parameters, leading stage dimension) onto the pipeline schedule
+    of :mod:`autodist_tpu.parallel.pipeline`: every stage variable is
+    stored sharded on the ``pipe`` axis, activations hop stages via a
+    ``ppermute`` ring.  ``GraphConfig.accum_steps`` (GradAccumulation)
+    composes: each accumulation slice runs the full microbatched
+    schedule.
+    """
+
+    def __init__(self, num_microbatches: int = 1):
+        if num_microbatches < 1:
+            raise ValueError("num_microbatches must be >= 1")
+        self.num_microbatches = num_microbatches
+
+    def build(self, trainable, resource_spec):
+        shape = resource_spec.resolved_mesh_shape()
+        if const.PIPE_AXIS not in shape:
+            raise ValueError(
+                f"Pipeline needs a {const.PIPE_AXIS!r} mesh axis; spec "
+                f"resolves to {shape} — declare e.g. "
+                "mesh: {data: ..., pipe: ...}")
+        num_stages = getattr(trainable, "num_stages", None)
+        if num_stages is not None and num_stages != shape[const.PIPE_AXIS]:
+            raise ValueError(
+                f"trainable declares {num_stages} stages; mesh pipe axis "
+                f"has {shape[const.PIPE_AXIS]}")
+        nodes = []
+        for i in trainable.var_infos():
+            spec = [const.PIPE_AXIS] + [None] * (max(len(i.shape), 1) - 1)
+            nodes.append(NodeConfig(
+                var_name=i.name,
+                synchronizer=AllReduceSynchronizer(),
+                partitioner=PartitionerConfig(mesh_axis=const.PIPE_AXIS,
+                                              spec=spec),
+                is_sparse=i.is_sparse))
+        cfg = self._graph_config(resource_spec)
+        cfg.lowering = "pipeline"
+        cfg.parallel = {"num_microbatches": self.num_microbatches}
+        return Strategy(node_configs=nodes, graph_config=cfg)
+
+
+_EXPERT_NAME_RE = re.compile(r"(expert|moe)", re.IGNORECASE)
+
+
+class ExpertParallel(StrategyBuilder):
+    """Expert parallelism (MoE) over the ``expert`` mesh axis.
+
+    Variables carrying a leading expert dimension — named explicitly via
+    ``expert_params`` (path-suffix match) or auto-detected (name contains
+    ``expert``/``moe`` and the leading dim divides the expert axis) — are
+    stored sharded across experts; everything else replicates with the
+    expert axis doubling as a batch axis (GShard arrangement).  The
+    model must route tokens through
+    :func:`autodist_tpu.parallel.moe.expert_parallel_ffn`.
+    """
+
+    def __init__(self, expert_params: Sequence[str] = (),
+                 detect: bool = True):
+        self.expert_params = tuple(expert_params)
+        self.detect = detect
+
+    def build(self, trainable, resource_spec):
+        shape = resource_spec.resolved_mesh_shape()
+        if const.EXPERT_AXIS not in shape:
+            raise ValueError(
+                f"ExpertParallel needs an {const.EXPERT_AXIS!r} mesh axis; "
+                f"spec resolves to {shape} — declare e.g. "
+                "mesh: {expert: ...}")
+        E = shape[const.EXPERT_AXIS]
+        nodes = []
+        matched = set()
+        for i in trainable.var_infos():
+            explicit = any(i.name == p or i.name.endswith("/" + p)
+                           for p in self.expert_params)
+            auto = (self.detect and _EXPERT_NAME_RE.search(i.name)
+                    and len(i.shape) >= 2 and i.shape[0] % E == 0)
+            node = NodeConfig(var_name=i.name,
+                              synchronizer=AllReduceSynchronizer(),
+                              is_sparse=i.is_sparse)
+            if explicit or auto:
+                matched.add(i.name)
+                node.partitioner = PartitionerConfig(
+                    mesh_axis=const.EXPERT_AXIS,
+                    spec=[const.EXPERT_AXIS]
+                    + [None] * (len(i.shape) - 1))
+            nodes.append(node)
+        for p in self.expert_params:
+            if not any(n == p or n.endswith("/" + p) for n in matched):
+                raise ValueError(
+                    f"expert_params entry {p!r} matched no variable "
+                    f"(have {[i.name for i in trainable.var_infos()]})")
+        if not matched:
+            raise ValueError(
+                "ExpertParallel found no expert variables: pass "
+                "expert_params=... or name them with 'expert'/'moe'")
+        cfg = self._graph_config(resource_spec)
+        cfg.lowering = "expert"
+        cfg.parallel = {}
+        return Strategy(node_configs=nodes, graph_config=cfg)

@@ -1,0 +1,348 @@
+"""Advanced parallelisms as first-class Strategy-IR citizens.
+
+The reference's IR anticipated per-node distribution choices
+(``strategy.proto:40-42``); these tests pin the promoted form: sequence /
+pipeline / expert parallelism built as *serializable strategies* through
+``AutoDist(spec, builder).build(trainable)``, with golden equality
+against single-device execution and JSON round-trips.
+"""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from autodist_tpu import AutoDist, PipelineTrainable, Trainable
+from autodist_tpu.parallel.moe import expert_parallel_ffn
+from autodist_tpu.parallel.ring_attention import ring_self_attention
+from autodist_tpu.parallel.sequence import global_positions
+from autodist_tpu.strategy.ir import Strategy
+
+VOCAB, DIM, HEADS, SEQ = 64, 32, 2, 32
+
+
+# --------------------------------------------------------------------------- #
+# Sequence parallelism through the IR
+# --------------------------------------------------------------------------- #
+class TinyCausalLM(nn.Module):
+    attention: any
+    positions: any
+
+    @nn.compact
+    def __call__(self, tokens):
+        B, L = tokens.shape
+        embed = nn.Embed(VOCAB, DIM, name="embed")
+        pos_table = self.param("pos", nn.initializers.normal(0.02),
+                               (SEQ, DIM))
+        x = embed(tokens) + pos_table[self.positions(L)]
+        qkv = nn.Dense(3 * DIM, name="qkv")(x).reshape(B, L, 3, HEADS,
+                                                       DIM // HEADS)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = self.attention(q, k, v).reshape(B, L, DIM)
+        x = x + nn.Dense(DIM, name="out")(o)
+        x = nn.LayerNorm(name="ln")(x)
+        return embed.attend(x)
+
+
+def plain_causal_attention(q, k, v):
+    depth = q.shape[-1]
+    s = jnp.einsum("blhd,bmhd->bhlm", q, k) / np.sqrt(depth)
+    L = q.shape[1]
+    mask = jnp.tril(jnp.ones((L, L), bool))
+    s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhlm,bmhd->blhd", p, v)
+
+
+def make_lm_trainable(sharded: bool):
+    if sharded:
+        attn = lambda q, k, v: ring_self_attention(q, k, v, axis_name="seq",
+                                                   causal=True)
+        pos = lambda L: global_positions(L)
+    else:
+        attn = plain_causal_attention
+        pos = lambda L: jnp.arange(L)
+    model = TinyCausalLM(attention=attn, positions=pos)
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["x"])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, batch["y"][..., None], axis=-1)
+        return -jnp.mean(ll)
+
+    init_model = TinyCausalLM(attention=plain_causal_attention,
+                              positions=lambda L: jnp.arange(L))
+    params = init_model.init(jax.random.PRNGKey(0),
+                             jnp.zeros((2, SEQ), jnp.int32))["params"]
+    return Trainable.from_loss_fn(loss_fn, params, optax.sgd(0.5))
+
+
+def lm_batches(n):
+    r = np.random.RandomState(0)
+    out = []
+    for _ in range(n):
+        x = r.randint(0, VOCAB, (8, SEQ)).astype(np.int32)
+        out.append({"x": x, "y": np.roll(x, -1, axis=1)})
+    return out
+
+
+SEQ_SPEC = {"topology": {"platform": "cpu", "num_devices": 8},
+            "mesh": {"data": 2, "seq": 4}}
+
+
+def test_sequence_parallel_through_autodist_matches_single_device():
+    """The VERDICT round-3 'done' bar: a ring-attention sequence-parallel
+    transformer trained end-to-end through
+    ``AutoDist(spec, "SequenceParallel").build(trainable)`` reproduces
+    the unsharded single-device run exactly."""
+    ad = AutoDist(SEQ_SPEC, "SequenceParallel")
+    trainable = make_lm_trainable(sharded=True)
+    runner = ad.build(trainable)
+    bs = lm_batches(3)
+    for b in bs:
+        metrics = runner.step(b, rng=jax.random.PRNGKey(0))
+    assert np.isfinite(float(np.asarray(metrics["loss"])))
+
+    ref = make_lm_trainable(sharded=False)
+    params = ref.params
+    opt_state = ref.optimizer.init(params)
+    for b in bs:
+        def loss_for(p):
+            l, _, _ = ref.loss(p, None, jax.tree.map(jnp.asarray, b),
+                               jax.random.PRNGKey(0))
+            return l
+        grads = jax.grad(loss_for)(params)
+        updates, opt_state = ref.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), rtol=2e-5, atol=2e-5),
+        runner.get_params(), jax.device_get(params))
+
+
+def test_sequence_strategy_serializes_and_rebuilds():
+    """The serialized strategy is a complete artifact: a worker
+    deserializing the JSON (the chief→worker handoff) lowers to the same
+    program and computes the same numbers."""
+    ad = AutoDist(SEQ_SPEC, "SequenceParallel")
+    trainable = make_lm_trainable(sharded=True)
+    strategy = ad.build_or_load_strategy(trainable)
+    assert strategy.graph_config.lowering == "sequence"
+    assert strategy.graph_config.parallel == {"seq_leaves": ["x", "y"]}
+
+    clone = Strategy.from_json(strategy.to_json())
+    assert clone.graph_config.to_dict() == strategy.graph_config.to_dict()
+    assert [n.to_dict() for n in clone.node_configs] \
+        == [n.to_dict() for n in strategy.node_configs]
+
+    b = lm_batches(1)[0]
+    r1 = ad.build(trainable, strategy)
+    m1 = r1.step(b, rng=jax.random.PRNGKey(0))
+    r2 = ad.build(make_lm_trainable(sharded=True), clone)
+    m2 = r2.step(b, rng=jax.random.PRNGKey(0))
+    np.testing.assert_allclose(float(np.asarray(m1["loss"])),
+                               float(np.asarray(m2["loss"])), rtol=1e-6)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), rtol=1e-6, atol=1e-6),
+        r1.get_params(), r2.get_params())
+
+
+def test_sequence_runner_checkpoint_roundtrip(tmp_path):
+    """Saver works on the promoted lowering: exact resume."""
+    from autodist_tpu.checkpoint.saver import Saver
+
+    ad = AutoDist(SEQ_SPEC, "SequenceParallel")
+    runner = ad.build(make_lm_trainable(sharded=True))
+    bs = lm_batches(2)
+    runner.step(bs[0], rng=jax.random.PRNGKey(0))
+    saver = Saver(str(tmp_path))
+    saver.save(runner)
+
+    runner.step(bs[1], rng=jax.random.PRNGKey(1))
+    stepped = jax.device_get(runner.get_params())
+    saver.restore(runner)
+    assert runner.step_count == 1
+    runner.step(bs[1], rng=jax.random.PRNGKey(1))
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), rtol=1e-6, atol=1e-6),
+        jax.device_get(runner.get_params()), stepped)
+    saver.close()
+
+
+# --------------------------------------------------------------------------- #
+# Pipeline parallelism through the IR
+# --------------------------------------------------------------------------- #
+S_STAGES, HID = 4, 8
+
+
+def mlp_stage(params, x):
+    return jax.nn.relu(x @ params["w"] + params["b"])
+
+
+def mse_head(outputs, batch):
+    l = jnp.mean((outputs - batch["y"]) ** 2)
+    return l, {}
+
+
+def make_pipeline_trainable(seed=0):
+    r = np.random.RandomState(seed)
+    stacked = {"w": jnp.asarray(r.randn(S_STAGES, HID, HID) * 0.5,
+                                jnp.float32),
+               "b": jnp.asarray(r.randn(S_STAGES, HID) * 0.1, jnp.float32)}
+    return PipelineTrainable(mlp_stage, stacked, mse_head, optax.sgd(0.05),
+                             num_stages=S_STAGES)
+
+
+PIPE_SPEC = {"topology": {"platform": "cpu", "num_devices": 8},
+             "mesh": {"data": 2, "pipe": 4}}
+
+
+def pipe_batches(n, seed=2):
+    r = np.random.RandomState(seed)
+    return [{"x": r.randn(8, HID).astype(np.float32),
+             "y": r.randn(8, HID).astype(np.float32)} for _ in range(n)]
+
+
+def sequential_train(trainable, batches):
+    """Single-device reference: PipelineTrainable.loss IS the sequential
+    semantics."""
+    params = trainable.params
+    opt_state = trainable.optimizer.init(params)
+    losses = []
+    for b in batches:
+        jb = jax.tree.map(jnp.asarray, b)
+
+        def loss_for(p):
+            l, _, _ = trainable.loss(p, None, jb, None)
+            return l
+
+        losses.append(float(loss_for(params)))
+        g = jax.grad(loss_for)(params)
+        upd, opt_state = trainable.optimizer.update(g, opt_state, params)
+        params = optax.apply_updates(params, upd)
+    return params, losses
+
+
+def test_pipeline_through_autodist_matches_sequential():
+    ad = AutoDist(PIPE_SPEC, "Pipeline", num_microbatches=2)
+    trainable = make_pipeline_trainable()
+    runner = ad.build(trainable)
+    bs = pipe_batches(3)
+    losses = []
+    for b in bs:
+        m = runner.step(b)
+        losses.append(float(np.asarray(m["loss"])))
+
+    ref_params, ref_losses = sequential_train(make_pipeline_trainable(), bs)
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-4, atol=1e-5)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-5),
+        runner.get_params(), jax.device_get(ref_params))
+
+
+def test_pipeline_strategy_serializes():
+    ad = AutoDist(PIPE_SPEC, "Pipeline", num_microbatches=2)
+    strategy = ad.build_or_load_strategy(make_pipeline_trainable())
+    assert strategy.graph_config.lowering == "pipeline"
+    assert strategy.graph_config.parallel == {"num_microbatches": 2}
+    clone = Strategy.from_json(strategy.to_json())
+    assert clone.graph_config.parallel == {"num_microbatches": 2}
+    # every stage variable is pipe-sharded in the IR
+    for n in clone.node_configs:
+        assert n.partitioner.spec[0] == "pipe"
+
+
+def test_pipeline_composes_with_grad_accumulation():
+    """GraphConfig.accum_steps x pipeline microbatching: each accumulation
+    slice runs the full schedule; the update equals one big-batch
+    sequential step (linear-in-loss grads: mean of slice grads == full
+    grad only when slices are equal-sized, which they are)."""
+    from autodist_tpu.strategy.builders import GradAccumulation
+    from autodist_tpu.strategy.parallel_builders import Pipeline
+
+    ad = AutoDist(PIPE_SPEC,
+                  GradAccumulation(Pipeline(num_microbatches=2), steps=2))
+    trainable = make_pipeline_trainable()
+    runner = ad.build(trainable)
+    b = pipe_batches(1, seed=5)[0]  # [8, HID] -> 2 accum slices of 4
+    runner.step(b)
+
+    ref_params, _ = sequential_train(make_pipeline_trainable(), [b])
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-5),
+        runner.get_params(), jax.device_get(ref_params))
+
+
+# --------------------------------------------------------------------------- #
+# Expert parallelism through the IR
+# --------------------------------------------------------------------------- #
+E, M_DIM, H_DIM, G = 8, 16, 32, 8   # 8 experts over 4 devices, G tokens/dev
+
+EXPERT_SPEC = {"topology": {"platform": "cpu", "num_devices": 4},
+               "mesh": {"expert": 4}}
+
+
+def make_moe_trainable(seed=0):
+    r = np.random.RandomState(seed)
+    params = {
+        "gate": jnp.asarray(r.randn(M_DIM, E) * 0.5, jnp.float32),
+        "moe_wi": jnp.asarray(r.randn(E, M_DIM, H_DIM) * 0.2, jnp.float32),
+        "moe_wo": jnp.asarray(r.randn(E, H_DIM, M_DIM) * 0.2, jnp.float32),
+    }
+
+    def loss_fn(p, batch):
+        out, aux = expert_parallel_ffn(batch["x"], p["gate"], p["moe_wi"],
+                                       p["moe_wo"], capacity_factor=4.0)
+        return jnp.mean((out - batch["y"]) ** 2) + 0.01 * aux
+
+    return Trainable.from_loss_fn(loss_fn, params, optax.adam(1e-2))
+
+
+def test_expert_parallel_through_autodist_trains():
+    ad = AutoDist(EXPERT_SPEC, "ExpertParallel")
+    trainable = make_moe_trainable()
+    runner = ad.build(trainable)
+
+    # expert tables are stored sharded on the expert axis
+    spec_wi = runner.lowered.state_specs["params"]["moe_wi"]
+    assert spec_wi == P("expert", None, None)
+    assert runner.lowered.state_specs["params"]["gate"] == P()
+
+    r = np.random.RandomState(3)
+    x = r.randn(4 * G, M_DIM).astype(np.float32)
+    y = (x @ (r.randn(M_DIM, M_DIM).astype(np.float32) * 0.1))
+    losses = []
+    for _ in range(10):
+        m = runner.step({"x": x, "y": y})
+        losses.append(float(np.asarray(m["loss"])))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_expert_strategy_serializes_and_marks_experts():
+    ad = AutoDist(EXPERT_SPEC, "ExpertParallel")
+    strategy = ad.build_or_load_strategy(make_moe_trainable())
+    assert strategy.graph_config.lowering == "expert"
+    by_name = {n.var_name: n for n in strategy.node_configs}
+    assert by_name["moe_wi"].partitioner.spec[0] == "expert"
+    assert by_name["moe_wo"].partitioner.spec[0] == "expert"
+    assert by_name["gate"].partitioner is None
+    clone = Strategy.from_json(strategy.to_json())
+    assert {n.var_name: bool(n.partitioner) for n in clone.node_configs} \
+        == {n.var_name: bool(n.partitioner) for n in strategy.node_configs}
+
+
+def test_expert_parallel_requires_expert_vars():
+    ad = AutoDist(EXPERT_SPEC, "ExpertParallel")
+    plain = Trainable.from_loss_fn(
+        lambda p, b: jnp.sum(p["w"] * b["x"]),
+        {"w": jnp.ones((4, 4))}, optax.sgd(0.1))
+    with pytest.raises(ValueError, match="no expert variables"):
+        ad.build_or_load_strategy(plain)
