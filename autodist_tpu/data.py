@@ -263,17 +263,18 @@ def lm_window_loader(path: str, *, batch_size: int, seq_len: int,
     n = len(tokens)
     if n < seq_len + 1:
         raise ValueError(f"{path!r} has {n} tokens < seq_len+1")
-    rng = np.random.RandomState(seed)
-    pending: list[np.ndarray] = []
 
-    def sample():
+    def offsets_for(step: int) -> np.ndarray:
+        # Deterministic in (seed, step) — not a stateful stream — so a
+        # resumed job (fit() shifts the source by the restored step)
+        # really continues the data order instead of replaying windows
+        # from the seed.
+        rng = np.random.RandomState(np.array([seed, step], np.uint32))
         return rng.randint(0, n - seq_len, size=batch_size).astype(np.int64)
 
     def source(step: int):
-        offs = pending.pop() if pending else sample()
-        nxt = sample()
-        pending.append(nxt)
-        tokens.prefetch(nxt, seq_len + 1)
+        offs = offsets_for(step)
+        tokens.prefetch(offsets_for(step + 1), seq_len + 1)
         w = tokens.gather(offs, seq_len + 1)
         return {"x": np.ascontiguousarray(w[:, :-1]),
                 "y": np.ascontiguousarray(w[:, 1:])}

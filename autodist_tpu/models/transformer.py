@@ -38,9 +38,10 @@ class TransformerConfig:
     # (local_len) -> position ids; None = arange.  Sequence-parallel
     # models pass parallel.sequence.global_positions so shards embed
     # their true offsets instead of restarting at 0.  max_len must cover
-    # the GLOBAL sequence (shards x local_len): ids beyond it clamp in
-    # the gather — silently wrong embeddings, unlike the default slice
-    # path which fails loudly on a shape mismatch.
+    # the GLOBAL sequence (shards x local_len): ids beyond it are
+    # NaN-poisoned at the gather (loss turns NaN immediately) instead of
+    # clamping to silently wrong embeddings; global_positions(max_len=...)
+    # additionally rejects the mismatch statically at trace time.
     position_fn: Optional[Callable] = None
     causal: bool = False
 
@@ -145,8 +146,17 @@ class TransformerLM(nn.Module):
         pos_embed = self.param(
             "pos_embed", nn.initializers.normal(0.02),
             (cfg.max_len, cfg.hidden_size), jnp.float32)
-        pos = (pos_embed[cfg.position_fn(L)] if cfg.position_fn is not None
-               else pos_embed[:L])
+        if cfg.position_fn is not None:
+            pos_ids = cfg.position_fn(L)
+            pos = pos_embed[pos_ids]
+            # The gather clamps out-of-range ids (repeating the last row —
+            # silently wrong embeddings when max_len does not cover
+            # shards x local_len); poison them to NaN so the loss goes
+            # NaN on the first step instead.
+            oob = (pos_ids < 0) | (pos_ids >= cfg.max_len)
+            pos = jnp.where(oob[:, None], jnp.nan, pos)
+        else:
+            pos = pos_embed[:L]
         x = embed(tokens) + pos[None].astype(cfg.dtype)
         x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
         causal = nn.make_causal_mask(tokens, dtype=jnp.bool_)

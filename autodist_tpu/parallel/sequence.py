@@ -29,10 +29,23 @@ from autodist_tpu import const
 from autodist_tpu.kernel import common
 
 
-def global_positions(local_len: int, *, seq_axis: str = const.SEQ_AXIS):
+def global_positions(local_len: int, *, seq_axis: str = const.SEQ_AXIS,
+                     max_len: Optional[int] = None):
     """Global token positions of this device's sequence chunk — what a
     sequence-parallel model feeds its positional embedding (a local
-    ``arange`` would restart at 0 on every shard)."""
+    ``arange`` would restart at 0 on every shard).
+
+    ``max_len`` (the positional table size) enables a *static* trace-time
+    check that the global sequence ``shards x local_len`` fits the table
+    — both quantities are known inside ``shard_map`` — so a too-small
+    table fails at build instead of via the runtime NaN guard in
+    :class:`~autodist_tpu.models.transformer.TransformerLM`."""
+    shards = lax.axis_size(seq_axis)
+    if max_len is not None and shards * local_len > max_len:
+        raise ValueError(
+            f"positional table max_len={max_len} does not cover the "
+            f"global sequence: {shards} seq shards x {local_len} local "
+            f"tokens = {shards * local_len}")
     return lax.axis_index(seq_axis) * local_len + jnp.arange(local_len)
 
 

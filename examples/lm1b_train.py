@@ -41,18 +41,18 @@ def main():
 
     if args.data:
         from autodist_tpu.data import lm_window_loader
-        raw = lm_window_loader(args.data, batch_size=args.batch_size,
-                               seq_len=args.seq_len, seed=0)
-
-        def source(step):
-            b = raw(step)
-            if step == 0:  # gather clamps silently; fail loudly instead
-                hi = max(int(b["x"].max()), int(b["y"].max()))
-                if hi >= args.vocab_size:
-                    raise SystemExit(
-                        f"--data contains token id {hi} >= --vocab-size "
-                        f"{args.vocab_size}; pass the tokenizer's size")
-            return b
+        # The embedding gather clamps out-of-range ids silently; scan the
+        # whole file's max once up front (a streaming pass over the mmap)
+        # so a bad id in ANY window fails loudly, not just step 0's.
+        mm = np.memmap(args.data, dtype=np.int32, mode="r")
+        hi = int(mm.max()) if len(mm) else 0
+        del mm
+        if hi >= args.vocab_size:
+            raise SystemExit(
+                f"--data contains token id {hi} >= --vocab-size "
+                f"{args.vocab_size}; pass the tokenizer's size")
+        source = lm_window_loader(args.data, batch_size=args.batch_size,
+                                  seq_len=args.seq_len, seed=0)
     else:
         rng = np.random.RandomState(0)
 

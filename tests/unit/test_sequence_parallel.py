@@ -17,6 +17,8 @@ from autodist_tpu.parallel.ring_attention import (make_ring_attention_fn,
 from autodist_tpu.parallel.sequence import (global_positions,
                                             lower_sequence_parallel)
 
+pytestmark = pytest.mark.slow
+
 VOCAB, DIM, HEADS, SEQ = 64, 32, 2, 32
 
 
@@ -194,3 +196,39 @@ def test_sequence_parallel_ring_flash_matches_single_device():
         lambda a, e: np.testing.assert_allclose(
             np.asarray(a), np.asarray(e), rtol=2e-4, atol=2e-5),
         got, jax.device_get(ref))
+
+
+def test_global_positions_static_max_len_check():
+    """A positional table too small for shards x local_len fails at trace
+    time (both quantities are static inside shard_map)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("seq",))
+
+    def f():
+        return global_positions(16, max_len=32)  # 4 shards x 16 > 32
+
+    with pytest.raises(ValueError, match="does not cover"):
+        jax.jit(jax.shard_map(f, mesh=mesh, in_specs=(), out_specs=P("seq"),
+                              check_vma=False)).lower()
+
+
+def test_position_fn_out_of_range_poisons_to_nan():
+    """Out-of-range position ids must surface as NaN loss on step one,
+    not silently-clamped (repeated last-row) embeddings."""
+    from autodist_tpu.models.transformer import (TransformerConfig,
+                                                 TransformerLM)
+
+    cfg = TransformerConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                            num_heads=2, mlp_dim=32, max_len=8,
+                            dropout_rate=0.0, attention_dropout_rate=0.0,
+                            position_fn=lambda L: jnp.arange(L) + 4)
+    model = TransformerLM(cfg)
+    tokens = jnp.zeros((2, 8), jnp.int32)  # ids 4..11 vs max_len 8 -> oob
+    params = TransformerLM(
+        TransformerConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                          num_heads=2, mlp_dim=32, max_len=8,
+                          dropout_rate=0.0, attention_dropout_rate=0.0)
+    ).init(jax.random.PRNGKey(0), tokens)["params"]
+    logits = model.apply({"params": params}, tokens)
+    assert bool(jnp.isnan(logits).any())
