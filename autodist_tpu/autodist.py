@@ -138,10 +138,17 @@ class AutoDist:
         # of recompiling the identical program.
         take_cached = getattr(self.strategy_builder, "take_cached_runner",
                               None)
-        if take_cached is not None and not runner_kwargs and rng is None:
-            cached = take_cached(strategy.id)
+        if take_cached is not None:
+            cached = (take_cached(strategy.id)
+                      if not runner_kwargs and rng is None else None)
             if cached is not None:
                 return cached
+            # Cache bypassed (custom rng/runner kwargs, or a different
+            # strategy id): release the measured winner's compiled runner
+            # now, or it would pin HBM alongside the fresh build below.
+            drop = getattr(self.strategy_builder, "drop_cached_runner", None)
+            if drop is not None:
+                drop()
         from autodist_tpu.strategy.ir import PSSynchronizer
         async_nodes = [
             nc for nc in strategy.node_configs

@@ -18,7 +18,7 @@ from autodist_tpu.utils import logging
 
 
 def default_candidates() -> list[StrategyBuilder]:
-    from autodist_tpu.strategy import gspmd_builders
+    from autodist_tpu.strategy import gspmd_builders, parallel_builders
 
     return [
         _builders.AllReduce(),
@@ -33,6 +33,12 @@ def default_candidates() -> list[StrategyBuilder]:
         # model and the candidate is skipped).
         gspmd_builders.FSDPSharded(),
         gspmd_builders.TensorParallel(),
+        # Advanced parallelisms: score only when the topology declares
+        # their mesh axis (seq / pipe) — and, for Pipeline, when the
+        # trainable is stage-structured; otherwise build() raises
+        # ValueError and the candidate is skipped.
+        parallel_builders.SequenceParallel(),
+        parallel_builders.Pipeline(num_microbatches=4),
     ]
 
 
@@ -79,7 +85,26 @@ class AutoStrategy(StrategyBuilder):
         self._winner_strategy_id = None
 
     def build(self, trainable, resource_spec):
-        model = CostModel(resource_spec, **self.cost_model_kwargs)
+        cm_kwargs = dict(self.cost_model_kwargs)
+        if ("tokens_per_step" not in cm_kwargs
+                and getattr(trainable, "tokens_per_step", None) is None
+                and self.example_batch is not None):
+            # Infer the activation-shape hint from the measurement batch:
+            # a rank-2 *integer* leaf is a [B, L] token-id tensor.  Float
+            # leaves (images, features) are not tokens — inferring from
+            # them would price bogus activation collectives, so they
+            # leave the hint unset (declare Trainable(tokens_per_step=)
+            # to opt in explicitly).
+            import numpy as _np
+
+            import jax as _jax
+            for leaf in _jax.tree.leaves(self.example_batch):
+                if _np.ndim(leaf) == 2 and _np.issubdtype(
+                        _np.asarray(leaf).dtype, _np.integer):
+                    shape = _np.shape(leaf)
+                    cm_kwargs["tokens_per_step"] = int(shape[0] * shape[1])
+                    break
+        model = CostModel(resource_spec, **cm_kwargs)
         self.measured = {}
         self._winner_runner = None
         self._winner_strategy_id = None
@@ -95,11 +120,37 @@ class AutoStrategy(StrategyBuilder):
             seen_names[name] = seen_names.get(name, 0) + 1
             if seen_names[name] > 1:
                 name = f"{name}#{seen_names[name]}"
+            if (name.startswith("SequenceParallel")
+                    and not getattr(trainable, "sequence_ready", False)):
+                # Splitting the token dim under a model with plain local
+                # attention silently changes the objective; only models
+                # declaring sequence_ready are auto-considered.
+                logging.debug("candidate %s skipped: trainable does not "
+                              "declare sequence_ready", name)
+                continue
             try:
                 strategy = builder.build(trainable, resource_spec)
             except ValueError as e:
                 logging.debug("candidate %s skipped: %s", name, e)
                 continue
+            if strategy.graph_config.lowering == "pipeline" \
+                    and self.example_batch is not None:
+                # Screen unbuildable pipeline configs: the schedule needs
+                # the per-shard batch divisible by num_microbatches.
+                import numpy as _np
+
+                import jax as _jax
+                M = int(strategy.graph_config.parallel.get(
+                    "num_microbatches", 1))
+                repl = max(strategy.graph_config.replicas, 1)
+                leaves = [l for l in _jax.tree.leaves(self.example_batch)
+                          if _np.ndim(l) > 0]
+                if leaves and (_np.shape(leaves[0])[0] % (repl * M)):
+                    logging.debug(
+                        "candidate %s skipped: batch %d not divisible by "
+                        "%d replicas x %d microbatches", name,
+                        _np.shape(leaves[0])[0], repl, M)
+                    continue
             # Distinct configs can emit byte-identical strategies (e.g.
             # two AllReduce chunk sizes on a model with few tensors):
             # keep only the first, so measurement slots never time the
@@ -163,6 +214,13 @@ class AutoStrategy(StrategyBuilder):
             runner.rng = jax.random.PRNGKey(0)
             return runner
         return None
+
+    def drop_cached_runner(self):
+        """Release the measured winner's compiled runner without handing
+        it out (called by ``AutoDist.build`` when the cache is bypassed),
+        freeing its device state instead of retaining HBM."""
+        self._winner_runner = None
+        self._winner_strategy_id = None
 
     # ------------------------------------------------------------------ #
     def _measure(self, trainable, resource_spec, scored):
