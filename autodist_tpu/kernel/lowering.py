@@ -152,6 +152,21 @@ def make_plan(trainable: Trainable, strategy: Strategy, mesh) -> Plan:
     buckets: dict[str, list[str]] = {}
     bucket_comp: dict[str, str] = {}
     ssp_staleness = 0
+    proxy_vars = [
+        nc.var_name for nc in strategy.node_configs
+        if isinstance(nc.synchronizer, PSSynchronizer)
+        and nc.synchronizer.local_replication]
+    if proxy_vars:
+        # The reference's ProxyVariable cached PS values on each worker
+        # (proxy_variable.py:74-114); on TPU parameters are re-gathered
+        # inside the compiled step every iteration, so there is nothing
+        # to cache — but a user explicitly requesting proxy caching must
+        # hear that the knob is a no-op, not silently lose it.
+        logging.warning(
+            "local_proxy_variable=True on %d variable(s) (e.g. %s) is a "
+            "no-op on TPU: parameters are re-gathered each step inside "
+            "the SPMD program (no cross-step cache to manage)",
+            len(proxy_vars), proxy_vars[0])
     for info in trainable.var_infos():
         node = strategy.node_config_for(info.name)
         sync = node.synchronizer if node else AllReduceSynchronizer()

@@ -10,9 +10,10 @@ memory is O(L·D) instead of O(L²) and the MXU sees back-to-back matmuls.
 
 Layout contract matches ``models/transformer.py``: q/k/v are
 ``[batch, length, heads, head_dim]``; softmax in fp32 regardless of input
-dtype.  The backward pass is a blockwise recompute from the saved
-logsumexp (standard flash-attention backward), written in plain JAX so
-XLA fuses it; forward is the Pallas kernel.
+dtype.  Forward and backward are both Pallas kernels: the backward is
+the standard blockwise recompute from the saved logsumexp, as dq and
+dk/dv kernels (``_bwd_dq_kernel`` / ``_bwd_dkv_kernel`` below) wired
+through a custom VJP.
 """
 from __future__ import annotations
 

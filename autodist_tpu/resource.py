@@ -79,6 +79,26 @@ class ResourceSpec:
             with open(spec) as f:
                 spec = yaml.safe_load(f)
         spec = dict(spec or {})
+        if "nodes" in spec:
+            # Reference-style SSH GPU inventories (resource_spec.py:160-215)
+            # do not describe a TPU topology; silently ignoring the key
+            # would train on a different cluster than the user declared.
+            # Heterogeneous replica sets in particular (the reference's
+            # r4.yml 2-GPU + 1-GPU workers with weighted-average gradient
+            # semantics, cases/c0.py:88-138) are deliberately out of scope:
+            # TPU pod slices are homogeneous by construction.
+            counts = {len(n.get("gpus", n.get("devices", [])) or [])
+                      for n in spec["nodes"] if isinstance(n, dict)}
+            if len(counts) > 1:
+                raise ValueError(
+                    "heterogeneous replica sets (nodes with differing "
+                    f"device counts {sorted(counts)}) are out of scope on "
+                    "homogeneous TPU meshes — see docs/usage/migration.md "
+                    "'Deliberate exclusions'")
+            raise ValueError(
+                "reference-style 'nodes' inventories are not a TPU "
+                "topology; declare topology.num_devices (+ multihost for "
+                "multi-process jobs) — see docs/usage/migration.md")
         topo = dict(spec.get("topology") or {})
         self.platform: str = topo.get("platform", "auto")
         self.generation: str = topo.get("generation", "auto")

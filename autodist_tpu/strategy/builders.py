@@ -10,9 +10,12 @@ reference builder           TPU-native realization
 PS                          ZeRO-1: every param's optimizer update runs on a
                             flat 1/N shard (grads reduce-scattered ≙ PS
                             accumulators), params re-gathered (≙ pull).
-PSLoadBalancing             same; greedy byte-size bin packing retained to
-                            tag shard destinations (it governs DCN placement
-                            for multi-slice meshes).
+PSLoadBalancing             same lowering; the greedy byte-size bin packing
+                            is retained as serialized provenance metadata
+                            (``reduction_destination`` tags).  The ZeRO-1
+                            lowering spreads optimizer state evenly over the
+                            mesh regardless — strictly better balance than
+                            the reference's greedy packing.
 PartitionedPS               FSDP/ZeRO-3: params stored sharded on the
                             partition axis, gathered on use.
 UnevenPartitionedPS         identical lowering; uneven shards become padding
@@ -71,7 +74,11 @@ class PS(StrategyBuilder):
 class PSLoadBalancing(PS):
     """PS with greedy byte-size load balancing (reference
     ``ps_lb_strategy.py:23-117``).  The bin index becomes the
-    ``reduction_destination`` shard tag."""
+    ``reduction_destination`` shard tag — serialized *metadata only*
+    (strategy provenance / parity with the reference's placement
+    decisions): the ZeRO-1 lowering spreads optimizer state uniformly
+    over the mesh, which strictly dominates greedy packing, so the tags
+    are not consumed by any execution path."""
 
     def build(self, trainable, resource_spec):
         infos = trainable.var_infos()

@@ -67,3 +67,47 @@ def test_chip_spec_lookup():
     rs = ResourceSpec({"topology": {"generation": "v5e"}})
     assert rs.chip.name == "v5e"
     assert rs.chip.peak_bf16_tflops > 0
+
+
+def test_reference_style_nodes_spec_rejected():
+    """Deliberate exclusion (docs/usage/migration.md): reference SSH GPU
+    inventories are not a TPU topology; heterogeneous ones name the
+    exclusion explicitly."""
+    import pytest
+    from autodist_tpu.resource import ResourceSpec
+
+    hetero = {"nodes": [{"address": "a", "gpus": [0, 1]},
+                        {"address": "b", "gpus": [0]}]}
+    with pytest.raises(ValueError, match="heterogeneous replica sets"):
+        ResourceSpec(hetero)
+
+    homo = {"nodes": [{"address": "a", "gpus": [0, 1]},
+                      {"address": "b", "gpus": [0, 1]}]}
+    with pytest.raises(ValueError, match="not a TPU topology"):
+        ResourceSpec(homo)
+
+
+def test_local_proxy_variable_warns_at_lowering(caplog):
+    """A no-op knob the user explicitly set must say so (reference
+    ProxyVariable has no TPU analog: params re-gather every step)."""
+    import logging as _logging
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist, PS, Trainable
+
+    t = Trainable.from_loss_fn(
+        lambda p, b: jnp.mean((b["x"] @ p["w"]) ** 2),
+        {"w": jnp.ones((4, 2))}, optax.sgd(0.1))
+    ad = AutoDist({"topology": {"platform": "cpu", "num_devices": 8}},
+                  PS(local_proxy_variable=True))
+    from autodist_tpu.utils.logging import get_logger
+    logger = get_logger()  # propagate=False: attach the capture handler
+    logger.addHandler(caplog.handler)
+    try:
+        ad.build(t)
+    finally:
+        logger.removeHandler(caplog.handler)
+    assert any("local_proxy_variable" in r.message for r in caplog.records)
