@@ -85,11 +85,30 @@ class Trainable:
         sparse_params: Sequence[str] = (),
         detect_sparse: bool = True,
         name: str = "trainable",
+        tokens_per_step: Optional[int] = None,
+        act_bytes_per_token: Optional[float] = None,
+        sequence_ready: bool = False,
     ):
         self.loss = loss
         self.params = params
         self.optimizer = optimizer
         self.extra = extra
+        # The model attends globally through ring attention and positions
+        # tokens with global offsets (parallel.sequence.global_positions)
+        # — i.e. splitting the token dimension preserves the objective.
+        # AutoStrategy only auto-considers SequenceParallel when declared:
+        # a model with plain local attention would train on a silently
+        # different objective under a seq-sharded batch.
+        self.sequence_ready = sequence_ready
+        # Optional shape hints for the analytic cost model: global tokens
+        # processed per optimizer step (batch x seq) and activation bytes
+        # a single token keeps live through fwd+bwd.  Strategies lower
+        # fine without them; with them AutoStrategy can also price
+        # activation collectives (TP, ring attention, pipeline hops) and
+        # activation memory — the axes that differentiate "which
+        # parallelism", not just "which DP flavor".
+        self.tokens_per_step = tokens_per_step
+        self.act_bytes_per_token = act_bytes_per_token
         # Inference-mode loss for runner.eval_step/evaluate: same signature
         # as ``loss`` but must apply the model with dropout off and BatchNorm
         # running averages.  Falls back to the train loss when not given.
@@ -187,7 +206,8 @@ class PipelineTrainable(Trainable):
     """
 
     def __init__(self, stage_fn, stacked_params, loss_head, optimizer, *,
-                 num_stages: int, batch_key: str = "x", **kw):
+                 num_stages: int, batch_key: str = "x",
+                 stage_aux: bool = False, **kw):
         sizes = set()
         for l in jax.tree_util.tree_leaves(stacked_params):
             shape = getattr(l, "shape", ())
@@ -200,12 +220,26 @@ class PipelineTrainable(Trainable):
         self.loss_head = loss_head
         self.num_stages = num_stages
         self.batch_key = batch_key
+        # stage_fn returns (activation, aux_scalar): per-stage auxiliary
+        # losses (summed over stages, averaged over microbatches in the
+        # pipelined execution — use mean-style aux so the average equals
+        # the full-batch value).
+        self.stage_aux = stage_aux
 
         def sequential_loss(params, extra, batch, rng):
             x = batch[batch_key]
+            aux_total = 0.0
             for i in range(num_stages):
-                x = stage_fn(jax.tree_util.tree_map(lambda p: p[i], params), x)
+                chunk = jax.tree_util.tree_map(lambda p: p[i], params)
+                if stage_aux:
+                    x, aux = stage_fn(chunk, x)
+                    aux_total = aux_total + aux
+                else:
+                    x = stage_fn(chunk, x)
             loss, metrics = loss_head(x, batch)
+            if stage_aux:
+                loss = loss + aux_total
+                metrics = dict(metrics, aux_loss=aux_total)
             return loss, extra, dict(metrics, loss=loss)
 
         super().__init__(sequential_loss, stacked_params, optimizer, **kw)

@@ -1,107 +1,279 @@
-"""Pipeline parallelism: GPipe-style microbatch schedule over the ``pipe``
-mesh axis.
+"""Pipeline parallelism: microbatch schedules over the ``pipe`` mesh axis.
 
 Absent from the reference (``architecture.rst:49-51``, SURVEY.md §2.10
 lists pipeline parallelism as not implemented) — built TPU-first: all
 pipeline stages run the *same* SPMD program (identical stage structure,
 stacked parameters sharded on the ``pipe`` axis); activations hop stage to
 stage via ``lax.ppermute`` inside a ``lax.scan`` over schedule ticks.
-The backward pass is the transposed ring (AD through ppermute), giving
-1F1B-equivalent communication without hand-written schedules.
+The backward pass is the transposed ring (AD through ppermute).
 
-Per-device memory: O(stage params + microbatch activations · ticks); use
+Two schedules, one implementation:
+
+* ``virtual_stages=1`` — GPipe fill-drain: microbatch ``m``'s stage ``c``
+  runs at tick ``m + c``; bubble fraction ``(n-1)/(M+n-1)``.
+* ``virtual_stages=V>1`` — Megatron-style interleaved: each device owns
+  ``V`` *chunks* (chunk ``c`` on device ``c mod n``), and chunk ``c`` of
+  microbatch ``m`` runs at tick
+
+      start(m, c) = n·V·⌊m/n⌋ + (m mod n) + c
+
+  which is provably conflict-free (for a device's chunks ``c ≡ d mod n``
+  the tick decomposes uniquely into ``(⌊m/n⌋, v, m mod n)`` base-V/base-n
+  digits) and keeps the one-hop property ``start(m, c+1) = start(m, c)+1``
+  — so the same single-carry ppermute ring serves both schedules.  Total
+  ticks drop from ``V·(M + n - 1)`` chunk-times (GPipe with V-chunk
+  stages) to ``M·V + n·V - ...`` — precisely ``num_ticks`` below — and
+  the bubble shrinks ~``V``-fold: ``(n-1)/(M·V + n - 1)``.
+
+Activations are pytrees; stages may emit auxiliary scalar losses
+(``stage_aux=True``) which accumulate across every chunk — the
+"non-last-stage loss" path (e.g. MoE balance terms inside pipeline
+stages).
+
+Per-device memory: O(V·chunk params + activations · ticks); use
 ``jax.checkpoint`` in ``stage_fn`` for long pipelines.
 """
 from __future__ import annotations
 
-from typing import Callable
+import dataclasses
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from autodist_tpu import const
 from autodist_tpu.kernel import common
+from autodist_tpu.kernel.lowering import SimpleLowered
 
 
+# --------------------------------------------------------------------------- #
+# The schedule (shared by the kernel and by tests/diagnostics)
+# --------------------------------------------------------------------------- #
+def start_tick(m: int, c: int, *, num_devices: int, virtual_stages: int):
+    """Tick at which chunk ``c`` of microbatch ``m`` runs (host math)."""
+    n, V = num_devices, virtual_stages
+    return n * V * (m // n) + m % n + c
+
+
+def num_ticks(num_microbatches: int, num_devices: int,
+              virtual_stages: int) -> int:
+    """Total schedule ticks = start of the last (microbatch, chunk) + 1."""
+    n, V, M = num_devices, virtual_stages, num_microbatches
+    return start_tick(M - 1, n * V - 1, num_devices=n,
+                      virtual_stages=V) + 1
+
+
+def bubble_fraction(num_microbatches: int, num_devices: int,
+                    virtual_stages: int) -> float:
+    """Idle fraction of the schedule: (ticks - useful) / ticks, where a
+    device's useful ticks are its M·V chunk computations."""
+    T = num_ticks(num_microbatches, num_devices, virtual_stages)
+    useful = num_microbatches * virtual_stages
+    return (T - useful) / T
+
+
+def _tick_assignment(t, device, *, n: int, V: int, M: int):
+    """(valid, m, v) processed by ``device`` at tick ``t`` (traced math).
+
+    Inverts ``start(m, c)``: with ``c = v·n + device``,
+    ``t - device = (m mod n) + n·(v + V·⌊m/n⌋)``.
+    """
+    rel = t - device
+    nonneg = rel >= 0
+    rel_safe = jnp.maximum(rel, 0)
+    r = rel_safe % n
+    v = (rel_safe // n) % V
+    q = rel_safe // (n * V)
+    m = q * n + r
+    valid = nonneg & (m < M)
+    return valid, jnp.clip(m, 0, M - 1), v
+
+
+# --------------------------------------------------------------------------- #
+# The kernel
+# --------------------------------------------------------------------------- #
 def pipeline_apply(stage_fn: Callable, stage_params, x, *,
                    axis_name: str = const.PIPE_AXIS,
-                   num_microbatches: int):
+                   num_microbatches: int, virtual_stages: int = 1,
+                   stage_aux: bool = False):
     """Run the pipeline schedule (call inside ``shard_map``).
 
     Args:
-      stage_fn: ``(stage_params, activation) -> activation`` — one stage.
-      stage_params: this device's stage parameters (local shard).
-      x: local batch ``[B, ...]``; split into ``num_microbatches`` along dim 0.
-        Only stage 0's value is consumed; pass the same batch on all stages.
+      stage_fn: ``(chunk_params, activation) -> activation`` (or
+        ``-> (activation, aux_scalar)`` with ``stage_aux=True``) — one
+        pipeline chunk.  Activations are pytrees; chunk 0 consumes a
+        microbatch of ``x``, so the activation structure/shapes must
+        match the microbatch's.
+      stage_params: this device's chunk parameters — the local shard.
+        ``virtual_stages == 1``: the chunk's params directly;
+        ``virtual_stages == V > 1``: leaves carry a leading ``[V]`` dim
+        (local chunk ``v`` is global chunk ``v·n + device``).
+      x: local batch pytree ``[B, ...]``; split into ``num_microbatches``
+        along dim 0.  Only chunk 0's value is consumed; pass the same
+        batch on all devices.
       num_microbatches: M; B must be divisible by M.
+      virtual_stages: V — chunks per device (Megatron interleaving).
+      stage_aux: stage_fn also returns a scalar accumulated over every
+        (microbatch, chunk) — per-stage auxiliary losses.
 
-    Returns the last stage's outputs ``[B, ...]`` (zeros elsewhere — use
-    :func:`last_stage_value` or a psum to extract).
+    Returns the last chunk's outputs ``[B, ...]`` (zeros on other
+    devices — use :func:`last_stage_value` or a psum to extract), plus
+    this device's accumulated aux scalar when ``stage_aux``.
     """
-    S = lax.axis_size(axis_name)
+    n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    M = num_microbatches
-    B = x.shape[0]
+    M, V = num_microbatches, virtual_stages
+    leaves = jax.tree.leaves(x)
+    if not leaves:
+        raise ValueError("pipeline_apply needs a non-empty batch pytree")
+    B = leaves[0].shape[0]
     if B % M:
         raise ValueError(f"batch {B} not divisible by microbatches {M}")
-    mb = x.reshape(M, B // M, *x.shape[1:])
+    mb = jax.tree.map(lambda a: a.reshape(M, B // M, *a.shape[1:]), x)
 
-    # Probe output structure of one microbatch through one stage.
-    out_shape = jax.eval_shape(stage_fn, stage_params, mb[0])
-    T = M + S - 1
-    perm = [(i, (i + 1) % S) for i in range(S)]
+    vparams = stage_params if V > 1 else \
+        jax.tree.map(lambda p: p[None], stage_params)
+    for leaf in jax.tree.leaves(vparams):
+        if leaf.shape[0] != V:
+            raise ValueError(
+                f"virtual_stages={V} but a chunk-param leaf has leading "
+                f"dim {leaf.shape[0]} (expected [V, ...] per-device "
+                "layout)")
+
+    mb0 = jax.tree.map(lambda a: a[0], mb)
+    probe = jax.eval_shape(
+        stage_fn, jax.tree.map(lambda p: p[0], vparams), mb0)
+    act_probe = probe[0] if stage_aux else probe
+    in_probe = jax.eval_shape(lambda t: t, mb0)
+    if (jax.tree.structure(act_probe) != jax.tree.structure(in_probe)
+            or [(a.shape, a.dtype) for a in jax.tree.leaves(act_probe)]
+            != [(a.shape, a.dtype) for a in jax.tree.leaves(in_probe)]):
+        raise ValueError(
+            "stage activations must match the microbatch structure/"
+            f"shapes (chunk 0 consumes the batch): got {act_probe} vs "
+            f"{in_probe}")
+
+    T = num_ticks(M, n, V)
+    perm = [(i, (i + 1) % n) for i in range(n)]
 
     def tick(carry, t):
-        prev_out, outputs = carry
-        recv = lax.ppermute(prev_out, axis_name, perm)
-        mb_idx = jnp.clip(t, 0, M - 1)
-        first_in = lax.dynamic_index_in_dim(mb, mb_idx, keepdims=False)
-        my_in = jnp.where(idx == 0, first_in, recv)
-        out = stage_fn(stage_params, my_in)
-        # Last stage: store microbatch (t - (S-1)) when in range.
-        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
-        valid = jnp.logical_and(idx == S - 1, t >= S - 1)
-        current = lax.dynamic_index_in_dim(outputs, out_idx, keepdims=False)
-        new_val = jnp.where(valid, out, current)
-        outputs = lax.dynamic_update_index_in_dim(outputs, new_val, out_idx, 0)
-        return (out, outputs), None
+        prev_out, outputs, aux_acc = carry
+        recv = jax.tree.map(lambda a: lax.ppermute(a, axis_name, perm),
+                            prev_out)
+        valid, m, v = _tick_assignment(t, idx, n=n, V=V, M=M)
+        first = (v == 0) & (idx == 0)   # global chunk 0: inject the batch
+        inj = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, m, keepdims=False), mb)
+        my_in = jax.tree.map(lambda i, rcv: jnp.where(first, i, rcv),
+                             inj, recv)
+        pv = jax.tree.map(
+            lambda p: lax.dynamic_index_in_dim(p, v, keepdims=False),
+            vparams)
+        res = stage_fn(pv, my_in)
+        out, aux = res if stage_aux else (res, None)
+        if stage_aux:
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+        last = valid & (v == V - 1) & (idx == n - 1)
 
-    out0 = jnp.zeros((M, B // M) + tuple(out_shape.shape[1:]),
-                     out_shape.dtype)
-    carry0 = (jnp.zeros(tuple(out_shape.shape), out_shape.dtype), out0)
-    (_, outputs), _ = lax.scan(tick, carry0, jnp.arange(T))
-    return outputs.reshape(B, *outputs.shape[2:])
+        def store(o_acc, o):
+            cur = lax.dynamic_index_in_dim(o_acc, m, keepdims=False)
+            return lax.dynamic_update_index_in_dim(
+                o_acc, jnp.where(last, o, cur), m, 0)
+
+        outputs = jax.tree.map(store, outputs, out)
+        return (out, outputs, aux_acc), None
+
+    act0 = jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), in_probe)
+    out0 = jax.tree.map(
+        lambda a: jnp.zeros((M,) + tuple(a.shape), a.dtype), in_probe)
+    carry0 = (act0, out0, jnp.zeros((), jnp.float32))
+    (_, outputs, aux_acc), _ = lax.scan(tick, carry0, jnp.arange(T))
+    outputs = jax.tree.map(
+        lambda a: a.reshape(B, *a.shape[2:]), outputs)
+    return (outputs, aux_acc) if stage_aux else outputs
 
 
 def last_stage_value(value, axis_name: str = const.PIPE_AXIS):
     """psum-select the last pipeline stage's value (zeros elsewhere)."""
     S = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    return lax.psum(jnp.where(idx == S - 1, value, jnp.zeros_like(value)),
-                    axis_name)
+    return jax.tree.map(
+        lambda x: lax.psum(
+            jnp.where(idx == S - 1, x, jnp.zeros_like(x)), axis_name),
+        value)
 
 
+# --------------------------------------------------------------------------- #
+# Chunk <-> storage permutations (interleaving strides chunks over devices)
+# --------------------------------------------------------------------------- #
+def chunk_permutation(n: int, V: int) -> np.ndarray:
+    """``perm`` with storage row ``d·V + v`` = logical chunk ``v·n + d``:
+    applying ``logical[perm]`` yields the storage order whose
+    ``P('pipe')`` shard on device ``d`` holds that device's V chunks."""
+    return np.array([(r % V) * n + r // V for r in range(n * V)])
+
+
+def chunk_permutation_inv(n: int, V: int) -> np.ndarray:
+    """Inverse: ``storage[perm_inv]`` restores logical chunk order."""
+    return np.array([(c % n) * V + c // n for c in range(n * V)])
+
+
+@dataclasses.dataclass
+class _PipelineLowered(SimpleLowered):
+    """SimpleLowered + the storage→logical chunk permutation, so
+    ``get_params`` / portable checkpoints expose stage order the user
+    declared (the 'looks unpartitioned' contract)."""
+
+    perm_inv: Any = None
+
+    def unpad_params(self, params):
+        if self.perm_inv is None:
+            return params
+        # Host-side permutation: a device gather on the pipe-sharded dim
+        # would need a reshard; fetch callers (get_params, portable save)
+        # device_get immediately anyway.
+        inv = np.asarray(self.perm_inv)
+        return jax.tree.map(
+            lambda p: np.asarray(jax.device_get(p))[inv], params)
+
+
+# --------------------------------------------------------------------------- #
+# Lowering
+# --------------------------------------------------------------------------- #
 def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                     optimizer, mesh, *, num_microbatches: int,
                     data_axis: str = const.DATA_AXIS,
                     pipe_axis: str = const.PIPE_AXIS,
-                    accum: int = 1, batch_key: str = "x"):
+                    accum: int = 1, batch_key: str = "x",
+                    virtual_stages: int = 1, stage_aux: bool = False):
     """Shared construction for the direct API and the Strategy-IR entry;
-    returns a :class:`~autodist_tpu.kernel.lowering.SimpleLowered`.
+    returns a Lowered-contract container.
+
+    ``stacked_params``: pytree whose leaves carry the *logical* leading
+    chunk dimension ``C = n·virtual_stages``; stored internally in the
+    interleaved device order (``chunk_permutation``), restored on fetch.
 
     ``accum > 1`` composes gradient accumulation *around* the pipeline:
     each accumulation slice runs the full microbatched schedule, so one
     optimizer step consumes ``accum x num_microbatches`` microbatches
     (the reconciliation of ``GraphConfig.accum_steps`` with pipeline
     microbatching)."""
-    from autodist_tpu.kernel import common
-    from autodist_tpu.kernel.lowering import SimpleLowered
-
-    S = mesh.shape[pipe_axis]
+    n = mesh.shape[pipe_axis]
+    V = virtual_stages
+    C = n * V
     has_data = data_axis in mesh.shape
+    for leaf in jax.tree.leaves(stacked_params):
+        if leaf.shape[0] != C:
+            raise ValueError(
+                f"stacked param leading dim {leaf.shape[0]} != "
+                f"{n} pipe devices x {V} virtual stages = {C}")
+    perm = jnp.asarray(chunk_permutation(n, V))
+    perm_inv = jnp.asarray(chunk_permutation_inv(n, V))
+
     p_specs = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
     state_specs = {"step": P(), "params": p_specs, "opt_state": p_specs,
                    "extra": None, "sync_state": {}}
@@ -109,7 +281,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     def opt_specs_tree(opt_state_shapes):
         def spec_for(leaf):
             return P(pipe_axis) if getattr(leaf, "ndim", 0) > 0 \
-                and leaf.shape and leaf.shape[0] == S else P()
+                and leaf.shape and leaf.shape[0] == C else P()
         return jax.tree.map(spec_for, opt_state_shapes)
 
     opt_shapes = jax.eval_shape(optimizer.init, stacked_params)
@@ -120,64 +292,87 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                                    is_leaf=lambda x: isinstance(x, P))
 
     def _init(params, extra=None):
+        stored = jax.tree.map(lambda p: jnp.asarray(p)[perm], params)
         return {"step": jnp.zeros((), jnp.int32),
-                "params": jax.tree.map(jnp.asarray, params),
-                "opt_state": optimizer.init(jax.tree.map(jnp.asarray, params)),
+                "params": stored,
+                "opt_state": optimizer.init(stored),
                 "extra": None, "sync_state": {}}
 
     init_fn = jax.jit(_init, out_shardings=state_shardings)
 
-    def _forward_loss(sp, batch):
-        """Masked local loss+metrics of one batch slice (nonzero on the
-        last stage only; gradients reach earlier stages through the
-        transposed ppermute ring.  A psum here would double-scale
-        cotangents under check_vma=False; values are broadcast after the
-        grad instead)."""
-        outputs = pipeline_apply(stage_fn, sp, batch[batch_key],
-                                 axis_name=pipe_axis,
-                                 num_microbatches=num_microbatches)
+    def _forward_loss(vp, batch):
+        """Masked local loss+metrics of one batch slice (the head loss is
+        nonzero on the last device only; per-stage aux losses are local
+        to every device.  Gradients reach earlier chunks through the
+        transposed ppermute ring; a psum before the grad would double-
+        scale cotangents under check_vma=False, so values are broadcast
+        after)."""
+        # local shard of the [C]-stacked params is [V, ...]; the V == 1
+        # public contract of pipeline_apply takes the chunk params bare
+        local = vp if V > 1 else jax.tree.map(lambda p: p[0], vp)
+        res = pipeline_apply(stage_fn, local, batch[batch_key],
+                             axis_name=pipe_axis,
+                             num_microbatches=num_microbatches,
+                             virtual_stages=V, stage_aux=stage_aux)
+        outputs, aux = res if stage_aux else (res, None)
         loss, metrics = loss_head(outputs, batch)
         idx = lax.axis_index(pipe_axis)
-        masked = jnp.where(idx == S - 1, loss, 0.0)
-        return masked, dict(metrics, loss=loss)
+        masked = jnp.where(idx == n - 1, loss, 0.0)
+        metrics = dict(metrics, loss=loss)
+        if stage_aux:
+            # aux is per-device-local; its grads flow where they arose.
+            masked = masked + aux / num_microbatches
+            metrics["aux_loss"] = aux / num_microbatches
+        return masked, metrics
 
     def _broadcast_metrics(metrics):
-        """Last-stage-masked psum over pipe (value broadcast), then mean
-        over the data axis when one exists."""
+        """Head metrics: last-stage-masked psum over pipe (value
+        broadcast); the stage-aux scalar: plain psum (every device
+        contributed its own chunks' aux); then mean over the data axis
+        when one exists.  The ``aux_loss`` key is special-cased only
+        under ``stage_aux`` — a user metric of that name in a non-aux
+        pipeline gets the normal last-stage treatment."""
         idx = lax.axis_index(pipe_axis)
-        metrics = jax.tree.map(
-            lambda m: lax.psum(
-                jnp.where(idx == S - 1, m, jnp.zeros_like(m)), pipe_axis),
-            metrics)
+
+        def bc_last(m):
+            return lax.psum(
+                jnp.where(idx == n - 1, m, jnp.zeros_like(m)), pipe_axis)
+
+        out = {}
+        for k, m in metrics.items():
+            if stage_aux and k == "aux_loss":
+                out[k] = lax.psum(m, pipe_axis)
+            else:
+                out[k] = jax.tree.map(bc_last, m)
+        if stage_aux:
+            out["loss"] = out["loss"] + out["aux_loss"]
         if has_data:
-            metrics = jax.tree.map(lambda m: lax.pmean(m, data_axis),
-                                   metrics)
-        return metrics
+            out = jax.tree.map(lambda m: lax.pmean(m, data_axis), out)
+        return out
 
     def _local_step(state, batch, rng):
-        stage_params = jax.tree.map(lambda p: p[0], state["params"])
+        vparams = state["params"]  # local [V, ...] chunks
 
         def micro_grads(mb, rng_, extra_in):
-            def loss_of(sp):
-                masked, metrics = _forward_loss(sp, mb)
+            def loss_of(vp):
+                masked, metrics = _forward_loss(vp, mb)
                 return masked, (extra_in, metrics)
 
-            return jax.value_and_grad(loss_of, has_aux=True)(stage_params)
+            return jax.value_and_grad(loss_of, has_aux=True)(vparams)
 
         if accum == 1:
             (_, (_, metrics)), grads = micro_grads(batch, rng, None)
         else:
             grads, _, metrics = common.accumulate_microbatches(
-                micro_grads, stage_params, batch, rng, None, accum)
+                micro_grads, vparams, batch, rng, None, accum)
 
         metrics = _broadcast_metrics(metrics)
         if has_data:
             grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
-        grads = jax.tree.map(lambda g: g[None], grads)
 
         updates, new_opt = optimizer.update(grads, state["opt_state"],
-                                            state["params"])
-        new_params = optax.apply_updates(state["params"], updates)
+                                            vparams)
+        new_params = optax.apply_updates(vparams, updates)
         return ({"step": state["step"] + 1, "params": new_params,
                  "opt_state": new_opt, "extra": None, "sync_state": {}},
                 metrics)
@@ -194,8 +389,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     step_fn = jax.jit(_step, donate_argnums=(0,))
 
     def _local_eval(state, batch, rng):
-        sp = jax.tree.map(lambda p: p[0], state["params"])
-        _, metrics = _forward_loss(sp, batch)
+        _, metrics = _forward_loss(state["params"], batch)
         return _broadcast_metrics(metrics)
 
     def _eval(state, batch, rng):
@@ -206,28 +400,32 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
 
     eval_fn = jax.jit(_eval)
 
-    return SimpleLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
-                         state_specs=state_specs,
-                         state_shardings=state_shardings,
-                         batch_spec=batch_spec, eval_fn=eval_fn)
+    return _PipelineLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
+                            state_specs=state_specs,
+                            state_shardings=state_shardings,
+                            batch_spec=batch_spec, eval_fn=eval_fn,
+                            perm_inv=perm_inv)
 
 
 def lower_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                    optimizer, mesh, *, num_microbatches: int,
                    data_axis: str = const.DATA_AXIS,
-                   pipe_axis: str = const.PIPE_AXIS):
+                   pipe_axis: str = const.PIPE_AXIS,
+                   virtual_stages: int = 1):
     """Build a complete pipelined SPMD train step.
 
-    ``stacked_params``: pytree whose leaves have a leading stage dimension
-    ``S == mesh.shape[pipe_axis]`` (sharded onto the pipe axis).
-    ``loss_head(outputs, batch) -> (loss, metrics)`` runs on the last stage.
+    ``stacked_params``: pytree whose leaves have a leading logical-chunk
+    dimension ``C == mesh.shape[pipe_axis] * virtual_stages``.
+    ``loss_head(outputs, batch) -> (loss, metrics)`` runs on the last
+    chunk's outputs.
 
     Returns ``(init_fn, step_fn, state_shardings)`` with the same state
     dict layout as the other lowerings.
     """
     built = _build_pipeline(stage_fn, stacked_params, loss_head, optimizer,
                             mesh, num_microbatches=num_microbatches,
-                            data_axis=data_axis, pipe_axis=pipe_axis)
+                            data_axis=data_axis, pipe_axis=pipe_axis,
+                            virtual_stages=virtual_stages)
     return built.init_fn, built.step_fn, built.state_shardings
 
 
@@ -243,13 +441,15 @@ def lower_pipeline_ir(trainable, strategy, mesh):
             "declare one with PipelineTrainable(stage_fn, stacked_params, "
             "loss_head, optimizer, num_stages=S)")
     cfg = strategy.graph_config
+    V = max(int(cfg.parallel.get("virtual_stages", 1)), 1)
     S = mesh.shape.get(const.PIPE_AXIS)
-    if S != trainable.num_stages:
+    if S is None or S * V != trainable.num_stages:
         raise ValueError(
-            f"mesh pipe axis has {S} stages; trainable declares "
-            f"{trainable.num_stages}")
+            f"trainable declares {trainable.num_stages} stages; mesh pipe "
+            f"axis has {S} devices x {V} virtual stages")
     return _build_pipeline(
         trainable.stage_fn, trainable.params, trainable.loss_head,
         trainable.optimizer, mesh,
         num_microbatches=int(cfg.parallel.get("num_microbatches", 1)),
-        accum=max(cfg.accum_steps, 1), batch_key=trainable.batch_key)
+        accum=max(cfg.accum_steps, 1), batch_key=trainable.batch_key,
+        virtual_stages=V, stage_aux=trainable.stage_aux)

@@ -68,10 +68,13 @@ class Pipeline(StrategyBuilder):
     schedule.
     """
 
-    def __init__(self, num_microbatches: int = 1):
+    def __init__(self, num_microbatches: int = 1, virtual_stages: int = 1):
         if num_microbatches < 1:
             raise ValueError("num_microbatches must be >= 1")
+        if virtual_stages < 1:
+            raise ValueError("virtual_stages must be >= 1")
         self.num_microbatches = num_microbatches
+        self.virtual_stages = virtual_stages
 
     def build(self, trainable, resource_spec):
         shape = resource_spec.resolved_mesh_shape()
@@ -81,10 +84,18 @@ class Pipeline(StrategyBuilder):
                 f"resolves to {shape} — declare e.g. "
                 "mesh: {data: ..., pipe: ...}")
         num_stages = getattr(trainable, "num_stages", None)
-        if num_stages is not None and num_stages != shape[const.PIPE_AXIS]:
+        if num_stages is None:
+            # ValueError (not TypeError) so AutoStrategy's candidate loop
+            # can skip this builder for non-stage-structured trainables.
+            raise ValueError(
+                "Pipeline lowers stage-structured trainables; declare one "
+                "with PipelineTrainable(stage_fn, stacked_params, "
+                "loss_head, optimizer, num_stages=S)")
+        if num_stages != shape[const.PIPE_AXIS] * self.virtual_stages:
             raise ValueError(
                 f"trainable declares {num_stages} stages; mesh pipe axis "
-                f"has {shape[const.PIPE_AXIS]}")
+                f"has {shape[const.PIPE_AXIS]} devices x "
+                f"{self.virtual_stages} virtual stages")
         nodes = []
         for i in trainable.var_infos():
             spec = [const.PIPE_AXIS] + [None] * (max(len(i.shape), 1) - 1)
@@ -96,7 +107,8 @@ class Pipeline(StrategyBuilder):
                 is_sparse=i.is_sparse))
         cfg = self._graph_config(resource_spec)
         cfg.lowering = "pipeline"
-        cfg.parallel = {"num_microbatches": self.num_microbatches}
+        cfg.parallel = {"num_microbatches": self.num_microbatches,
+                        "virtual_stages": self.virtual_stages}
         return Strategy(node_configs=nodes, graph_config=cfg)
 
 

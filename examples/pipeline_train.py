@@ -1,0 +1,81 @@
+"""Pipeline-parallel training through the Strategy IR.
+
+Beyond reference parity (the reference declared pipeline parallelism
+future work, ``docs/design/architecture.rst:49-51``): a stage-stacked
+MLP trained over the ``pipe`` mesh axis, GPipe or Megatron-interleaved
+(``--virtual-stages 2``), with gradient accumulation composing on top.
+
+    python examples/pipeline_train.py --steps 20
+    python examples/pipeline_train.py --virtual-stages 2 --microbatches 4
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--stages", type=int, default=4,
+                    help="pipe-axis devices")
+    ap.add_argument("--virtual-stages", type=int, default=1)
+    ap.add_argument("--microbatches", type=int, default=2)
+    ap.add_argument("--accum-steps", type=int, default=1)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=32)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from autodist_tpu import AutoDist, PipelineTrainable
+    from autodist_tpu.parallel.pipeline import bubble_fraction
+    from autodist_tpu.strategy.builders import GradAccumulation
+    from autodist_tpu.strategy.parallel_builders import Pipeline
+
+    n = jax.device_count()
+    pp = min(args.stages, n)
+    dp = n // pp
+    C = pp * args.virtual_stages
+    HID = args.hidden
+    r = np.random.RandomState(0)
+    stacked = {"w": jnp.asarray(r.randn(C, HID, HID) * (2.0 / HID) ** 0.5,
+                                jnp.float32),
+               "b": jnp.zeros((C, HID), jnp.float32)}
+
+    def stage(p, x):
+        return jax.nn.relu(x @ p["w"] + p["b"])
+
+    def head(outputs, batch):
+        loss = jnp.mean((outputs - batch["y"]) ** 2)
+        return loss, {}
+
+    trainable = PipelineTrainable(stage, stacked, head, optax.adam(1e-3),
+                                  num_stages=C)
+    builder = Pipeline(num_microbatches=args.microbatches,
+                       virtual_stages=args.virtual_stages)
+    if args.accum_steps > 1:
+        builder = GradAccumulation(builder, steps=args.accum_steps)
+    mesh = {"data": dp, "pipe": pp} if dp > 1 else {"pipe": pp}
+    runner = AutoDist({"topology": {"num_devices": dp * pp}, "mesh": mesh},
+                      builder).build(trainable)
+
+    print(f"pipe={pp} x virtual={args.virtual_stages} "
+          f"(C={C} chunks), dp={dp}, M={args.microbatches}; "
+          f"schedule bubble = "
+          f"{bubble_fraction(args.microbatches, pp, args.virtual_stages):.3f}")
+    target = r.randn(HID, HID).astype(np.float32) * 0.1
+    for step in range(args.steps):
+        x = r.randn(args.batch, HID).astype(np.float32)
+        batch = {"x": x, "y": x @ target}
+        metrics = runner.step(batch)
+        if step % 5 == 0 or step == args.steps - 1:
+            print(f"step {step}: loss={float(np.asarray(metrics['loss'])):.5f}")
+
+
+if __name__ == "__main__":
+    main()

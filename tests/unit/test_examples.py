@@ -14,6 +14,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+pytestmark = pytest.mark.slow
+
 def run_script(rel_path, *args, timeout=240):
     env = dict(os.environ)
     env.update({
@@ -88,3 +90,10 @@ def test_long_context_ring_flash():
                      "--hidden", "32", "--layers", "1", "--flash",
                      timeout=300)
     assert "attn=flash" in out and "sp=4" in out
+
+
+def test_pipeline_train_interleaved():
+    out = run_script("examples/pipeline_train.py", "--steps", "3",
+                     "--virtual-stages", "2", "--microbatches", "2",
+                     "--hidden", "16", "--batch", "16", timeout=300)
+    assert "virtual=2" in out and "bubble" in out and "loss=" in out

@@ -20,6 +20,8 @@ from autodist_tpu.parallel.ring_attention import ring_self_attention
 from autodist_tpu.parallel.sequence import global_positions
 from autodist_tpu.strategy.ir import Strategy
 
+pytestmark = pytest.mark.slow
+
 VOCAB, DIM, HEADS, SEQ = 64, 32, 2, 32
 
 
@@ -250,9 +252,11 @@ def test_pipeline_strategy_serializes():
     ad = AutoDist(PIPE_SPEC, "Pipeline", num_microbatches=2)
     strategy = ad.build_or_load_strategy(make_pipeline_trainable())
     assert strategy.graph_config.lowering == "pipeline"
-    assert strategy.graph_config.parallel == {"num_microbatches": 2}
+    assert strategy.graph_config.parallel == {"num_microbatches": 2,
+                                              "virtual_stages": 1}
     clone = Strategy.from_json(strategy.to_json())
-    assert clone.graph_config.parallel == {"num_microbatches": 2}
+    assert clone.graph_config.parallel == {"num_microbatches": 2,
+                                           "virtual_stages": 1}
     # every stage variable is pipe-sharded in the IR
     for n in clone.node_configs:
         assert n.partitioner.spec[0] == "pipe"
