@@ -29,6 +29,11 @@ from autodist_tpu.strategy.ir import Strategy
 COLLECTIVE_ALPHA = 5e-6
 
 # Payload scale factors per compressor (grad bytes on the wire).
+# Analytic defaults; :func:`load_calibration` / the
+# ``tools/calibrate_compressors.py`` driver replace them with measured
+# wall-clock ratios (int8_ring's p-1 sequential ppermute hops and
+# PowerSGD's per-step Gram-Schmidt are NOT free — a byte count alone
+# overstates both).
 COMPRESSOR_FACTOR = {
     "none": 1.0,
     "fp16": 0.5, "bf16": 0.5,
@@ -41,6 +46,52 @@ COMPRESSOR_FACTOR = {
     # data-dependent ratio; at BERT-scale buckets it is ≲ 0.01.
     "powersgd": 0.02,
 }
+
+# Activation bytes per element on the wire/in HBM (bf16 activations).
+_ACT_BYTES = 2.0
+
+
+def load_calibration(path: Optional[str] = None) -> dict:
+    """Merge measured compressor factors into :data:`COMPRESSOR_FACTOR`.
+
+    ``tools/calibrate_compressors.py`` times each compressor's allreduce
+    against the uncompressed one on the real chip and writes
+    ``{"compressor_factor": {name: measured_ratio}, ...}``; loading it
+    turns the cost model's byte-count guesses into wall-clock ratios.
+    Default path: ``calibration.json`` at the repo root, then the
+    ``AUTODIST_TPU_CALIBRATION`` env var.  Returns the factors applied
+    (empty when no file exists).
+    """
+    import json
+    import os
+
+    candidates = [path] if path else [
+        os.environ.get("AUTODIST_TPU_CALIBRATION", ""),
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "calibration.json"),
+    ]
+    for p in candidates:
+        if p and os.path.exists(p):
+            with open(p) as f:
+                data = json.load(f)
+            factors = dict(data.get("compressor_factor", {}))
+            COMPRESSOR_FACTOR.update(factors)
+            return factors
+    return {}
+
+
+_calibration_loaded = False
+
+
+def _ensure_calibration():
+    global _calibration_loaded
+    if not _calibration_loaded:
+        _calibration_loaded = True
+        applied = load_calibration()
+        if applied:
+            from autodist_tpu.utils import logging
+            logging.info("cost model using measured compressor factors: %s",
+                         applied)
 
 
 class SpecMeshMismatch(ValueError):
@@ -71,17 +122,43 @@ class CostModel:
     def __init__(self, resource_spec: ResourceSpec, *,
                  sparsity_fraction: float = 0.05,
                  opt_state_multiplier: float = 2.0,
-                 hbm_headroom: float = 0.6):
+                 hbm_headroom: float = 0.6,
+                 tokens_per_step: Optional[int] = None,
+                 act_bytes_per_token: Optional[float] = None):
         """``sparsity_fraction``: expected fraction of embedding rows
         touched per step (drives the sparse gather/scatter volume).
         ``opt_state_multiplier``: optimizer slots per parameter byte
         (2.0 = adam m+v).  ``hbm_headroom``: fraction of HBM the model
-        state may occupy (the rest is activations/workspace)."""
+        state may occupy (the rest is activations/workspace).
+        ``tokens_per_step`` / ``act_bytes_per_token``: activation-shape
+        hints (override the trainable's own) enabling activation-
+        collective and activation-memory pricing — see
+        :class:`~autodist_tpu.capture.Trainable`."""
+        _ensure_calibration()
         self.spec = resource_spec
         self.chip = resource_spec.chip
         self.sparsity_fraction = sparsity_fraction
         self.opt_state_multiplier = opt_state_multiplier
         self.hbm_headroom = hbm_headroom
+        self.tokens_per_step = tokens_per_step
+        self.act_bytes_per_token = act_bytes_per_token
+
+    # ------------------------------------------------------------------ #
+    def _hints(self, trainable) -> tuple[Optional[int], Optional[float]]:
+        tokens = self.tokens_per_step if self.tokens_per_step is not None \
+            else getattr(trainable, "tokens_per_step", None)
+        act = self.act_bytes_per_token if self.act_bytes_per_token is not None \
+            else getattr(trainable, "act_bytes_per_token", None)
+        return tokens, act
+
+    @staticmethod
+    def _hidden_dim(trainable) -> int:
+        """Activation width estimate: the largest 'matmul contraction'
+        dim, i.e. max over rank>=2 variables of their smallest dim
+        (embedding [V, H] and square projections [H, H] both yield H)."""
+        dims = [min(v.shape) for v in trainable.var_infos()
+                if len(v.shape) >= 2]
+        return max(dims) if dims else 1
 
     @staticmethod
     def _gspmd_shards(node, mesh) -> tuple[int, bool]:
@@ -120,11 +197,18 @@ class CostModel:
           collective path's sharded branch.
         * model-axis-sharded (TP): each device permanently owns its
           slice; only the slice's gradient syncs over the data axis.
-          Activation collectives on the model axis depend on batch shape
-          the cost model cannot see — they appear in the per-collective
-          latency term only (documented limitation).
+          With a ``tokens_per_step`` hint, activation collectives on the
+          model axis are priced Megatron-style: each *row-parallel*
+          variable (dim 0 sharded on the model axis, e.g. the out-proj /
+          mlp-down matmul) implies one fwd activation allreduce of
+          ``tokens x out_features`` over its TP group, mirrored in the
+          backward at its column-parallel partner — 2x the fwd volume,
+          charged on the row var to avoid double counting.  Without the
+          hint they appear in the per-collective latency term only.
         * replicated: the DP grad allreduce.
         """
+        from autodist_tpu import const
+
         mesh = self.spec.resolved_mesh_shape()
         n = max(strategy.graph_config.replicas, 1)
         infos = {v.name: v for v in trainable.var_infos()}
@@ -132,6 +216,10 @@ class CostModel:
         total_devices = 1
         for v in mesh.values():
             total_devices *= v
+        tokens, act_hint = self._hints(trainable)
+        m = mesh.get(const.MODEL_AXIS, 1)
+        ring_m = 2.0 * (m - 1) / m if m > 1 else 0.0
+        tokens_per_group = (tokens / n) if tokens else 0.0
         comm_bytes = mem_bytes = 0.0
         num_collectives = 0
         for node in strategy.node_configs:
@@ -146,10 +234,33 @@ class CostModel:
                 comm_bytes += ring * (bytes_ if uses_data
                                       else bytes_ / shards)
                 num_collectives += 2
+                # Row-parallel on the model axis: fwd+bwd activation
+                # allreduce of tokens x shape[1] over the TP group.
+                part = node.partitioner
+                spec0 = part.spec[0] if part is not None \
+                    and part.spec else None
+                row_parallel = (
+                    ring_m > 0.0 and tokens and len(info.shape) >= 2
+                    and (const.MODEL_AXIS == spec0
+                         or (isinstance(spec0, (list, tuple))
+                             and const.MODEL_AXIS in spec0)))
+                if row_parallel:
+                    # Output width = the last (non-contracted) dim: H for
+                    # out-proj [heads, head_dim, H], wo [mlp, H], and the
+                    # vocab-sharded embedding [V, H] (partial-sum lookup).
+                    comm_bytes += 2.0 * ring_m * tokens_per_group \
+                        * info.shape[-1] * _ACT_BYTES
+                    num_collectives += 2
             else:
                 mem_bytes += bytes_ * (2.0 + self.opt_state_multiplier)
                 comm_bytes += ring * bytes_
                 num_collectives += 1
+        if tokens and act_hint:
+            # Activations divide by the number of batch shards (the data
+            # axis), not all devices: a TP group processes the same
+            # tokens on every member (the residual stream is unsharded —
+            # conservative; some TP intermediates do shard).
+            mem_bytes += act_hint * tokens / n
         bw = self.chip.ici_gbps * 1e9
         comm_time = comm_bytes / bw \
             + COLLECTIVE_ALPHA * num_collectives * (1 if total_devices > 1
@@ -160,10 +271,121 @@ class CostModel:
                             mem_bytes_per_device=mem_bytes,
                             feasible=mem_bytes <= hbm)
 
+    def _parallel_cost(self, trainable, strategy) -> StrategyCost:
+        """Pricing for the sequence / pipeline / expert lowerings.
+
+        Uses the activation hints where collective volume is activation-
+        shaped (ring-attention k/v rotation, pipeline activation hops,
+        MoE all_to_all); without hints those appear only in the latency
+        term — same documented degradation as TP.
+        """
+        from autodist_tpu import const
+
+        mesh = self.spec.resolved_mesh_shape()
+        kind = strategy.graph_config.lowering
+        tokens, act_hint = self._hints(trainable)
+        hidden = self._hidden_dim(trainable)
+        n_data = mesh.get(const.DATA_AXIS, 1) * mesh.get(const.DCN_AXIS, 1)
+        total_devices = 1
+        for v in mesh.values():
+            total_devices *= v
+        infos = list(trainable.var_infos())
+        param_bytes = float(sum(v.byte_size for v in infos))
+        opt_mult = self.opt_state_multiplier
+        comm = 0.0
+        colls = 0
+        mem = 0.0
+        tokens_per_dev = (tokens / total_devices) if tokens else 0.0
+
+        def ring(k: int) -> float:
+            return 2.0 * (k - 1) / k if k > 1 else 0.0
+
+        if kind == "sequence":
+            S = mesh.get(const.SEQ_AXIS, 1)
+            n_sync = n_data * S
+            # params replicated; per-var grad pmean over data x seq
+            comm += ring(n_sync) * param_bytes
+            colls += len(infos)
+            mem += param_bytes * (2.0 + opt_mult)
+            if tokens:
+                # ring attention: each device rotates its local k/v
+                # (2 tensors of tokens_local x hidden) S-1 hops forward,
+                # mirrored in the backward.
+                comm += 2.0 * 2.0 * tokens_per_dev * hidden * _ACT_BYTES \
+                    * (S - 1)
+                colls += 2 * max(S - 1, 0)
+            if tokens and act_hint:
+                mem += act_hint * tokens_per_dev  # seq divides activations
+        elif kind == "pipeline":
+            S = mesh.get(const.PIPE_AXIS, 1)
+            M = max(int(strategy.graph_config.parallel.get(
+                "num_microbatches", 1)), 1)
+            V = max(int(strategy.graph_config.parallel.get(
+                "virtual_stages", 1)), 1)
+            # V chunks of C = S*V total live per device -> params/opt at
+            # 1/S; grads pmean over the data axis only
+            mem += param_bytes * (2.0 + opt_mult) / S
+            comm += ring(n_data) * param_bytes / S
+            colls += len(infos)
+            if tokens:
+                # activation hop per schedule tick (ppermute ring), fwd +
+                # transposed bwd; T = M*V + S - 1 ticks of a microbatch
+                # activation (tokens_local/M x hidden) — interleaving
+                # trades V-fold more (smaller) hops for a ~V-fold smaller
+                # bubble, which only measurement can arbitrate.
+                tokens_local = tokens / max(n_data, 1)
+                T = M * V + S - 1
+                comm += 2.0 * T * (tokens_local / M) * hidden * _ACT_BYTES
+                colls += 2 * T
+                if act_hint:
+                    # one microbatch's activations live per stage
+                    mem += act_hint * tokens_local / M
+        else:  # expert
+            E = mesh.get(const.EXPERT_AXIS, 1)
+            expert_bytes = 0.0
+            for node in strategy.node_configs:
+                info = next((v for v in infos if v.name == node.var_name),
+                            None)
+                if info is None:
+                    continue
+                part = node.partitioner
+                is_expert = part is not None and (
+                    (part.spec is not None and const.EXPERT_AXIS in part.spec)
+                    or part.mesh_axis == const.EXPERT_AXIS)
+                if is_expert:
+                    expert_bytes += info.byte_size
+            dense_bytes = param_bytes - expert_bytes
+            # dense params replicate + sync over data x expert; expert
+            # tables live 1/E and sync over data only
+            mem += dense_bytes * (2.0 + opt_mult) \
+                + expert_bytes * (2.0 + opt_mult) / E
+            comm += ring(n_data * E) * dense_bytes \
+                + ring(n_data) * expert_bytes / E
+            colls += len(infos)
+            if tokens:
+                # all_to_all dispatch + combine, fwd + bwd: 4 passes of
+                # the local token activations, (E-1)/E leaving the device
+                comm += 4.0 * tokens_per_dev * hidden * _ACT_BYTES \
+                    * (E - 1) / max(E, 1)
+                colls += 4
+            if tokens and act_hint:
+                mem += act_hint * tokens_per_dev
+        bw = self.chip.ici_gbps * 1e9
+        comm_time = (comm / bw if total_devices > 1 else 0.0) \
+            + COLLECTIVE_ALPHA * colls * (1 if total_devices > 1 else 0)
+        hbm = self.chip.hbm_gb * 1e9 * self.hbm_headroom
+        return StrategyCost(comm_bytes=comm, comm_time_s=comm_time,
+                            num_collectives=colls,
+                            mem_bytes_per_device=mem,
+                            feasible=mem <= hbm)
+
     def strategy_cost(self, trainable: Trainable,
                       strategy: Strategy) -> StrategyCost:
         if strategy.graph_config.lowering == "gspmd":
             return self._gspmd_cost(trainable, strategy)
+        if strategy.graph_config.lowering in ("sequence", "pipeline",
+                                              "expert"):
+            return self._parallel_cost(trainable, strategy)
         n = max(strategy.graph_config.replicas, 1)
         infos = {v.name: v for v in trainable.var_infos()}
         ring = 2.0 * (n - 1) / n if n > 1 else 0.0
@@ -228,6 +450,9 @@ class CostModel:
                 mem_bytes += bytes_ * (2.0 + self.opt_state_multiplier)
 
         num_collectives += len(groups)
+        tokens, act_hint = self._hints(trainable)
+        if tokens and act_hint:
+            mem_bytes += act_hint * tokens / n
         bw = self.chip.ici_gbps * 1e9  # bytes/s
         comm_time = (comm_bytes / bw if n > 1 else 0.0) \
             + COLLECTIVE_ALPHA * num_collectives * (1 if n > 1 else 0)
