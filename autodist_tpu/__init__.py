@@ -10,6 +10,21 @@ instead of a rewritten TF graph over SSH/gRPC/NCCL.
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # Honor an explicit JAX_PLATFORMS choice through jax.config: some
+    # platform plugins (e.g. proxied TPU tunnels) register a backend at
+    # interpreter start that ignores the env var, so a CPU-pinned
+    # subprocess could still block on remote-client init.  jax.config
+    # wins over the plugin; a no-op when the backend is already up.
+    import jax as _jax
+
+    try:
+        _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    except Exception:  # pragma: no cover - backend already initialized
+        pass
+
 from autodist_tpu.autodist import AutoDist
 from autodist_tpu.capture import PipelineTrainable, Trainable, VarInfo
 from autodist_tpu.resource import ResourceSpec

@@ -74,6 +74,7 @@ def test_latest_step_and_missing(tmp_path):
         saver.restore_params()
 
 
+@pytest.mark.slow
 def test_preemption_hook_checkpoints_on_sigterm(tmp_path):
     """A SIGTERM (TPU preemption) must flush a checkpoint before the
     process obeys the signal; run in a subprocess to observe the death."""

@@ -53,6 +53,7 @@ def test_error_feedback_accumulates(name):
     assert np.all(np.isfinite(state))
 
 
+@pytest.mark.slow
 def test_ef_unbiased_over_steps():
     """Error feedback: average of compressed grads over many steps must
     approach the true mean (the point of the EF mixin)."""
@@ -157,6 +158,7 @@ def test_compressor_arg_parsing():
         Compressor.create("fp16:2")
 
 
+@pytest.mark.slow
 def test_int8_ring_matches_true_mean():
     """The hand-built int8 ring must agree with the true mean to
     quantization tolerance, for total sizes that do and don't divide

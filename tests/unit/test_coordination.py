@@ -197,6 +197,7 @@ def test_large_value_roundtrip(server):
         assert c.get("big") == blob
 
 
+@pytest.mark.slow
 def test_cluster_strategy_handoff_over_service(tmp_path):
     """End-to-end chief→worker handoff: the chief's Cluster starts the
     native service, publishes the strategy to KV, and a worker *process*

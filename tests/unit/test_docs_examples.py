@@ -19,6 +19,10 @@ from autodist_tpu import AutoDist, Trainable
 DOCS = pathlib.Path(__file__).resolve().parents[2] / "docs"
 
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 def _exec_doc_builder():
     """Exec the tutorial's code blocks — imports included — in order,
     up to and including the one defining ``BigVarsSharded``, so a rename

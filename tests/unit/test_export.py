@@ -12,6 +12,8 @@ from autodist_tpu import AutoDist, Parallax, PartitionedPS
 from autodist_tpu.checkpoint import export_model, load_exported
 
 
+pytestmark = pytest.mark.slow
+
 def make_model():
     import flax.linen as nn
 

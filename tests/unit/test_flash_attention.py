@@ -12,6 +12,8 @@ from autodist_tpu.models.transformer import dot_product_attention
 from autodist_tpu.ops import flash_attention, make_attention_fn
 
 
+pytestmark = pytest.mark.slow
+
 def _inputs(b=2, l=128, h=4, d=32, dtype=jnp.float32, seed=0):
     r = np.random.RandomState(seed)
     mk = lambda: jnp.asarray(r.randn(b, l, h, d) * 0.3, dtype)

@@ -16,6 +16,8 @@ from tests.unit.test_end_to_end import (make_batch, make_trainable,
                                         single_device_reference)
 
 
+pytestmark = pytest.mark.slow
+
 def test_sharded_dp_matches_single_device():
     trainable = make_trainable()
     batches = [make_batch(s) for s in range(3)]

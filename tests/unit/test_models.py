@@ -12,6 +12,8 @@ from autodist_tpu import AllReduce, AutoDist, Parallax, PartitionedPS
 from autodist_tpu import models
 
 
+pytestmark = pytest.mark.slow
+
 def run_steps(trainable, batches, builder, **ad_kw):
     runner = AutoDist({}, builder, **ad_kw).build(trainable)
     losses = [float(runner.step(b)["loss"]) for b in batches]

@@ -12,6 +12,8 @@ from jax.sharding import PartitionSpec as P
 from autodist_tpu.parallel.moe import (dense_moe_reference,
                                        expert_parallel_ffn, top2_gating)
 
+pytestmark = pytest.mark.slow
+
 Pdev, G, E, M, H = 4, 8, 8, 16, 32
 E_local = E // Pdev
 

@@ -22,6 +22,8 @@ from tests.unit.test_end_to_end import (make_batch, make_trainable,
 SPEC = {"topology": {"num_devices": 8}, "mesh": {"dcn": 2, "data": 4}}
 
 
+pytestmark = pytest.mark.slow
+
 @pytest.mark.parametrize("builder", [AllReduce, PS, PartitionedPS],
                          ids=["AllReduce", "PS-ZeRO1", "PartitionedPS"])
 def test_multislice_matches_single_device(builder):

@@ -9,6 +9,8 @@ from autodist_tpu.parallel.ring_attention import (ring_self_attention,
                                                   sequence_sharded_attention)
 
 
+pytestmark = pytest.mark.slow
+
 def reference_attention(q, k, v, causal=False):
     D = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D)
