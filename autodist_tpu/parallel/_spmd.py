@@ -1,0 +1,150 @@
+"""Shared SPMD construction for the replicated-parameter lowerings.
+
+The sequence and expert lowerings differ only in *placement policy*
+(which params shard, how batch leaves split, which axes gradients
+synchronize over); the step/eval/init machinery — microbatch
+accumulation, metric reduction, the defensive float-extra averaging, the
+shard_map plumbing — is identical, and identical to the collective
+path's semantics.  One builder, three injection points, so a fix to any
+of the shared rules lands everywhere at once.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from autodist_tpu.kernel import common
+from autodist_tpu.kernel.lowering import SimpleLowered, _reduce_metrics
+
+
+def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
+                          batch_spec_fn: Callable,
+                          batch_spec,
+                          param_spec_fn: Optional[Callable] = None,
+                          grad_sync: Optional[Callable] = None,
+                          accum: int = 1) -> SimpleLowered:
+    """Compile a train/eval step for a (mostly) replicated-parameter
+    strategy.
+
+    Args:
+      sync_axes: mesh axes gradients/metrics synchronize over (also the
+        per-device rng fold axes).
+      batch_spec_fn: ``batch -> PartitionSpec tree`` (the feed contract).
+      batch_spec: representative spec recorded on the Lowered (loaders).
+      param_spec_fn: ``(name, leaf) -> PartitionSpec`` for parameter
+        storage (default: replicate everything).  Optimizer-state leaves
+        inherit their variable's spec by path-suffix matching.
+      grad_sync: ``(name, grad) -> grad`` cross-device synchronization
+        (default: ``pmean`` over ``sync_axes``).
+      accum: gradient-accumulation microbatch count.
+    """
+    opt = trainable.optimizer
+    if param_spec_fn is None:
+        param_spec_fn = lambda name, leaf: P()  # noqa: E731
+    if grad_sync is None:
+        grad_sync = lambda name, g: lax.pmean(g, sync_axes)  # noqa: E731
+
+    p_specs = common.tree_from_names(trainable.params, param_spec_fn)
+    spec_by_name = dict(common.flatten_with_names(p_specs))
+    shapes_by_name = {v.name: v.shape for v in trainable.var_infos()}
+
+    import numpy as np
+
+    opt_shapes = jax.eval_shape(
+        opt.init,
+        jax.tree.map(lambda l: jax.ShapeDtypeStruct(
+            tuple(np.shape(l)), jnp.result_type(l)), trainable.params))
+
+    def opt_spec_for(path, leaf):
+        from autodist_tpu.capture import path_to_name
+        name = path_to_name(path)
+        var = common.match_var_by_suffix(
+            name, spec_by_name,
+            shape_ok=lambda v: tuple(leaf.shape)
+            == tuple(shapes_by_name[v]))
+        return spec_by_name[var] if var else P()
+
+    o_specs = jax.tree_util.tree_map_with_path(opt_spec_for, opt_shapes)
+    extra_specs = jax.tree.map(lambda _: P(), trainable.extra)
+    state_specs = {"step": P(), "params": p_specs, "opt_state": o_specs,
+                   "extra": extra_specs, "sync_state": {}}
+    state_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), state_specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+    def _init(params, extra):
+        return {"step": jnp.zeros((), jnp.int32),
+                "params": jax.tree.map(jnp.asarray, params),
+                "opt_state": opt.init(jax.tree.map(jnp.asarray, params)),
+                "extra": extra, "sync_state": {}}
+
+    init_fn = jax.jit(_init, out_shardings=state_shardings)
+
+    def _local_step(state, batch, rng):
+        local_rng = jax.random.fold_in(rng, lax.axis_index(sync_axes))
+
+        def micro_grads(mb, rng_, extra_in):
+            def loss_of(params):
+                loss, new_extra, metrics = trainable.loss(
+                    params, extra_in, mb, rng_)
+                return loss, (new_extra, metrics)
+
+            return jax.value_and_grad(loss_of, has_aux=True)(
+                state["params"])
+
+        if accum == 1:
+            (_, (new_extra, metrics)), grads = micro_grads(
+                batch, local_rng, state["extra"])
+        else:
+            grads, new_extra, metrics = common.accumulate_microbatches(
+                micro_grads, state["params"], batch, local_rng,
+                state["extra"], accum)
+
+        grads = common.tree_from_names(grads, grad_sync)
+        metrics = _reduce_metrics(dict(metrics), sync_axes)
+        # extra (e.g. batch stats) must be SPMD-invariant: average float
+        # leaves defensively (same guard as the collective lowering).
+        new_extra = jax.tree.map(
+            lambda x: lax.pmean(x, sync_axes)
+            if jnp.issubdtype(jnp.result_type(x), jnp.inexact) else x,
+            new_extra)
+        updates, new_opt = opt.update(grads, state["opt_state"],
+                                      state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return ({"step": state["step"] + 1, "params": new_params,
+                 "opt_state": new_opt, "extra": new_extra,
+                 "sync_state": {}}, metrics)
+
+    def _step(state, batch, rng):
+        return jax.shard_map(
+            _local_step, mesh=mesh,
+            in_specs=(state_specs, batch_spec_fn(batch), P()),
+            out_specs=(state_specs, P()),
+            check_vma=False)(state, batch, rng)
+
+    step_fn = jax.jit(_step, donate_argnums=(0,))
+
+    def _local_eval(state, batch, rng):
+        _, _, metrics = trainable.eval_loss(
+            state["params"], state["extra"], batch,
+            jax.random.fold_in(rng, lax.axis_index(sync_axes)))
+        return _reduce_metrics(dict(metrics), sync_axes)
+
+    def _eval(state, batch, rng):
+        return jax.shard_map(
+            _local_eval, mesh=mesh,
+            in_specs=(state_specs, batch_spec_fn(batch), P()),
+            out_specs=P(), check_vma=False)(state, batch, rng)
+
+    eval_fn = jax.jit(_eval)
+
+    return SimpleLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
+                         state_specs=state_specs,
+                         state_shardings=state_shardings,
+                         batch_spec=batch_spec, eval_fn=eval_fn,
+                         batch_spec_fn=batch_spec_fn)

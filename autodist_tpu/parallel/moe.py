@@ -107,11 +107,10 @@ def lower_expert_ir(trainable, strategy, mesh):
     inside ``shard_map`` and must route tokens with
     :func:`expert_parallel_ffn` (``axis_name="expert"``).
     """
-    import optax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from autodist_tpu.kernel import common
-    from autodist_tpu.kernel.lowering import SimpleLowered, _reduce_metrics
+    from autodist_tpu.parallel._spmd import build_replicated_spmd
 
     expert_axis = const.EXPERT_AXIS
     data_axis = const.DATA_AXIS
@@ -121,7 +120,6 @@ def lower_expert_ir(trainable, strategy, mesh):
     has_data = data_axis in mesh.shape
     batch_axes = (data_axis, expert_axis) if has_data else (expert_axis,)
     E_shards = mesh.shape[expert_axis]
-    opt = trainable.optimizer
 
     expert_vars = set()
     for nc in strategy.node_configs:
@@ -146,112 +144,27 @@ def lower_expert_ir(trainable, strategy, mesh):
             return P(*([expert_axis] + [None] * (leaf.ndim - 1)))
         return P()
 
-    p_specs = common.tree_from_names(trainable.params, param_spec)
-    spec_by_name = dict(common.flatten_with_names(p_specs))
-    shapes_by_name = {v.name: v.shape for v in trainable.var_infos()}
+    def sync_grad(name, g):
+        if name in expert_vars:
+            # Each device owns its experts; only replicas along the data
+            # axis hold the same shard.  The global objective is the
+            # mean over ALL token groups — (1/E) x the mean of this
+            # device's local-mean loss — so the local grad must be
+            # scaled by 1/E_shards to match what replicated params get
+            # from their pmean over (data x expert).  (Without this,
+            # expert tables train at an E_shards-scaled learning rate;
+            # adam's scale invariance masked it.)
+            g = g / E_shards
+            return lax.pmean(g, data_axis) if has_data else g
+        return lax.pmean(g, batch_axes)
 
-    opt_shapes = jax.eval_shape(
-        opt.init,
-        jax.tree.map(lambda l: jax.ShapeDtypeStruct(
-            tuple(np.shape(l)), jnp.result_type(l)), trainable.params))
-
-    def opt_spec_for(path, leaf):
-        from autodist_tpu.capture import path_to_name
-        name = path_to_name(path)
-        var = common.match_var_by_suffix(
-            name, spec_by_name,
-            shape_ok=lambda v: tuple(leaf.shape)
-            == tuple(shapes_by_name[v]))
-        return spec_by_name[var] if var else P()
-
-    o_specs = jax.tree_util.tree_map_with_path(opt_spec_for, opt_shapes)
-    extra_specs = jax.tree.map(lambda _: P(), trainable.extra)
-    state_specs = {"step": P(), "params": p_specs, "opt_state": o_specs,
-                   "extra": extra_specs, "sync_state": {}}
-    state_shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), state_specs,
-        is_leaf=lambda x: isinstance(x, P))
     batch_spec = P(common.axes_entry(batch_axes))
-
-    def _init(params, extra):
-        return {"step": jnp.zeros((), jnp.int32),
-                "params": jax.tree.map(jnp.asarray, params),
-                "opt_state": opt.init(jax.tree.map(jnp.asarray, params)),
-                "extra": extra, "sync_state": {}}
-
-    init_fn = jax.jit(_init, out_shardings=state_shardings)
-
-    accum = max(strategy.graph_config.accum_steps, 1)
-
-    def _local_step(state, batch, rng):
-        local_rng = jax.random.fold_in(rng, lax.axis_index(batch_axes))
-
-        def micro_grads(mb, rng_, extra_in):
-            def loss_of(params):
-                loss, new_extra, metrics = trainable.loss(
-                    params, extra_in, mb, rng_)
-                return loss, (new_extra, metrics)
-
-            return jax.value_and_grad(loss_of, has_aux=True)(
-                state["params"])
-
-        if accum == 1:
-            (_, (new_extra, metrics)), grads = micro_grads(
-                batch, local_rng, state["extra"])
-        else:
-            grads, new_extra, metrics = common.accumulate_microbatches(
-                micro_grads, state["params"], batch, local_rng,
-                state["extra"], accum)
-
-        def sync_grad(name, g):
-            if name in expert_vars:
-                # Each device owns its experts; only replicas along the
-                # data axis hold the same shard.
-                return lax.pmean(g, data_axis) if has_data else g
-            return lax.pmean(g, batch_axes)
-
-        grads = common.tree_from_names(grads, sync_grad)
-        metrics = _reduce_metrics(dict(metrics), batch_axes)
-        new_extra = jax.tree.map(
-            lambda x: lax.pmean(x, batch_axes)
-            if jnp.issubdtype(jnp.result_type(x), jnp.inexact) else x,
-            new_extra)
-        updates, new_opt = opt.update(grads, state["opt_state"],
-                                      state["params"])
-        new_params = optax.apply_updates(state["params"], updates)
-        return ({"step": state["step"] + 1, "params": new_params,
-                 "opt_state": new_opt, "extra": new_extra,
-                 "sync_state": {}}, metrics)
-
-    def _step(state, batch, rng):
-        return jax.shard_map(
-            _local_step, mesh=mesh,
-            in_specs=(state_specs, common.batch_specs(batch, batch_spec),
-                      P()),
-            out_specs=(state_specs, P()),
-            check_vma=False)(state, batch, rng)
-
-    step_fn = jax.jit(_step, donate_argnums=(0,))
-
-    def _local_eval(state, batch, rng):
-        _, _, metrics = trainable.eval_loss(
-            state["params"], state["extra"], batch,
-            jax.random.fold_in(rng, lax.axis_index(batch_axes)))
-        return _reduce_metrics(dict(metrics), batch_axes)
-
-    def _eval(state, batch, rng):
-        return jax.shard_map(
-            _local_eval, mesh=mesh,
-            in_specs=(state_specs, common.batch_specs(batch, batch_spec),
-                      P()),
-            out_specs=P(), check_vma=False)(state, batch, rng)
-
-    eval_fn = jax.jit(_eval)
-
-    return SimpleLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
-                         state_specs=state_specs,
-                         state_shardings=state_shardings,
-                         batch_spec=batch_spec, eval_fn=eval_fn)
+    return build_replicated_spmd(
+        trainable, mesh, sync_axes=batch_axes,
+        batch_spec_fn=lambda batch: common.batch_specs(batch, batch_spec),
+        batch_spec=batch_spec, param_spec_fn=param_spec,
+        grad_sync=sync_grad,
+        accum=max(strategy.graph_config.accum_steps, 1))
 
 
 def dense_moe_reference(tokens, gate_w, expert_wi, expert_wo,

@@ -19,11 +19,9 @@ from __future__ import annotations
 
 from typing import Callable, Optional, Sequence
 
-import jax
 import jax.numpy as jnp
-import optax
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from autodist_tpu import const
 from autodist_tpu.kernel import common
@@ -52,26 +50,18 @@ def global_positions(local_len: int, *, seq_axis: str = const.SEQ_AXIS,
 def _build_sequence(trainable, mesh, *, seq_leaves: Sequence[str],
                     seq_axis: str, data_axis: str, accum: int = 1):
     """Shared construction for both the direct API and the Strategy-IR
-    lowering; returns a :class:`~autodist_tpu.kernel.lowering.SimpleLowered`."""
-    from autodist_tpu.kernel.lowering import SimpleLowered, _reduce_metrics
+    lowering; returns a :class:`~autodist_tpu.kernel.lowering.SimpleLowered`.
+
+    Placement policy: params replicate; token-dim batch leaves split over
+    (data x) seq; per-shard token-mean grads pmean over both axes — the
+    exact full-sequence objective for equal shards.  The step machinery
+    is the shared replicated-SPMD builder (``parallel/_spmd.py``)."""
+    from autodist_tpu.parallel._spmd import build_replicated_spmd
 
     if seq_axis not in mesh.shape:
         raise ValueError(f"mesh {dict(mesh.shape)} has no {seq_axis!r} axis")
     has_data = data_axis in mesh.shape
     sync_axes = (data_axis, seq_axis) if has_data else (seq_axis,)
-    opt = trainable.optimizer
-
-    state_specs = {
-        "step": P(),
-        "params": jax.tree.map(lambda _: P(), trainable.params),
-        "opt_state": jax.tree.map(lambda _: P(),
-                                  jax.eval_shape(opt.init, trainable.params)),
-        "extra": jax.tree.map(lambda _: P(), trainable.extra),
-        "sync_state": {},
-    }
-    state_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
-                                   state_specs,
-                                   is_leaf=lambda x: isinstance(x, P))
 
     def batch_spec_for(name, leaf):
         if jnp.ndim(leaf) == 0:
@@ -93,78 +83,10 @@ def _build_sequence(trainable, mesh, *, seq_leaves: Sequence[str],
         return common.tree_from_names(
             batch, lambda name, leaf: batch_spec_for(name, leaf))
 
-    def _init(params, extra):
-        return {"step": jnp.zeros((), jnp.int32),
-                "params": jax.tree.map(jnp.asarray, params),
-                "opt_state": opt.init(jax.tree.map(jnp.asarray, params)),
-                "extra": extra, "sync_state": {}}
-
-    init_fn = jax.jit(_init, out_shardings=state_shardings)
-
-    def _local_step(state, batch, rng):
-        local_rng = jax.random.fold_in(rng, lax.axis_index(sync_axes))
-
-        def micro_grads(mb, rng_, extra_in):
-            def loss_of(params):
-                loss, new_extra, metrics = trainable.loss(
-                    params, extra_in, mb, rng_)
-                return loss, (new_extra, metrics)
-
-            return jax.value_and_grad(loss_of, has_aux=True)(
-                state["params"])
-
-        if accum == 1:
-            (loss, (new_extra, metrics)), grads = micro_grads(
-                batch, local_rng, state["extra"])
-        else:
-            grads, new_extra, metrics = common.accumulate_microbatches(
-                micro_grads, state["params"], batch, local_rng,
-                state["extra"], accum)
-        # Per-shard token-mean grads → global mean over data x seq.
-        grads = jax.tree.map(lambda g: lax.pmean(g, sync_axes), grads)
-        metrics = _reduce_metrics(dict(metrics), sync_axes)
-        # extra (e.g. batch stats) must be SPMD-invariant: average float
-        # leaves defensively (same guard as the collective lowering).
-        new_extra = jax.tree.map(
-            lambda x: lax.pmean(x, sync_axes)
-            if jnp.issubdtype(jnp.result_type(x), jnp.inexact) else x,
-            new_extra)
-        updates, new_opt = opt.update(grads, state["opt_state"],
-                                      state["params"])
-        new_params = optax.apply_updates(state["params"], updates)
-        return ({"step": state["step"] + 1, "params": new_params,
-                 "opt_state": new_opt, "extra": new_extra,
-                 "sync_state": {}}, metrics)
-
-    def _step(state, batch, rng):
-        return jax.shard_map(
-            _local_step, mesh=mesh,
-            in_specs=(state_specs, batch_spec_fn(batch), P()),
-            out_specs=(state_specs, P()),
-            check_vma=False)(state, batch, rng)
-
-    step_fn = jax.jit(_step, donate_argnums=(0,))
-
-    def _local_eval(state, batch, rng):
-        _, _, metrics = trainable.eval_loss(
-            state["params"], state["extra"], batch,
-            jax.random.fold_in(rng, lax.axis_index(sync_axes)))
-        return _reduce_metrics(dict(metrics), sync_axes)
-
-    def _eval(state, batch, rng):
-        return jax.shard_map(
-            _local_eval, mesh=mesh,
-            in_specs=(state_specs, batch_spec_fn(batch), P()),
-            out_specs=P(), check_vma=False)(state, batch, rng)
-
-    eval_fn = jax.jit(_eval)
-
     base_spec = P((data_axis, seq_axis) if has_data else (seq_axis,))
-    return SimpleLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
-                         state_specs=state_specs,
-                         state_shardings=state_shardings,
-                         batch_spec=base_spec, eval_fn=eval_fn,
-                         batch_spec_fn=batch_spec_fn)
+    return build_replicated_spmd(
+        trainable, mesh, sync_axes=sync_axes,
+        batch_spec_fn=batch_spec_fn, batch_spec=base_spec, accum=accum)
 
 
 def lower_sequence_parallel(trainable, mesh, *,
