@@ -1,0 +1,157 @@
+"""Mixture-of-Experts transformer LM (expert-parallel model family).
+
+Beyond reference parity (SURVEY.md §2.10 lists expert parallelism as
+absent from the reference): a decoder-only LM whose MLP blocks are
+GShard-style top-2-gated expert layers
+(:func:`autodist_tpu.parallel.moe.expert_parallel_ffn`).  Built to run
+two ways from one parameter set:
+
+* single-device / data-parallel: ``expert_sharded=False`` routes tokens
+  through the dense reference dispatch (no collectives) — the golden
+  semantics;
+* expert-parallel: ``expert_sharded=True`` inside the ``expert``
+  lowering's ``shard_map`` — each device holds ``E / expert_axis``
+  experts, tokens travel by ``all_to_all``.
+
+The gating aux loss rides the metrics contract (summed into the loss by
+``make_moe_lm_trainable``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu import const
+from autodist_tpu.models.transformer import (SelfAttention,
+                                             TransformerConfig)
+from autodist_tpu.parallel.moe import (dense_moe_reference,
+                                       expert_parallel_ffn)
+
+
+@dataclasses.dataclass(unsafe_hash=True)
+class MoeConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    num_layers: int = 4
+    num_heads: int = 8
+    expert_hidden: int = 1024
+    num_experts: int = 8
+    capacity_factor: float = 2.0
+    max_len: int = 512
+    aux_weight: float = 0.01
+    dtype: Any = jnp.bfloat16
+
+    def encoder_cfg(self) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            num_layers=self.num_layers, num_heads=self.num_heads,
+            mlp_dim=self.expert_hidden, max_len=self.max_len,
+            dropout_rate=0.0, attention_dropout_rate=0.0,
+            dtype=self.dtype, causal=True)
+
+
+class MoeBlock(nn.Module):
+    """Top-2-gated expert MLP over flattened tokens."""
+
+    cfg: MoeConfig
+    expert_sharded: bool
+
+    @nn.compact
+    def __call__(self, x):
+        from jax import lax
+
+        cfg = self.cfg
+        B, L, H = x.shape
+        # Inside the expert lowering's shard_map this module sees its
+        # LOCAL expert shard: declare E/axis_size rows (axis size is
+        # static at trace time).  The gate stays global — tokens score
+        # every expert before the all_to_all.
+        E_local = cfg.num_experts
+        if self.expert_sharded:
+            E_local //= lax.axis_size(const.EXPERT_AXIS)
+        gate = self.param("expert_gate", nn.initializers.normal(0.02),
+                          (H, cfg.num_experts), jnp.float32)
+        wi = self.param("expert_wi",
+                        nn.initializers.normal(0.02 / np.sqrt(H)),
+                        (E_local, H, cfg.expert_hidden),
+                        jnp.float32)
+        wo = self.param("expert_wo",
+                        nn.initializers.normal(0.02 / np.sqrt(cfg.expert_hidden)),
+                        (E_local, cfg.expert_hidden, H),
+                        jnp.float32)
+        tokens = x.reshape(B * L, H).astype(jnp.float32)
+        if self.expert_sharded:
+            out, aux = expert_parallel_ffn(
+                tokens, gate, wi, wo, axis_name=const.EXPERT_AXIS,
+                capacity_factor=cfg.capacity_factor)
+        else:
+            G = tokens.shape[0]
+            capacity = max(int(np.ceil(
+                2 * G * cfg.capacity_factor / cfg.num_experts)), 4)
+            out, aux = dense_moe_reference(tokens, gate, wi, wo, capacity)
+        return out.reshape(B, L, H).astype(x.dtype), aux
+
+
+class MoeTransformerLM(nn.Module):
+    """Decoder-only LM: attention blocks + MoE MLP blocks."""
+
+    cfg: MoeConfig
+    expert_sharded: bool = False
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        enc = cfg.encoder_cfg()
+        B, L = tokens.shape
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         name="token_embed")
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (cfg.max_len, cfg.hidden_size), jnp.float32)
+        x = embed(tokens) + pos[None, :L].astype(cfg.dtype)
+        causal = nn.make_causal_mask(tokens, dtype=jnp.bool_)
+        aux_total = 0.0
+        for i in range(cfg.num_layers):
+            a = SelfAttention(enc, name=f"layer_{i}_attention")(
+                x, causal, True)
+            x = nn.LayerNorm(dtype=cfg.dtype,
+                             name=f"layer_{i}_ln_attention")(x + a)
+            m, aux = MoeBlock(cfg, self.expert_sharded,
+                              name=f"layer_{i}_moe")(x)
+            aux_total = aux_total + aux
+            x = nn.LayerNorm(dtype=cfg.dtype,
+                             name=f"layer_{i}_ln_moe")(x + m)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_final")(x)
+        logits = embed.attend(x.astype(jnp.float32))
+        return logits, aux_total / cfg.num_layers
+
+
+def make_moe_lm_trainable(cfg: MoeConfig, optimizer, rng, *,
+                          batch_size=4, seq_len=64,
+                          expert_sharded: bool = True):
+    """Trainable for the MoE LM.  ``expert_sharded=True`` builds the
+    all_to_all routing for the ``ExpertParallel`` strategy (the ``moe``
+    lowering runs the loss inside an ``expert``-axis ``shard_map``);
+    ``False`` is the dense single-device semantics for goldens."""
+    from autodist_tpu.capture import Trainable
+
+    init_model = MoeTransformerLM(cfg, expert_sharded=False)
+    tokens = jnp.zeros((batch_size, seq_len), jnp.int32)
+    params = init_model.init(jax.random.PRNGKey(
+        int(jax.random.randint(rng, (), 0, 2**31 - 1))
+        if hasattr(rng, "dtype") else rng), tokens)["params"]
+    model = MoeTransformerLM(cfg, expert_sharded=expert_sharded)
+
+    def loss(p, extra, batch, step_rng):
+        logits, aux = model.apply({"params": p}, batch["x"])
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, batch["y"][..., None], axis=-1)
+        nll = -jnp.mean(ll)
+        total = nll + cfg.aux_weight * aux
+        return total, extra, {"loss": total, "nll": nll, "aux": aux}
+
+    return Trainable(loss, params, optimizer, name="moe_lm")

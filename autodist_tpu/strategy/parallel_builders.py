@@ -21,6 +21,7 @@ from typing import Optional, Sequence
 
 from autodist_tpu import const
 from autodist_tpu.strategy.base import StrategyBuilder
+from autodist_tpu.utils import logging
 from autodist_tpu.strategy.ir import (AllReduceSynchronizer, NodeConfig,
                                       PartitionerConfig, Strategy)
 
@@ -120,7 +121,9 @@ class ExpertParallel(StrategyBuilder):
 
     Variables carrying a leading expert dimension — named explicitly via
     ``expert_params`` (path-suffix match) or auto-detected (name contains
-    ``expert``/``moe`` and the leading dim divides the expert axis) — are
+    ``expert``/``moe``, rank >= 3, and the leading dim divides the expert
+    axis; rank-2 tensors like gating matrices are never auto-sharded — a
+    gate's leading dim is the hidden size, not the expert count) — are
     stored sharded across experts; everything else replicates with the
     expert axis doubling as a batch axis (GShard arrangement).  The
     model must route tokens through
@@ -146,7 +149,20 @@ class ExpertParallel(StrategyBuilder):
             explicit = any(i.name == p or i.name.endswith("/" + p)
                            for p in self.expert_params)
             auto = (self.detect and _EXPERT_NAME_RE.search(i.name)
-                    and len(i.shape) >= 2 and i.shape[0] % E == 0)
+                    and len(i.shape) >= 3 and i.shape[0] % E == 0)
+            if (not explicit and not auto and self.detect
+                    and _EXPERT_NAME_RE.search(i.name)
+                    and len(i.shape) == 2 and i.shape[0] % E == 0):
+                # A rank-2 tensor in an expert scope could be a gate
+                # (leading dim = hidden — must replicate) or a
+                # per-expert bias (leading dim = experts — should
+                # shard); only the user can tell.  Say so instead of
+                # silently replicating.
+                logging.info(
+                    "%s: rank-2 tensor in an expert-named scope is NOT "
+                    "auto-sharded (could be a gate); pass "
+                    "expert_params=(%r,) if it is a per-expert table",
+                    i.name, i.name.rsplit("/", 1)[-1])
             node = NodeConfig(var_name=i.name,
                               synchronizer=AllReduceSynchronizer(),
                               is_sparse=i.is_sparse)

@@ -350,3 +350,113 @@ def test_expert_parallel_requires_expert_vars():
         {"w": jnp.ones((4, 4))}, optax.sgd(0.1))
     with pytest.raises(ValueError, match="no expert variables"):
         ad.build_or_load_strategy(plain)
+
+
+# --------------------------------------------------------------------------- #
+# MoE transformer LM model family through ExpertParallel
+# --------------------------------------------------------------------------- #
+def test_moe_transformer_lm_trains_expert_parallel():
+    """The bundled MoE LM model family trains through the ExpertParallel
+    strategy: expert tables sharded, gate replicated (never auto-sharded
+    despite its 'moe'-scoped name), loss decreasing, aux loss finite."""
+    import optax
+
+    from autodist_tpu.models.moe_transformer import (MoeConfig,
+                                                     make_moe_lm_trainable)
+
+    cfg = MoeConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                    num_heads=2, expert_hidden=32, num_experts=4,
+                    max_len=32, dtype=jnp.float32)
+    trainable = make_moe_lm_trainable(cfg, optax.adam(1e-2),
+                                      jax.random.PRNGKey(0),
+                                      batch_size=4, seq_len=16)
+    ad = AutoDist({"topology": {"platform": "cpu", "num_devices": 4},
+                   "mesh": {"expert": 4}}, "ExpertParallel")
+    strategy = ad.build_or_load_strategy(trainable)
+    by_name = {n.var_name: n for n in strategy.node_configs}
+    assert by_name["layer_0_moe/expert_wi"].partitioner is not None
+    assert by_name["layer_0_moe/expert_wo"].partitioner is not None
+    assert by_name["layer_0_moe/expert_gate"].partitioner is None
+
+    runner = ad.build(trainable, strategy)
+    r = np.random.RandomState(0)
+    x = r.randint(0, 64, (8, 16)).astype(np.int32)
+    batch = {"x": x, "y": np.roll(x, -1, axis=1)}
+    losses = []
+    for _ in range(8):
+        m = runner.step(batch)
+        losses.append(float(np.asarray(m["loss"])))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    assert np.isfinite(float(np.asarray(m["aux"])))
+
+
+def test_expert_parallel_sgd_matches_dense_golden():
+    """With ample capacity (no token drops) the per-token MoE output is
+    independent of routing-group composition, so expert-parallel SGD must
+    reproduce the dense single-device run EXACTLY — including gradient
+    scale on the expert tables (a missing 1/E_shards would train experts
+    at an E-scaled learning rate; adam's scale invariance hides it, sgd
+    does not).  aux_weight-free loss: the balance term is group-local by
+    construction."""
+    import optax
+
+    E_, M_, H_, G_ = 4, 8, 16, 8
+
+    def make(seed=0):
+        r = np.random.RandomState(seed)
+        params = {
+            "gate": jnp.asarray(r.randn(M_, E_) * 0.5, jnp.float32),
+            "moe_wi": jnp.asarray(r.randn(E_, M_, H_) * 0.2, jnp.float32),
+            "moe_wo": jnp.asarray(r.randn(E_, H_, M_) * 0.2, jnp.float32),
+        }
+
+        def loss_fn(p, batch):
+            out, _ = expert_parallel_ffn(batch["x"], p["gate"],
+                                         p["moe_wi"], p["moe_wo"],
+                                         capacity_factor=float(E_))
+            return jnp.mean((out - batch["y"]) ** 2)
+
+        return Trainable.from_loss_fn(loss_fn, params, optax.sgd(0.1))
+
+    r = np.random.RandomState(1)
+    x = r.randn(4 * G_, M_).astype(np.float32)
+    y = (x @ (r.randn(M_, M_).astype(np.float32) * 0.1))
+    batch = {"x": x, "y": y}
+
+    ad = AutoDist({"topology": {"platform": "cpu", "num_devices": 4},
+                   "mesh": {"expert": 4}}, "ExpertParallel")
+    runner = ad.build(make())
+    for _ in range(3):
+        runner.step(batch)
+
+    # dense single-device reference: same loss fn on a 1-device expert
+    # mesh is just dense routing of all tokens at once — but the group
+    # partition differs, so instead run the sharded semantics by hand:
+    # mean over the 4 groups of each group's local-mean loss.
+    ref = make()
+    params = ref.params
+    opt_state = ref.optimizer.init(params)
+    from autodist_tpu.parallel.moe import dense_moe_reference
+    capacity = max(int(np.ceil(2 * G_ * float(E_) / E_)), 4)
+
+    def group_loss(p, xb, yb):
+        out, _ = dense_moe_reference(xb, p["gate"], p["moe_wi"],
+                                     p["moe_wo"], capacity)
+        return jnp.mean((out - yb) ** 2)
+
+    def total_loss(p):
+        losses = [group_loss(p, jnp.asarray(x[g * G_:(g + 1) * G_]),
+                             jnp.asarray(y[g * G_:(g + 1) * G_]))
+                  for g in range(4)]
+        return sum(losses) / 4.0
+
+    for _ in range(3):
+        g = jax.grad(total_loss)(params)
+        upd, opt_state = ref.optimizer.update(g, opt_state, params)
+        params = optax.apply_updates(params, upd)
+
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-5),
+        runner.get_params(), jax.device_get(params))
