@@ -207,7 +207,8 @@ class PipelineTrainable(Trainable):
 
     def __init__(self, stage_fn, stacked_params, loss_head, optimizer, *,
                  num_stages: int, batch_key: str = "x",
-                 stage_aux: bool = False, **kw):
+                 stage_aux: bool = False, shared_params=None,
+                 prologue=None, **kw):
         sizes = set()
         for l in jax.tree_util.tree_leaves(stacked_params):
             shape = getattr(l, "shape", ())
@@ -216,6 +217,8 @@ class PipelineTrainable(Trainable):
             raise ValueError(
                 f"stacked_params leading dims {sorted(sizes, key=str)} != "
                 f"num_stages {num_stages}")
+        if prologue is not None and shared_params is None:
+            raise ValueError("a prologue needs shared_params to act on")
         self.stage_fn = stage_fn
         self.loss_head = loss_head
         self.num_stages = num_stages
@@ -225,21 +228,44 @@ class PipelineTrainable(Trainable):
         # pipelined execution — use mean-style aux so the average equals
         # the full-batch value).
         self.stage_aux = stage_aux
+        # Replicated parameters outside the stage stack — the
+        # embedding/unembedding of a pipelined transformer:
+        # ``prologue(shared, batch) -> activation`` produces chunk 0's
+        # input, and ``loss_head(outputs, batch, shared)`` (3-arg form,
+        # used iff shared_params is set) closes the model on the last
+        # stage.  Their gradients psum over the pipe axis (each device
+        # contributes a different role: injection on device 0, the head
+        # on device n-1).
+        self.shared_params = shared_params
+        self.prologue = prologue
+        self.has_shared = shared_params is not None
+
+        has_shared = self.has_shared
 
         def sequential_loss(params, extra, batch, rng):
-            x = batch[batch_key]
+            stages = params["stages"] if has_shared else params
+            shared = params.get("shared") if has_shared else None
+            if prologue is not None:
+                x = prologue(shared, batch)
+            else:
+                x = batch[batch_key]
             aux_total = 0.0
             for i in range(num_stages):
-                chunk = jax.tree_util.tree_map(lambda p: p[i], params)
+                chunk = jax.tree_util.tree_map(lambda p: p[i], stages)
                 if stage_aux:
                     x, aux = stage_fn(chunk, x)
                     aux_total = aux_total + aux
                 else:
                     x = stage_fn(chunk, x)
-            loss, metrics = loss_head(x, batch)
+            if has_shared:
+                loss, metrics = loss_head(x, batch, shared)
+            else:
+                loss, metrics = loss_head(x, batch)
             if stage_aux:
                 loss = loss + aux_total
                 metrics = dict(metrics, aux_loss=aux_total)
             return loss, extra, dict(metrics, loss=loss)
 
-        super().__init__(sequential_loss, stacked_params, optimizer, **kw)
+        params = ({"stages": stacked_params, "shared": shared_params}
+                  if self.has_shared else stacked_params)
+        super().__init__(sequential_loss, params, optimizer, **kw)

@@ -229,6 +229,7 @@ class _PipelineLowered(SimpleLowered):
     declared (the 'looks unpartitioned' contract)."""
 
     perm_inv: Any = None
+    has_shared: bool = False
 
     def unpad_params(self, params):
         if self.perm_inv is None:
@@ -237,8 +238,15 @@ class _PipelineLowered(SimpleLowered):
         # would need a reshard; fetch callers (get_params, portable save)
         # device_get immediately anyway.
         inv = np.asarray(self.perm_inv)
-        return jax.tree.map(
-            lambda p: np.asarray(jax.device_get(p))[inv], params)
+
+        def unperm(tree):
+            return jax.tree.map(
+                lambda p: np.asarray(jax.device_get(p))[inv], tree)
+
+        if self.has_shared:
+            return {"stages": unperm(params["stages"]),
+                    "shared": jax.device_get(params["shared"])}
+        return unperm(params)
 
 
 # --------------------------------------------------------------------------- #
@@ -249,13 +257,22 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                     data_axis: str = const.DATA_AXIS,
                     pipe_axis: str = const.PIPE_AXIS,
                     accum: int = 1, batch_key: str = "x",
-                    virtual_stages: int = 1, stage_aux: bool = False):
+                    virtual_stages: int = 1, stage_aux: bool = False,
+                    shared_params=None, prologue: Callable = None):
     """Shared construction for the direct API and the Strategy-IR entry;
     returns a Lowered-contract container.
 
     ``stacked_params``: pytree whose leaves carry the *logical* leading
     chunk dimension ``C = n·virtual_stages``; stored internally in the
     interleaved device order (``chunk_permutation``), restored on fetch.
+
+    ``shared_params`` (optional): replicated parameters outside the
+    stage stack — a pipelined transformer's embedding/unembedding.
+    ``prologue(shared, batch) -> activation`` produces chunk 0's input
+    on every device (only device 0's value enters the ring) and
+    ``loss_head(outputs, batch, shared)`` closes the model on the last
+    stage; shared grads psum over the pipe axis (each device contributes
+    a different role) then average over data.
 
     ``accum > 1`` composes gradient accumulation *around* the pipeline:
     each accumulation slice runs the full microbatched schedule, so one
@@ -266,6 +283,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     V = virtual_stages
     C = n * V
     has_data = data_axis in mesh.shape
+    has_shared = shared_params is not None
     for leaf in jax.tree.leaves(stacked_params):
         if leaf.shape[0] != C:
             raise ValueError(
@@ -274,25 +292,48 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     perm = jnp.asarray(chunk_permutation(n, V))
     perm_inv = jnp.asarray(chunk_permutation_inv(n, V))
 
-    p_specs = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
+    stage_specs = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
+    if has_shared:
+        p_specs = {"stages": stage_specs,
+                   "shared": jax.tree.map(lambda _: P(), shared_params)}
+        full_params = {"stages": stacked_params, "shared": shared_params}
+    else:
+        p_specs = stage_specs
+        full_params = stacked_params
     state_specs = {"step": P(), "params": p_specs, "opt_state": p_specs,
                    "extra": None, "sync_state": {}}
 
     def opt_specs_tree(opt_state_shapes):
-        def spec_for(leaf):
+        # 'leading dim == C means stacked' holds only for the stages
+        # subtree (every stage leaf is validated to carry it); a shared
+        # leaf whose leading dim coincidentally equals C (a size-C ln
+        # scale, say) must stay replicated.
+        def spec_for(path, leaf):
+            in_shared = has_shared and any(
+                isinstance(k, jax.tree_util.DictKey) and k.key == "shared"
+                for k in path)
+            if in_shared:
+                return P()
             return P(pipe_axis) if getattr(leaf, "ndim", 0) > 0 \
                 and leaf.shape and leaf.shape[0] == C else P()
-        return jax.tree.map(spec_for, opt_state_shapes)
+        return jax.tree_util.tree_map_with_path(spec_for, opt_state_shapes)
 
-    opt_shapes = jax.eval_shape(optimizer.init, stacked_params)
+    opt_shapes = jax.eval_shape(optimizer.init, full_params)
     o_specs = opt_specs_tree(opt_shapes)
     state_specs["opt_state"] = o_specs
     state_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
                                    state_specs,
                                    is_leaf=lambda x: isinstance(x, P))
 
+    def _permute(params):
+        if has_shared:
+            return {"stages": jax.tree.map(
+                lambda p: jnp.asarray(p)[perm], params["stages"]),
+                "shared": jax.tree.map(jnp.asarray, params["shared"])}
+        return jax.tree.map(lambda p: jnp.asarray(p)[perm], params)
+
     def _init(params, extra=None):
-        stored = jax.tree.map(lambda p: jnp.asarray(p)[perm], params)
+        stored = _permute(params)
         return {"step": jnp.zeros((), jnp.int32),
                 "params": stored,
                 "opt_state": optimizer.init(stored),
@@ -307,15 +348,20 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         transposed ppermute ring; a psum before the grad would double-
         scale cotangents under check_vma=False, so values are broadcast
         after)."""
+        stages = vp["stages"] if has_shared else vp
+        shared = vp.get("shared") if has_shared else None
         # local shard of the [C]-stacked params is [V, ...]; the V == 1
         # public contract of pipeline_apply takes the chunk params bare
-        local = vp if V > 1 else jax.tree.map(lambda p: p[0], vp)
-        res = pipeline_apply(stage_fn, local, batch[batch_key],
+        local = stages if V > 1 else jax.tree.map(lambda p: p[0], stages)
+        x_in = prologue(shared, batch) if prologue is not None \
+            else batch[batch_key]
+        res = pipeline_apply(stage_fn, local, x_in,
                              axis_name=pipe_axis,
                              num_microbatches=num_microbatches,
                              virtual_stages=V, stage_aux=stage_aux)
         outputs, aux = res if stage_aux else (res, None)
-        loss, metrics = loss_head(outputs, batch)
+        loss, metrics = loss_head(outputs, batch, shared) if has_shared \
+            else loss_head(outputs, batch)
         idx = lax.axis_index(pipe_axis)
         masked = jnp.where(idx == n - 1, loss, 0.0)
         metrics = dict(metrics, loss=loss)
@@ -367,6 +413,14 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                 micro_grads, vparams, batch, rng, None, accum)
 
         metrics = _broadcast_metrics(metrics)
+        if has_shared:
+            # Each device holds a different piece of the shared grads
+            # (injection on device 0, the head on device n-1, zeros in
+            # between): sum, don't average, over the pipe axis.
+            grads = {"stages": grads["stages"],
+                     "shared": jax.tree.map(
+                         lambda g: lax.psum(g, pipe_axis),
+                         grads["shared"])}
         if has_data:
             grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
 
@@ -404,7 +458,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                             state_specs=state_specs,
                             state_shardings=state_shardings,
                             batch_spec=batch_spec, eval_fn=eval_fn,
-                            perm_inv=perm_inv)
+                            perm_inv=perm_inv, has_shared=has_shared)
 
 
 def lower_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
@@ -447,9 +501,14 @@ def lower_pipeline_ir(trainable, strategy, mesh):
         raise ValueError(
             f"trainable declares {trainable.num_stages} stages; mesh pipe "
             f"axis has {S} devices x {V} virtual stages")
+    stacked = (trainable.params["stages"] if trainable.has_shared
+               else trainable.params)
     return _build_pipeline(
-        trainable.stage_fn, trainable.params, trainable.loss_head,
+        trainable.stage_fn, stacked, trainable.loss_head,
         trainable.optimizer, mesh,
         num_microbatches=int(cfg.parallel.get("num_microbatches", 1)),
         accum=max(cfg.accum_steps, 1), batch_key=trainable.batch_key,
+        shared_params=(trainable.params["shared"] if trainable.has_shared
+                       else None),
+        prologue=trainable.prologue,
         virtual_stages=V, stage_aux=trainable.stage_aux)

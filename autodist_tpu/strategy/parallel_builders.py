@@ -97,15 +97,20 @@ class Pipeline(StrategyBuilder):
                 f"trainable declares {num_stages} stages; mesh pipe axis "
                 f"has {shape[const.PIPE_AXIS]} devices x "
                 f"{self.virtual_stages} virtual stages")
+        has_shared = getattr(trainable, "has_shared", False)
         nodes = []
         for i in trainable.var_infos():
-            spec = [const.PIPE_AXIS] + [None] * (max(len(i.shape), 1) - 1)
-            nodes.append(NodeConfig(
-                var_name=i.name,
-                synchronizer=AllReduceSynchronizer(),
-                partitioner=PartitionerConfig(mesh_axis=const.PIPE_AXIS,
-                                              spec=spec),
-                is_sparse=i.is_sparse))
+            node = NodeConfig(var_name=i.name,
+                              synchronizer=AllReduceSynchronizer(),
+                              is_sparse=i.is_sparse)
+            # shared-group vars (embedding/unembedding of a pipelined
+            # transformer) replicate; stage vars shard on the pipe axis.
+            if not has_shared or i.name.startswith("stages/"):
+                node.partitioner = PartitionerConfig(
+                    mesh_axis=const.PIPE_AXIS,
+                    spec=[const.PIPE_AXIS]
+                    + [None] * (max(len(i.shape), 1) - 1))
+            nodes.append(node)
         cfg = self._graph_config(resource_spec)
         cfg.lowering = "pipeline"
         cfg.parallel = {"num_microbatches": self.num_microbatches,
