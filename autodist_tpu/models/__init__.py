@@ -1,6 +1,9 @@
 """Model zoo: parity with the reference's examples + benchmark models
 (SURVEY.md §2.8): linear regression, MNIST CNN, ImageNet CNNs (ResNet
-family), BERT MLM, lm1b word LM with sampled softmax, NCF/NeuMF."""
+family), BERT MLM, lm1b word LM with sampled softmax, NCF/NeuMF —
+plus beyond-parity families for the advanced parallelisms: the
+stage-form pipelined LM (``pipeline_lm``) and the MoE transformer LM
+(``moe_transformer``)."""
 
 from autodist_tpu.models.bert import (BertModel, bert_base, bert_large,
                                       make_mlm_trainable, mlm_loss_head,
@@ -21,3 +24,7 @@ from autodist_tpu.models.resnet import (ResNet18, ResNet34, ResNet50,
 from autodist_tpu.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19
 from autodist_tpu.models.transformer import (Encoder, TransformerConfig,
                                              TransformerLM, lm_loss_head)
+from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+from autodist_tpu.models.moe_transformer import (MoeConfig,
+                                                 MoeTransformerLM,
+                                                 make_moe_lm_trainable)
