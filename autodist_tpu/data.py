@@ -68,7 +68,7 @@ class DataLoader:
 
     def __init__(self, source: Iterable | Callable[[int], Any], mesh,
                  *, buffer_size: int = 2, global_batches: bool = False,
-                 num_batches: Optional[int] = None):
+                 num_batches: Optional[int] = None, lowered=None):
         if buffer_size < 1:
             raise ValueError("buffer_size must be >= 1")
         self.mesh = mesh
@@ -76,6 +76,11 @@ class DataLoader:
         self.global_batches = global_batches
         self.num_batches = num_batches
         self._source = source
+        # The lowering's feed contract (Lowered.batch_spec_tree), when
+        # known: pipe-/seq-/expert-axis meshes place batches differently
+        # than the default data-axis split (a pipe-only mesh has no data
+        # axis at all).  fit() passes the runner's lowered.
+        self.lowered = lowered
 
     def _batches(self) -> Iterator[Any]:
         if callable(self._source):
@@ -94,18 +99,48 @@ class DataLoader:
         from autodist_tpu.kernel import common
         from autodist_tpu.kernel.lowering import replica_axes
 
+        if self.lowered is not None:
+            specs = self.lowered.batch_spec_tree(batch)
+        else:
+            # Split over the full replica group — ('dcn', 'data') on
+            # multi-slice meshes, matching the lowered batch_spec.
+            specs = common.batch_specs(
+                batch, P(common.axes_entry(replica_axes(self.mesh))))
         if self.global_batches:
-            batch = shard_batch(batch)
-        # Split over the full replica group — ('dcn', 'data') on
-        # multi-slice meshes, matching the lowered batch_spec.
-        spec = P(common.axes_entry(replica_axes(self.mesh)))
-        shardings = common.batch_shardings(batch, self.mesh, spec)
-        if jax.process_count() > 1:
-            return jax.tree.map(
-                lambda x, s: jax.make_array_from_process_local_data(
-                    s, np.asarray(x)), batch, shardings)
-        return jax.tree.map(
-            lambda x, s: jax.device_put(np.asarray(x), s), batch, shardings)
+            # Per-leaf: this process keeps its slice of batch-split
+            # leaves and the FULL value of replicated ones — slicing a
+            # leaf whose spec is replicated would hand
+            # make_array_from_process_local_data divergent data for a
+            # nominally replicated array (silent cross-host skew).
+            pc = jax.process_count()
+            pi = jax.process_index()
+
+            def slc(x, s):
+                x = np.asarray(x)
+                split = x.ndim > 0 and len(s) > 0 and s[0]
+                if pc == 1 or not split:
+                    return x
+                if x.shape[0] % pc:
+                    raise ValueError(
+                        f"global batch dim {x.shape[0]} not divisible "
+                        f"by {pc} processes")
+                k = x.shape[0] // pc
+                return x[pi * k:(pi + 1) * k]
+
+            batch = jax.tree.map(slc, batch, specs)
+        shardings = common.specs_to_shardings(specs, self.mesh)
+
+        def place(x, sharding):
+            x = np.asarray(x)
+            if jax.process_count() > 1:
+                # x is this process's local slice; the global-shape
+                # divisibility is make_array_from_process_local_data's
+                # own contract to enforce.
+                return jax.make_array_from_process_local_data(sharding, x)
+            common.check_batch_divisibility(x, sharding.spec, self.mesh)
+            return jax.device_put(x, sharding)
+
+        return jax.tree.map(place, batch, shardings)
 
     def __iter__(self) -> Iterator[Any]:
         q: "queue.Queue" = queue.Queue(maxsize=self.buffer_size)

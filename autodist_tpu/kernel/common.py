@@ -177,6 +177,38 @@ def batch_shardings(batch, mesh, spec):
         lambda x: rep if jnp.ndim(x) == 0 else split, batch)
 
 
+def specs_to_shardings(specs, mesh):
+    """PartitionSpec tree → NamedSharding tree (single feed-contract
+    translation, shared by the runner and the DataLoader)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def spec_shard_count(entry, mesh) -> int:
+    """Devices a single PartitionSpec entry shards a dim over."""
+    axes = entry if isinstance(entry, tuple) else (
+        (entry,) if entry else ())
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    return n
+
+
+def check_batch_divisibility(x, spec, mesh):
+    """Loud feed-contract error for every sharded dim of one leaf (the
+    curated message a raw device_put error would bury)."""
+    import numpy as np
+    for dim, entry in enumerate(spec):
+        if dim >= np.ndim(x):
+            break
+        n = spec_shard_count(entry, mesh)
+        if n > 1 and np.shape(x)[dim] % n:
+            raise ValueError(
+                f"batch dim {dim} of shape {np.shape(x)} must be "
+                f"divisible by the shard count {n} (axes {entry})")
+
+
 # --------------------------------------------------------------------------- #
 # Pytree path helpers
 # --------------------------------------------------------------------------- #

@@ -17,7 +17,7 @@ Long-context recipe::
 """
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence
+from typing import Optional, Sequence
 
 import jax.numpy as jnp
 from jax import lax

@@ -81,9 +81,10 @@ class DistributedRunner:
         global arrays pass through.  Placement is per-leaf, from the
         lowering's spec tree (sequence parallelism splits token leaves
         over ``data x seq``)."""
+        from autodist_tpu.kernel import common
+
         specs = self.lowered.batch_spec_tree(batch)
-        shardings = jax.tree.map(lambda s: NamedSharding(self.mesh, s),
-                                 specs, is_leaf=lambda x: isinstance(x, P))
+        shardings = common.specs_to_shardings(specs, self.mesh)
 
         def place(x, sharding):
             if isinstance(x, jax.Array):
@@ -94,18 +95,7 @@ class DistributedRunner:
                 # on-device reshard otherwise — never a host round-trip.
                 return jax.device_put(x, sharding)
             x = np.asarray(x)
-            for dim, entry in enumerate(sharding.spec):
-                if dim >= x.ndim:
-                    break
-                axes = entry if isinstance(entry, tuple) else (
-                    (entry,) if entry else ())
-                n = 1
-                for a in axes:
-                    n *= self.mesh.shape[a]
-                if n > 1 and x.shape[dim] % n:
-                    raise ValueError(
-                        f"batch dim {dim} of shape {x.shape} must be "
-                        f"divisible by the shard count {n} (axes {axes})")
+            common.check_batch_divisibility(x, sharding.spec, self.mesh)
             return jax.device_put(x, sharding)
 
         return jax.tree.map(place, batch, shardings)

@@ -17,7 +17,7 @@ the schedule knobs.
 from __future__ import annotations
 
 import re
-from typing import Optional, Sequence
+from typing import Sequence
 
 from autodist_tpu import const
 from autodist_tpu.strategy.base import StrategyBuilder

@@ -65,7 +65,8 @@ def fit(runner, source: Iterable | Callable[[int], Any], *,
         inner = source
         source = lambda i: inner(start + i)  # noqa: E731
     loader = iter(DataLoader(source, runner.mesh, buffer_size=prefetch,
-                             num_batches=remaining))
+                             num_batches=remaining,
+                             lowered=getattr(runner, "lowered", None)))
     import time
 
     t0 = time.perf_counter()

@@ -61,3 +61,43 @@ def test_fit_resumes_from_saver(tmp_path):
     hist = fit(runner2, source, steps=8, saver=saver, log_every=0)
     assert runner2.step_count == 8
     saver.close()
+
+
+def test_fit_with_pipeline_runner(tmp_path):
+    """fit() composes with the pipeline lowering: prefetch, periodic
+    checkpointing, and preemption-style resume on a PipelineTrainable."""
+    from autodist_tpu import PipelineTrainable
+    from autodist_tpu.strategy.parallel_builders import Pipeline
+
+    def make():
+        r = np.random.RandomState(0)
+        stacked = {"w": jnp.asarray(r.randn(4, 8, 8) * 0.3, jnp.float32)}
+
+        def stage(p, x):
+            return jax.nn.relu(x @ p["w"])
+
+        def head(o, b):
+            return jnp.mean((o - b["y"]) ** 2), {}
+
+        return PipelineTrainable(stage, stacked, head, optax.sgd(0.05),
+                                 num_stages=4)
+
+    spec = {"topology": {"platform": "cpu", "num_devices": 4},
+            "mesh": {"pipe": 4}}
+    r = np.random.RandomState(1)
+
+    def source(step):
+        x = r.randn(8, 8).astype(np.float32)
+        return {"x": x, "y": x * 0.5}
+
+    saver = Saver(str(tmp_path))
+    runner = AutoDist(spec, Pipeline(num_microbatches=2)).build(make())
+    fit(runner, source, steps=4, saver=saver, save_every=2, log_every=0)
+    assert runner.step_count == 4
+    assert saver.latest_step() == 4
+
+    # resume: a fresh runner continues from the checkpoint
+    runner2 = AutoDist(spec, Pipeline(num_microbatches=2)).build(make())
+    hist = fit(runner2, source, steps=6, saver=saver, log_every=0)
+    assert runner2.step_count == 6
+    saver.close()
