@@ -34,11 +34,13 @@ def default_candidates() -> list[StrategyBuilder]:
         gspmd_builders.FSDPSharded(),
         gspmd_builders.TensorParallel(),
         # Advanced parallelisms: score only when the topology declares
-        # their mesh axis (seq / pipe) — and, for Pipeline, when the
-        # trainable is stage-structured; otherwise build() raises
-        # ValueError and the candidate is skipped.
+        # their mesh axis (seq / pipe / expert) — and, for Pipeline,
+        # when the trainable is stage-structured, or for ExpertParallel,
+        # when expert tables exist; otherwise build() raises ValueError
+        # and the candidate is skipped.
         parallel_builders.SequenceParallel(),
         parallel_builders.Pipeline(num_microbatches=4),
+        parallel_builders.ExpertParallel(),
     ]
 
 

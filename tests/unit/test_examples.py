@@ -97,3 +97,11 @@ def test_pipeline_train_interleaved():
                      "--virtual-stages", "2", "--microbatches", "2",
                      "--hidden", "16", "--batch", "16", timeout=300)
     assert "virtual=2" in out and "bubble" in out and "loss=" in out
+
+
+def test_moe_train_expert_parallel():
+    out = run_script("examples/moe_train.py", "--steps", "3",
+                     "--experts", "8", "--layers", "1", "--hidden", "32",
+                     "--vocab", "64", "--seq-len", "16", "--batch", "16",
+                     timeout=300)
+    assert "experts over" in out and "aux=" in out
