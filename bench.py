@@ -119,6 +119,20 @@ def main():
     dog = _Watchdog(2400, "backend init").arm()
     try:
         _bench(dog)
+    except RuntimeError as e:
+        # A degraded tunnel surfaces as UNAVAILABLE from PJRT init
+        # (observed: ~30 min blocked inside init, then this error; jax
+        # caches the failure process-wide so retrying here is useless).
+        # The driver still gets one well-formed diagnostic line instead
+        # of a bare traceback.
+        if "UNAVAILABLE" not in str(e) and "backend" not in str(e):
+            raise
+        dog.disarm()
+        print(json.dumps({
+            "metric": "bert_base_mlm_mfu", "value": 0.0,
+            "unit": "mfu", "vs_baseline": 0.0,
+            "error": f"accelerator backend unavailable: {e}"}))
+        sys.exit(3)
     finally:
         dog.disarm()   # every exit path reaps the monitor + stage file
 

@@ -269,3 +269,39 @@ def test_pipeline_pytree_activations_and_stage_aux():
             np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-5),
         jax.device_get(built.unpad_params(state["params"])),
         jax.device_get(expect))
+
+
+def test_pipeline_stage_remat_matches_sequential():
+    """jax.checkpoint inside stage_fn (the documented long-pipeline
+    memory recipe) must not change numerics through the schedule."""
+    mesh = jax.make_mesh((2, 4), ("data", "pipe"))
+    stacked = make_stacked_params(7)
+
+    remat_stage = jax.checkpoint(stage_fn)
+
+    def loss_head(outputs, batch):
+        return jnp.mean((outputs - batch["y"]) ** 2), {}
+
+    opt = optax.sgd(0.05)
+    init_fn, step_fn, _ = lower_pipeline(
+        remat_stage, stacked, loss_head, opt, mesh, num_microbatches=2)
+    state = init_fn(stacked)
+    r = np.random.RandomState(8)
+    b = {"x": r.randn(8, HID).astype(np.float32),
+         "y": r.randn(8, HID).astype(np.float32)}
+    gb = jax.device_put(b, NamedSharding(mesh, P("data")))
+    state, metrics = step_fn(state, gb, jax.random.PRNGKey(0))
+
+    ref_params, ref_opt = stacked, opt.init(stacked)
+
+    def ref_loss(p, bb):
+        return jnp.mean((sequential_forward(p, bb["x"]) - bb["y"]) ** 2)
+
+    jb = jax.tree.map(jnp.asarray, b)
+    g = jax.grad(ref_loss)(ref_params, jb)
+    upd, ref_opt = opt.update(g, ref_opt, ref_params)
+    expect = optax.apply_updates(ref_params, upd)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-5),
+        jax.device_get(state["params"]), jax.device_get(expect))
