@@ -282,7 +282,15 @@ class Strategy:
             return cls.from_json(f.read())
 
     def __str__(self):
-        lines = [f"Strategy(id={self.id}, replicas={self.graph_config.replicas})"]
+        gc = self.graph_config
+        head = f"Strategy(id={self.id}, replicas={gc.replicas}"
+        if gc.lowering != "collective":
+            head += f", lowering={gc.lowering}"
+        if gc.parallel:
+            head += f", parallel={gc.parallel}"
+        if gc.accum_steps > 1:
+            head += f", accum_steps={gc.accum_steps}"
+        lines = [head + ")"]
         for n in self.node_configs:
             part = "-"
             if n.partitioner:
