@@ -181,6 +181,13 @@ class DistributedRunner:
     def get_extra(self):
         return jax.device_get(self.state["extra"])
 
+    def close(self):
+        """Release device state references (AutoStrategy's measurement
+        loop closes loser runners so their HBM frees before the next
+        candidate compiles; safe to call more than once)."""
+        self.state = None
+        self.lowered = None
+
 
 # --------------------------------------------------------------------------- #
 # Asynchronous PS (PS(sync=False))
