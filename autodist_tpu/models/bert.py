@@ -78,11 +78,19 @@ class BertModel(nn.Module):
 
 
 def mlm_loss_head(logits, batch):
-    """Masked-LM cross entropy over the static masked positions."""
+    """Masked-LM cross entropy over the static masked positions.
+
+    ``ll = logit[target] - logsumexp(logits)`` instead of a full
+    ``log_softmax``: mathematically identical, but skips materializing a
+    second [B, P, V] tensor (one full HBM write+read of the logits'
+    size per step)."""
     labels = batch["masked_ids"]       # [B, P]
     weights = batch["masked_weights"]  # [B, P] 0 for padding predictions
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)           # [B, P]
+    target = jnp.take_along_axis(logits, labels[..., None],
+                                 axis=-1)[..., 0]
+    ll = target - lse
     denom = jnp.maximum(weights.sum(), 1.0)
     loss = -(ll * weights).sum() / denom
     acc = ((logits.argmax(-1) == labels) * weights).sum() / denom

@@ -210,11 +210,14 @@ def _bench(dog):
     if on_accel:
         attn_impls["flash"] = make_attention_fn(causal=False)
     if on_accel:
-        # 3 configs = 3 compiles: einsum at both batches, flash only at
-        # the big one (flash at the base batch already measured slower
-        # than einsum on v5e, BASELINE.md round-3 table).
+        # 4 configs = 4 compiles: einsum at three batch sizes (batch 64
+        # probes whether HBM still has room — an OOM just loses its
+        # probe), flash only at batch 32 (flash at the base batch
+        # already measured slower than einsum on v5e, BASELINE.md
+        # round-3 table).
         candidates = [("einsum", batch_per_chip),
                       ("einsum", 2 * batch_per_chip),
+                      ("einsum", 4 * batch_per_chip),
                       ("flash", 2 * batch_per_chip)]
     else:
         candidates = [("einsum", batch_per_chip)]
