@@ -113,12 +113,15 @@ def lower_expert_ir(trainable, strategy, mesh):
     from autodist_tpu.parallel._spmd import build_replicated_spmd
 
     expert_axis = const.EXPERT_AXIS
-    data_axis = const.DATA_AXIS
     if expert_axis not in mesh.shape:
         raise ValueError(
             f"mesh {dict(mesh.shape)} has no {expert_axis!r} axis")
-    has_data = data_axis in mesh.shape
-    batch_axes = (data_axis, expert_axis) if has_data else (expert_axis,)
+    # Replica axes include dcn on multi-slice meshes (data-only sync
+    # would skip cross-slice gradient exchange).
+    d_axes = tuple(a for a in (const.DCN_AXIS, const.DATA_AXIS)
+                   if a in mesh.shape)
+    has_data = bool(d_axes)
+    batch_axes = (*d_axes, expert_axis)
     E_shards = mesh.shape[expert_axis]
 
     expert_vars = set()
@@ -155,7 +158,7 @@ def lower_expert_ir(trainable, strategy, mesh):
             # expert tables train at an E_shards-scaled learning rate;
             # adam's scale invariance masked it.)
             g = g / E_shards
-            return lax.pmean(g, data_axis) if has_data else g
+            return lax.pmean(g, d_axes) if has_data else g
         return lax.pmean(g, batch_axes)
 
     batch_spec = P(common.axes_entry(batch_axes))

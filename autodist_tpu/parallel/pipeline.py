@@ -282,7 +282,12 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     n = mesh.shape[pipe_axis]
     V = virtual_stages
     C = n * V
-    has_data = data_axis in mesh.shape
+    # Replica axes include dcn on multi-slice meshes (data-only sync
+    # would skip cross-slice gradient exchange).
+    d_axes = tuple(a for a in (const.DCN_AXIS, data_axis)
+                   if a in mesh.shape)
+    has_data = bool(d_axes)
+    d_entry = common.axes_entry(d_axes) if has_data else None
     has_shared = shared_params is not None
     for leaf in jax.tree.leaves(stacked_params):
         if leaf.shape[0] != C:
@@ -393,7 +398,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         if stage_aux:
             out["loss"] = out["loss"] + out["aux_loss"]
         if has_data:
-            out = jax.tree.map(lambda m: lax.pmean(m, data_axis), out)
+            out = jax.tree.map(lambda m: lax.pmean(m, d_axes), out)
         return out
 
     def _local_step(state, batch, rng):
@@ -422,7 +427,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                          lambda g: lax.psum(g, pipe_axis),
                          grads["shared"])}
         if has_data:
-            grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
+            grads = jax.tree.map(lambda g: lax.pmean(g, d_axes), grads)
 
         updates, new_opt = optimizer.update(grads, state["opt_state"],
                                             vparams)
@@ -431,7 +436,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                  "opt_state": new_opt, "extra": None, "sync_state": {}},
                 metrics)
 
-    batch_spec = P(data_axis) if has_data else P()
+    batch_spec = P(d_entry) if has_data else P()
 
     def _step(state, batch, rng):
         return jax.shard_map(

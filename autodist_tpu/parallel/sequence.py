@@ -60,15 +60,21 @@ def _build_sequence(trainable, mesh, *, seq_leaves: Sequence[str],
 
     if seq_axis not in mesh.shape:
         raise ValueError(f"mesh {dict(mesh.shape)} has no {seq_axis!r} axis")
-    has_data = data_axis in mesh.shape
-    sync_axes = (data_axis, seq_axis) if has_data else (seq_axis,)
+    # Replica axes include the cross-slice dcn axis on multi-slice
+    # meshes — syncing over data alone would silently skip cross-slice
+    # gradient exchange.
+    d_axes = tuple(a for a in (const.DCN_AXIS, data_axis)
+                   if a in mesh.shape)
+    has_data = bool(d_axes)
+    d_entry = common.axes_entry(d_axes) if has_data else None
+    sync_axes = (*d_axes, seq_axis)
 
     def batch_spec_for(name, leaf):
         if jnp.ndim(leaf) == 0:
             return P()
         if name.split("/")[-1] in seq_leaves:
-            return P(data_axis, seq_axis) if has_data else P(None, seq_axis)
-        return P(data_axis) if has_data else P()
+            return P(d_entry, seq_axis)
+        return P(d_entry) if has_data else P()
 
     def batch_spec_fn(batch):
         matched = [name for name, _ in common.flatten_with_names(batch)
@@ -83,7 +89,7 @@ def _build_sequence(trainable, mesh, *, seq_leaves: Sequence[str],
         return common.tree_from_names(
             batch, lambda name, leaf: batch_spec_for(name, leaf))
 
-    base_spec = P((data_axis, seq_axis) if has_data else (seq_axis,))
+    base_spec = P((*d_axes, seq_axis) if has_data else (seq_axis,))
     return build_replicated_spmd(
         trainable, mesh, sync_axes=sync_axes,
         batch_spec_fn=batch_spec_fn, batch_spec=base_spec, accum=accum)
