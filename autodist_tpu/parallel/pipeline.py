@@ -22,8 +22,9 @@ Two schedules, one implementation:
   digits) and keeps the one-hop property ``start(m, c+1) = start(m, c)+1``
   — so the same single-carry ppermute ring serves both schedules.  Total
   ticks drop from ``V·(M + n - 1)`` chunk-times (GPipe with V-chunk
-  stages) to ``M·V + n·V - ...`` — precisely ``num_ticks`` below — and
-  the bubble shrinks ~``V``-fold: ``(n-1)/(M·V + n - 1)``.
+  fused stages) to ``M·V + n - 1`` for ``n | M`` (exactly
+  ``num_ticks`` below in general), shrinking the bubble ~``V``-fold:
+  ``(n-1)/(M·V + n - 1)``.
 
 Activations are pytrees; stages may emit auxiliary scalar losses
 (``stage_aux=True``) which accumulate across every chunk — the

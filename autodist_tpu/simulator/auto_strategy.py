@@ -40,6 +40,9 @@ def default_candidates() -> list[StrategyBuilder]:
         # and the candidate is skipped.
         parallel_builders.SequenceParallel(),
         parallel_builders.Pipeline(num_microbatches=4),
+        # Interleaved variant matches trainables with 2 chunks per pipe
+        # device (num_stages == 2 x pipe axis); mismatches are skipped.
+        parallel_builders.Pipeline(num_microbatches=4, virtual_stages=2),
         parallel_builders.ExpertParallel(),
     ]
 
