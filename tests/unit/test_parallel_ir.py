@@ -622,3 +622,36 @@ def test_pipelined_lm_grad_accum_matches_big_batch():
         lambda a, e: np.testing.assert_allclose(
             np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-4),
         runner.get_params(), jax.device_get(expect))
+
+
+def test_sequence_parallel_grad_accum_matches_big_batch():
+    """GradAccumulation(SequenceParallel): two accumulated slices equal
+    one big batch (the sequence lowering honors accum_steps)."""
+    import optax
+
+    from autodist_tpu.strategy.builders import GradAccumulation
+    from autodist_tpu.strategy.parallel_builders import SequenceParallel
+
+    ad = AutoDist(SEQ_SPEC,
+                  GradAccumulation(SequenceParallel(), steps=2))
+    trainable = make_lm_trainable(sharded=True)
+    runner = ad.build(trainable)
+    b = lm_batches(1)[0]
+    runner.step(b, rng=jax.random.PRNGKey(0))
+
+    ref = make_lm_trainable(sharded=False)
+    params = ref.params
+    opt_state = ref.optimizer.init(params)
+
+    def loss_for(p):
+        l, _, _ = ref.loss(p, None, jax.tree.map(jnp.asarray, b),
+                           jax.random.PRNGKey(0))
+        return l
+
+    g = jax.grad(loss_for)(params)
+    upd, opt_state = ref.optimizer.update(g, opt_state, params)
+    expect = optax.apply_updates(params, upd)
+    jax.tree.map(
+        lambda a, e: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(e), rtol=2e-5, atol=2e-5),
+        runner.get_params(), jax.device_get(expect))
