@@ -58,17 +58,38 @@ def lower_gspmd(trainable: Trainable, strategy: Strategy, mesh) -> GspmdLowered:
     opt = trainable.optimizer
     nodes = {n.var_name: n for n in strategy.node_configs}
 
-    # The gspmd path delegates all communication to XLA: per-variable
-    # synchronizer knobs (compressors, PS semantics) have no effect here.
+    # The gspmd path delegates communication to XLA.  PS(sync=True) node
+    # configs ARE honored — as GSPMD-style ZeRO-1: the variable's
+    # optimizer state shards its leading dim over the data axes (XLA
+    # derives the reduce-scatter into the update and the all-gather out
+    # of it).  Compressors have no GSPMD realization (custom wire
+    # arithmetic needs explicit collectives): warn, don't silently
+    # reprice — the cost model skips compressor factors for gspmd
+    # strategies (`simulator/cost_model.py`).
+    from autodist_tpu.strategy.ir import PSSynchronizer
+
+    for n in strategy.node_configs:
+        if isinstance(n.synchronizer, PSSynchronizer):
+            if not n.synchronizer.sync:
+                raise NotImplementedError(
+                    f"PS(sync=False) on {n.var_name}: asynchronous "
+                    "training does not lower to one SPMD program; build "
+                    "through AutoDist (AsyncPSRunner) or use sync=True")
+            if n.synchronizer.staleness > 0:
+                raise NotImplementedError(
+                    f"PS(staleness>0) on {n.var_name}: SSP gating is "
+                    "implemented for the collective lowering only")
+    ps_vars = {n.var_name for n in strategy.node_configs
+               if isinstance(n.synchronizer, PSSynchronizer)}
     ignored = sorted({
         n.var_name for n in strategy.node_configs
-        if getattr(n.synchronizer, "compressor", "none") not in ("", "none")
-        or getattr(n.synchronizer, "kind", "allreduce") == "ps"})
+        if getattr(n.synchronizer, "compressor", "none")
+        not in ("", "none")})
     if ignored:
         logging.warning(
-            "gspmd lowering ignores synchronizer config (compressor/PS) on "
-            "%d variable(s), e.g. %s — use the collective lowering for "
-            "those features", len(ignored), ignored[0])
+            "gspmd lowering ignores compressor config on %d variable(s), "
+            "e.g. %s — use the collective lowering for compressed "
+            "gradients", len(ignored), ignored[0])
 
     def axis_size(axis) -> int:
         axes = axis if isinstance(axis, tuple) else (axis,)
@@ -110,6 +131,11 @@ def lower_gspmd(trainable: Trainable, strategy: Strategy, mesh) -> GspmdLowered:
                 tuple(np.shape(l)), jnp.result_type(l)),
             trainable.params))
 
+    from autodist_tpu.kernel.lowering import replica_axes
+    repl = replica_axes(mesh)
+    repl_entry = common.axes_entry(repl)
+    n_repl = int(np.prod([mesh.shape[a] for a in repl]))
+
     def opt_spec_for(path, leaf):
         from autodist_tpu.kernel import common
         name = path_to_name(path)
@@ -117,7 +143,35 @@ def lower_gspmd(trainable: Trainable, strategy: Strategy, mesh) -> GspmdLowered:
             name, by_name,
             shape_ok=lambda v: tuple(leaf.shape)
             == tuple(shapes_by_name[v]))
-        return by_name[var] if var else P()
+        if var is None:
+            return P()
+        spec = by_name[var]
+        if var in ps_vars and leaf.ndim > 0:
+            # GSPMD ZeRO-1: additionally shard the state over the data
+            # axes — extending dim 0 (joining a model axis already there
+            # when divisible), else the first free divisible dim.
+            entries = list(spec) + [None] * (leaf.ndim - len(list(spec)))
+            e0 = entries[0]
+            axes0 = tuple(e0) if isinstance(e0, tuple) else (
+                (e0,) if e0 else ())
+            if any(a in repl for a in axes0):
+                # dim 0 already shards over a data axis (FSDP-style
+                # rule): the inherited spec IS the ZeRO layout.
+                return P(*entries)
+            shard0 = int(np.prod([mesh.shape[a] for a in axes0])) \
+                if axes0 else 1
+            if leaf.shape[0] % (shard0 * n_repl) == 0:
+                entries[0] = (*axes0, *repl) if axes0 else repl_entry
+                return P(*entries)
+            for d in range(1, leaf.ndim):
+                if entries[d] is None and leaf.shape[d] % n_repl == 0:
+                    entries[d] = repl_entry
+                    return P(*entries)
+            logging.warning(
+                "%s: PS (ZeRO-1) requested but no dim of %s (spec %s) "
+                "can shard over the %d-way data axes; state stays %s",
+                var, tuple(leaf.shape), spec, n_repl, spec)
+        return spec
 
     o_specs = jax.tree_util.tree_map_with_path(opt_spec_for, opt_shapes)
     extra_specs = jax.tree.map(lambda _: P(), trainable.extra)

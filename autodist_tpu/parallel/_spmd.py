@@ -7,19 +7,161 @@ accumulation, metric reduction, the defensive float-extra averaging, the
 shard_map plumbing — is identical, and identical to the collective
 path's semantics.  One builder, three injection points, so a fix to any
 of the shared rules lands everywhere at once.
+
+Per-variable synchronizer configs (the reference's defining trick —
+heterogeneous per-variable sync, ``parallax_strategy.py:24-71``) are
+honored here through :class:`VarPolicy`:
+
+* ``PSSynchronizer(sync=True)`` on a replicated variable becomes ZeRO-1:
+  the gradient is reduce-scattered flat over the variable's replica axes,
+  the optimizer update runs on the local 1/n flat shard (optimizer state
+  lives *only* sharded), and the updated values are all-gathered —
+  parameters stay stored full, exactly the collective lowering's U_FLAT
+  scheme (``kernel/lowering.py``), now composable with sequence/expert
+  parallelism.
+* ``AllReduceSynchronizer(compressor=C)`` runs the compressed allreduce
+  of :mod:`autodist_tpu.kernel.compressor` on that variable's flat
+  gradient; error-feedback state persists in ``state["sync_state"]``
+  sharded one row per device (residuals are inherently per-device).
 """
 from __future__ import annotations
 
+import dataclasses
+import math
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from autodist_tpu.kernel import common
+from autodist_tpu.kernel.compressor import Compressor
 from autodist_tpu.kernel.lowering import SimpleLowered, _reduce_metrics
+
+
+@dataclasses.dataclass(frozen=True)
+class VarPolicy:
+    """Per-variable synchronization choice for the replicated-SPMD
+    builder (resolved from a Strategy's node configs).
+
+    ``zero_axes``: non-empty = ZeRO-1 — shard this variable's optimizer
+    state flat over these mesh axes (grad reduce-scatter + update
+    all-gather).  ``compressor``: run the named compressed allreduce
+    instead of a plain pmean.  ``sync_axes``: the axes a plain/compressed
+    sync averages over (defaults to the builder's ``sync_axes``).
+    ``scale``: applied after the mean — the expert lowering's 1/E factor
+    for expert-sharded variables.
+    """
+
+    zero_axes: tuple = ()
+    compressor: str = "none"
+    sync_axes: Optional[tuple] = None
+    scale: float = 1.0
+
+
+def policies_from_node_configs(strategy, mesh, *, replicated_axes,
+                               axes_for: Optional[Callable] = None,
+                               scale_for: Optional[Callable] = None,
+                               sharded_vars=()) -> dict[str, VarPolicy]:
+    """Resolve a Strategy's per-variable synchronizer configs into
+    :class:`VarPolicy` entries for :func:`build_replicated_spmd`.
+
+    ``replicated_axes``: the axes a fully-replicated variable syncs over.
+    ``axes_for(name)`` / ``scale_for(name)``: per-variable overrides (the
+    expert lowering syncs expert-sharded variables over the data axes
+    only, scaled 1/E).  ``sharded_vars``: variables whose *parameters*
+    are stored sharded by this lowering — ZeRO-1 requests on them fall
+    back to plain sync with a warning (their optimizer state already
+    shards with the parameter; the flat re-shard is not implemented).
+    """
+    from autodist_tpu.strategy.ir import AllReduceSynchronizer, PSSynchronizer
+    from autodist_tpu.utils import logging
+
+    sharded_vars = set(sharded_vars)
+    policies: dict[str, VarPolicy] = {}
+    for nc in strategy.node_configs:
+        name, sync = nc.var_name, nc.synchronizer
+        axes = tuple(axes_for(name)) if axes_for else tuple(replicated_axes)
+        scale = float(scale_for(name)) if scale_for else 1.0
+        if isinstance(sync, PSSynchronizer):
+            if not sync.sync:
+                raise NotImplementedError(
+                    f"PS(sync=False) on {name}: asynchronous training does "
+                    "not lower to a synchronous SPMD program; build through "
+                    "AutoDist (which dispatches to AsyncPSRunner) or use "
+                    "sync=True")
+            if sync.staleness > 0:
+                raise NotImplementedError(
+                    f"PS(staleness>0) on {name}: SSP gating is implemented "
+                    "for the collective lowering only")
+            if name in sharded_vars:
+                logging.warning(
+                    "%s: parameter is stored sharded by this lowering; its "
+                    "optimizer state shards with it — the ZeRO-1 (PS) "
+                    "request degrades to plain sync", name)
+                if scale != 1.0 or axes != tuple(replicated_axes):
+                    policies[name] = VarPolicy(sync_axes=axes, scale=scale)
+                continue
+            n = math.prod(mesh.shape[a] for a in axes)
+            if n > 1:
+                policies[name] = VarPolicy(zero_axes=axes, sync_axes=axes,
+                                           scale=scale)
+        elif isinstance(sync, AllReduceSynchronizer):
+            comp = sync.compressor or "none"
+            if comp != "none":
+                Compressor.create(comp)  # validate the name at build time
+                policies[name] = VarPolicy(compressor=comp, sync_axes=axes,
+                                           scale=scale)
+    return policies
+
+
+# --------------------------------------------------------------------------- #
+# Shared compressor-state plumbing (used by this builder AND the pipeline
+# lowering — one copy of the subtle EF bookkeeping).
+# --------------------------------------------------------------------------- #
+def init_sync_rows(policies: dict, local_size_fn: Callable) -> dict:
+    """Per-variable EF/compressor state rows (host numpy), sized from the
+    variable's *local* (per-device) gradient length."""
+    rows = {}
+    for name, pol in policies.items():
+        if pol.compressor != "none":
+            comp = Compressor.create(pol.compressor)
+            if comp.stateful:
+                rows[name] = np.asarray(
+                    comp.init_state_flat(local_size_fn(name)), np.float32)
+    return rows
+
+
+def sync_state_layout(mesh, sync_rows: dict):
+    """(specs, n_total): one state row per device — residuals are
+    inherently per-device — sharded over every mesh axis."""
+    all_axes = tuple(mesh.axis_names)
+    n_total = math.prod(mesh.shape[a] for a in all_axes)
+    specs = {k: P(common.axes_entry(all_axes)) for k in sync_rows}
+    return specs, n_total
+
+
+def tile_sync_rows(sync_rows: dict, n_total: int) -> dict:
+    """Initial sync_state value (inside plain jit): every device starts
+    from the same row."""
+    return {k: jnp.tile(jnp.asarray(row)[None], (n_total, 1))
+            for k, row in sync_rows.items()}
+
+
+def apply_compressed(name, g, comp_name: str, axes_entry, sync_state,
+                     new_sync: dict):
+    """Run one variable's compressed allreduce inside shard_map,
+    recording new stateful-compressor rows into ``new_sync``."""
+    comp = Compressor.create(comp_name)
+    flat = g.reshape(-1).astype(jnp.float32)
+    st = sync_state[name][0] if comp.stateful else None
+    red, st = comp.allreduce(flat, st, axes_entry)
+    if comp.stateful:
+        new_sync[name] = st[None]
+    return red.reshape(g.shape).astype(g.dtype)
 
 
 def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
@@ -27,7 +169,8 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
                           batch_spec,
                           param_spec_fn: Optional[Callable] = None,
                           grad_sync: Optional[Callable] = None,
-                          accum: int = 1) -> SimpleLowered:
+                          accum: int = 1,
+                          policies: Optional[dict] = None) -> SimpleLowered:
     """Compile a train/eval step for a (mostly) replicated-parameter
     strategy.
 
@@ -40,10 +183,14 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
         storage (default: replicate everything).  Optimizer-state leaves
         inherit their variable's spec by path-suffix matching.
       grad_sync: ``(name, grad) -> grad`` cross-device synchronization
-        (default: ``pmean`` over ``sync_axes``).
+        for variables without a policy (default: ``pmean`` over
+        ``sync_axes``).
       accum: gradient-accumulation microbatch count.
+      policies: per-variable :class:`VarPolicy` map (ZeRO-1 /
+        compressors) — see :func:`policies_from_node_configs`.
     """
     opt = trainable.optimizer
+    policies = policies or {}
     if param_spec_fn is None:
         param_spec_fn = lambda name, leaf: P()  # noqa: E731
     if grad_sync is None:
@@ -52,36 +199,87 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
     p_specs = common.tree_from_names(trainable.params, param_spec_fn)
     spec_by_name = dict(common.flatten_with_names(p_specs))
     shapes_by_name = {v.name: v.shape for v in trainable.var_infos()}
+    sizes_by_name = {v.name: max(v.size, 1) for v in trainable.var_infos()}
 
-    import numpy as np
+    # --- ZeRO-1 bookkeeping ------------------------------------------------ #
+    def zero_n(name) -> int:
+        pol = policies.get(name)
+        if pol is None or not pol.zero_axes:
+            return 1
+        return math.prod(mesh.shape[a] for a in pol.zero_axes)
+
+    def u_shape(name) -> tuple:
+        """Global update-space shape: padded flat for ZeRO vars, the
+        parameter shape otherwise."""
+        n = zero_n(name)
+        if n > 1:
+            return (common.padded_flat_size(sizes_by_name[name], n),)
+        return tuple(shapes_by_name[name])
+
+    for name, pol in policies.items():
+        if pol.zero_axes and spec_by_name.get(name, P()) != P():
+            raise ValueError(
+                f"{name}: ZeRO-1 requires a replicated parameter; it is "
+                f"stored {spec_by_name[name]}")
+
+    def u_view(name, p):
+        """Global update-space view (runs in plain jit, not shard_map)."""
+        n = zero_n(name)
+        if n > 1:
+            flat = jnp.asarray(p).reshape(-1)
+            return common.pad_axis_to(flat, 0, u_shape(name)[0])
+        return p
+
+    def u_spec(name):
+        n = zero_n(name)
+        if n > 1:
+            return P(common.axes_entry(policies[name].zero_axes))
+        return spec_by_name.get(name, P())
 
     opt_shapes = jax.eval_shape(
         opt.init,
-        jax.tree.map(lambda l: jax.ShapeDtypeStruct(
-            tuple(np.shape(l)), jnp.result_type(l)), trainable.params))
+        common.tree_from_names(
+            jax.tree.map(lambda l: jax.ShapeDtypeStruct(
+                tuple(np.shape(l)), jnp.result_type(l)), trainable.params),
+            lambda name, l: jax.ShapeDtypeStruct(u_shape(name), l.dtype)))
 
     def opt_spec_for(path, leaf):
         from autodist_tpu.capture import path_to_name
         name = path_to_name(path)
         var = common.match_var_by_suffix(
             name, spec_by_name,
-            shape_ok=lambda v: tuple(leaf.shape)
-            == tuple(shapes_by_name[v]))
-        return spec_by_name[var] if var else P()
+            shape_ok=lambda v: tuple(leaf.shape) == u_shape(v))
+        return u_spec(var) if var else P()
 
     o_specs = jax.tree_util.tree_map_with_path(opt_spec_for, opt_shapes)
+
+    # --- compressor state: one row per device (residuals are per-device) --- #
+    def local_size(name) -> int:
+        """Per-device gradient size: the global size divided by the shard
+        count of every partitioned dimension (compressors run on the
+        local shard inside shard_map)."""
+        size, spec = sizes_by_name[name], spec_by_name.get(name, P())
+        for entry in spec:
+            size //= max(common.spec_shard_count(entry, mesh), 1)
+        return max(size, 1)
+
+    sync_rows = init_sync_rows(policies, local_size)
+    sync_specs, n_total = sync_state_layout(mesh, sync_rows)
+
     extra_specs = jax.tree.map(lambda _: P(), trainable.extra)
     state_specs = {"step": P(), "params": p_specs, "opt_state": o_specs,
-                   "extra": extra_specs, "sync_state": {}}
+                   "extra": extra_specs, "sync_state": sync_specs}
     state_shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), state_specs,
         is_leaf=lambda x: isinstance(x, P))
 
     def _init(params, extra):
+        params = jax.tree.map(jnp.asarray, params)
         return {"step": jnp.zeros((), jnp.int32),
-                "params": jax.tree.map(jnp.asarray, params),
-                "opt_state": opt.init(jax.tree.map(jnp.asarray, params)),
-                "extra": extra, "sync_state": {}}
+                "params": params,
+                "opt_state": opt.init(common.tree_from_names(params, u_view)),
+                "extra": extra,
+                "sync_state": tile_sync_rows(sync_rows, n_total)}
 
     init_fn = jax.jit(_init, out_shardings=state_shardings)
 
@@ -105,7 +303,43 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
                 micro_grads, state["params"], batch, local_rng,
                 state["extra"], accum)
 
-        grads = common.tree_from_names(grads, grad_sync)
+        new_sync: dict = {}
+
+        def sync_one(name, g):
+            pol = policies.get(name)
+            if pol is None:
+                return grad_sync(name, g)
+            # None = inherit the builder default; an explicitly-empty
+            # tuple means "no sync axes" (e.g. expert vars on a data-less
+            # mesh) and must not fall back to the full sync set.
+            axes = sync_axes if pol.sync_axes is None else pol.sync_axes
+            if pol.zero_axes:
+                rs = common.reduce_scatter_flat(
+                    g, common.axes_entry(pol.zero_axes),
+                    zero_n(name), mean=True)
+                return rs if pol.scale == 1.0 else rs * pol.scale
+            if not axes:
+                # Variable replicated over no axes (e.g. expert-sharded on
+                # a data-less mesh): nothing to synchronize.
+                return g if pol.scale == 1.0 else g * pol.scale
+            if pol.compressor != "none":
+                red = apply_compressed(name, g, pol.compressor,
+                                       common.axes_entry(axes),
+                                       state["sync_state"], new_sync)
+                return red if pol.scale == 1.0 else red * pol.scale
+            g = lax.pmean(g, common.axes_entry(axes))
+            return g if pol.scale == 1.0 else g * pol.scale
+
+        u_grads = common.tree_from_names(grads, sync_one)
+
+        def u_param(name, p):
+            if zero_n(name) > 1:
+                return common.local_flat_shard(
+                    p, common.axes_entry(policies[name].zero_axes),
+                    zero_n(name))
+            return p
+
+        u_params = common.tree_from_names(state["params"], u_param)
         metrics = _reduce_metrics(dict(metrics), sync_axes)
         # extra (e.g. batch stats) must be SPMD-invariant: average float
         # leaves defensively (same guard as the collective lowering).
@@ -113,12 +347,22 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
             lambda x: lax.pmean(x, sync_axes)
             if jnp.issubdtype(jnp.result_type(x), jnp.inexact) else x,
             new_extra)
-        updates, new_opt = opt.update(grads, state["opt_state"],
-                                      state["params"])
-        new_params = optax.apply_updates(state["params"], updates)
+        updates, new_opt = opt.update(u_grads, state["opt_state"], u_params)
+        u_new = optax.apply_updates(u_params, updates)
+
+        def to_store(name, un):
+            if zero_n(name) > 1:
+                return common.all_gather_flat(
+                    un, common.axes_entry(policies[name].zero_axes),
+                    shapes_by_name[name])
+            return un
+
+        new_params = common.tree_from_names(u_new, to_store)
+        full_sync = dict(state["sync_state"])
+        full_sync.update(new_sync)
         return ({"step": state["step"] + 1, "params": new_params,
                  "opt_state": new_opt, "extra": new_extra,
-                 "sync_state": {}}, metrics)
+                 "sync_state": full_sync}, metrics)
 
     def _step(state, batch, rng):
         return jax.shard_map(

@@ -161,13 +161,27 @@ def lower_expert_ir(trainable, strategy, mesh):
             return lax.pmean(g, d_axes) if has_data else g
         return lax.pmean(g, batch_axes)
 
+    # Per-variable synchronizer configs (PS -> ZeRO-1, compressors):
+    # replicated variables sync over (data x expert) — both are batch
+    # axes for them; expert-sharded variables over data only, scaled
+    # 1/E_shards (same objective as sync_grad above).  ZeRO-1 on an
+    # expert-sharded variable degrades with a warning — its optimizer
+    # state is already E-way sharded with the parameter.
+    from autodist_tpu.parallel._spmd import policies_from_node_configs
+    policies = policies_from_node_configs(
+        strategy, mesh, replicated_axes=batch_axes,
+        axes_for=lambda n: d_axes if n in expert_vars else batch_axes,
+        scale_for=lambda n: 1.0 / E_shards if n in expert_vars else 1.0,
+        sharded_vars=expert_vars)
+
     batch_spec = P(common.axes_entry(batch_axes))
     return build_replicated_spmd(
         trainable, mesh, sync_axes=batch_axes,
         batch_spec_fn=lambda batch: common.batch_specs(batch, batch_spec),
         batch_spec=batch_spec, param_spec_fn=param_spec,
         grad_sync=sync_grad,
-        accum=max(strategy.graph_config.accum_steps, 1))
+        accum=max(strategy.graph_config.accum_steps, 1),
+        policies=policies)
 
 
 def dense_moe_reference(tokens, gate_w, expert_wi, expert_wo,

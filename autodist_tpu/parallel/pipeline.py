@@ -37,6 +37,7 @@ Per-device memory: O(V·chunk params + activations · ticks); use
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Any, Callable
 
 import jax
@@ -259,7 +260,8 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                     pipe_axis: str = const.PIPE_AXIS,
                     accum: int = 1, batch_key: str = "x",
                     virtual_stages: int = 1, stage_aux: bool = False,
-                    shared_params=None, prologue: Callable = None):
+                    shared_params=None, prologue: Callable = None,
+                    policies=None):
     """Shared construction for the direct API and the Strategy-IR entry;
     returns a Lowered-contract container.
 
@@ -279,16 +281,35 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     each accumulation slice runs the full microbatched schedule, so one
     optimizer step consumes ``accum x num_microbatches`` microbatches
     (the reconciliation of ``GraphConfig.accum_steps`` with pipeline
-    microbatching)."""
+    microbatching).
+
+    ``policies`` (per-variable :class:`~autodist_tpu.parallel._spmd.VarPolicy`,
+    resolved from the Strategy's node configs by :func:`lower_pipeline_ir`)
+    composes ZeRO-1 and gradient compression with the pipeline:
+
+    * a *stage* variable with ``zero_axes`` (the data axes) keeps its
+      pipe-sharded storage, but its optimizer state lives flat-sharded
+      over the data axes *within* each pipe shard — grads reduce-scatter
+      over data, the update runs on the local 1/n_d flat shard, updated
+      values all-gather back (opt-state spec ``P((pipe, data))``);
+    * a *shared* variable with ``zero_axes`` shards its optimizer state
+      over ``pipe x data`` jointly: one ``psum_scatter`` realizes the
+      sum-over-pipe (each device contributes a different role) and the
+      shard split, divided by the data-replica count for the mean;
+    * a ``compressor`` runs the compressed allreduce over the data axes
+      (stage grads differ across pipe; shared grads psum over pipe at
+      full precision first)."""
     n = mesh.shape[pipe_axis]
     V = virtual_stages
     C = n * V
+    policies = policies or {}
     # Replica axes include dcn on multi-slice meshes (data-only sync
     # would skip cross-slice gradient exchange).
     d_axes = tuple(a for a in (const.DCN_AXIS, data_axis)
                    if a in mesh.shape)
     has_data = bool(d_axes)
     d_entry = common.axes_entry(d_axes) if has_data else None
+    n_d = math.prod(mesh.shape[a] for a in d_axes) if d_axes else 1
     has_shared = shared_params is not None
     for leaf in jax.tree.leaves(stacked_params):
         if leaf.shape[0] != C:
@@ -309,12 +330,80 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     state_specs = {"step": P(), "params": p_specs, "opt_state": p_specs,
                    "extra": None, "sync_state": {}}
 
+    # --- per-variable policy bookkeeping (ZeRO-1 / compressors) ----------- #
+    def is_stage_var(name: str) -> bool:
+        return name.startswith("stages/") if has_shared else True
+
+    def zero_pol(name):
+        pol = policies.get(name)
+        return pol if (pol is not None and pol.zero_axes) else None
+
+    def zero_count(pol) -> int:
+        return math.prod(mesh.shape[a] for a in pol.zero_axes)
+
+    for name, pol in policies.items():
+        if pol.zero_axes and is_stage_var(name) \
+                and pipe_axis in pol.zero_axes:
+            raise ValueError(
+                f"{name}: a stage variable is already pipe-sharded; its "
+                f"ZeRO axes must not include {pipe_axis!r}")
+
+    leaves_by_name = dict(common.flatten_with_names(full_params))
+    # Per-device sizes: stage leaves hold this device's V chunks (1/n of
+    # the stack); shared leaves replicate in full.
+    local_sizes = {
+        name: (max(int(np.prod(np.shape(leaf))), 1) // n
+               if is_stage_var(name)
+               else max(int(np.prod(np.shape(leaf))), 1))
+        for name, leaf in leaves_by_name.items()}
+
+    def u_shape(name) -> tuple:
+        pol = zero_pol(name)
+        if pol is None:
+            return tuple(np.shape(leaves_by_name[name]))
+        padded = common.padded_flat_size(local_sizes[name], zero_count(pol))
+        return (n * padded,) if is_stage_var(name) else (padded,)
+
+    def u_spec(name):
+        pol = zero_pol(name)
+        if is_stage_var(name):
+            return P((pipe_axis, *pol.zero_axes))
+        return P(common.axes_entry(pol.zero_axes))
+
+    def u_view(name, leaf):
+        """Global update-space view (runs in plain jit on the *stored*,
+        i.e. interleave-permuted, layout): ZeRO leaves flatten pipe-major
+        so the jit sharding matches what ``local_flat_shard`` /
+        ``reduce_scatter_flat`` produce inside shard_map."""
+        pol = zero_pol(name)
+        if pol is None:
+            return leaf
+        nz = zero_count(pol)
+        if is_stage_var(name):
+            flat = jnp.reshape(leaf, (n, local_sizes[name]))
+            flat = common.pad_axis_to(
+                flat, 1, common.padded_flat_size(local_sizes[name], nz))
+            return flat.reshape(-1)
+        flat = jnp.reshape(leaf, (-1,))
+        return common.pad_axis_to(
+            flat, 0, common.padded_flat_size(flat.size, nz))
+
     def opt_specs_tree(opt_state_shapes):
-        # 'leading dim == C means stacked' holds only for the stages
-        # subtree (every stage leaf is validated to carry it); a shared
-        # leaf whose leading dim coincidentally equals C (a size-C ln
-        # scale, say) must stay replicated.
+        # ZeRO leaves resolve by path-suffix + u-shape match; otherwise
+        # 'leading dim == C means stacked' — which holds only for the
+        # stages subtree (every stage leaf is validated to carry it); a
+        # shared leaf whose leading dim coincidentally equals C (a
+        # size-C ln scale, say) must stay replicated.
+        u_by_name = {k: u_shape(k) for k in leaves_by_name}
+
         def spec_for(path, leaf):
+            from autodist_tpu.capture import path_to_name
+            name = path_to_name(path)
+            var = common.match_var_by_suffix(
+                name, u_by_name,
+                shape_ok=lambda v: tuple(leaf.shape) == u_by_name[v])
+            if var is not None and zero_pol(var) is not None:
+                return u_spec(var)
             in_shared = has_shared and any(
                 isinstance(k, jax.tree_util.DictKey) and k.key == "shared"
                 for k in path)
@@ -324,9 +413,28 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                 and leaf.shape and leaf.shape[0] == C else P()
         return jax.tree_util.tree_map_with_path(spec_for, opt_state_shapes)
 
-    opt_shapes = jax.eval_shape(optimizer.init, full_params)
+    opt_shapes = jax.eval_shape(
+        optimizer.init,
+        common.tree_from_names(
+            full_params,
+            lambda nm, l: jax.ShapeDtypeStruct(u_shape(nm),
+                                               jnp.result_type(l))))
     o_specs = opt_specs_tree(opt_shapes)
     state_specs["opt_state"] = o_specs
+
+    # Compressor EF state: one row per device (residuals are per-device;
+    # stage grads genuinely differ across pipe shards).  Shared plumbing
+    # with the replicated-SPMD builder (_spmd.py) so the subtle EF
+    # bookkeeping has one implementation.
+    from autodist_tpu.parallel._spmd import (apply_compressed,
+                                             init_sync_rows,
+                                             sync_state_layout,
+                                             tile_sync_rows)
+
+    comp_policies = {k: p for k, p in policies.items() if has_data}
+    sync_rows = init_sync_rows(comp_policies, lambda nm: local_sizes[nm])
+    state_specs["sync_state"], n_total = sync_state_layout(mesh, sync_rows)
+
     state_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
                                    state_specs,
                                    is_leaf=lambda x: isinstance(x, P))
@@ -342,8 +450,10 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         stored = _permute(params)
         return {"step": jnp.zeros((), jnp.int32),
                 "params": stored,
-                "opt_state": optimizer.init(stored),
-                "extra": None, "sync_state": {}}
+                "opt_state": optimizer.init(
+                    common.tree_from_names(stored, u_view)),
+                "extra": None,
+                "sync_state": tile_sync_rows(sync_rows, n_total)}
 
     init_fn = jax.jit(_init, out_shardings=state_shardings)
 
@@ -419,23 +529,72 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                 micro_grads, vparams, batch, rng, None, accum)
 
         metrics = _broadcast_metrics(metrics)
-        if has_shared:
-            # Each device holds a different piece of the shared grads
+        new_sync: dict = {}
+
+        def compressed(name, g, comp_name):
+            return apply_compressed(name, g, comp_name, d_entry,
+                                    state["sync_state"], new_sync)
+
+        def sync_one(name, g):
+            pol = policies.get(name)
+            if is_stage_var(name):
+                # Stage grads: each pipe shard owns its chunks; replicas
+                # differ along the data axes only.
+                if pol is not None and pol.zero_axes:
+                    return common.reduce_scatter_flat(
+                        g, common.axes_entry(pol.zero_axes),
+                        zero_count(pol), mean=True)
+                if pol is not None and pol.compressor != "none" \
+                        and has_data:
+                    return compressed(name, g, pol.compressor)
+                return lax.pmean(g, d_axes) if has_data else g
+            # Shared grads: each device holds a different piece
             # (injection on device 0, the head on device n-1, zeros in
             # between): sum, don't average, over the pipe axis.
-            grads = {"stages": grads["stages"],
-                     "shared": jax.tree.map(
-                         lambda g: lax.psum(g, pipe_axis),
-                         grads["shared"])}
-        if has_data:
-            grads = jax.tree.map(lambda g: lax.pmean(g, d_axes), grads)
+            if pol is not None and pol.zero_axes:
+                # One psum_scatter over (pipe x data) realizes the
+                # pipe-sum and the ZeRO shard split; /n_d restores the
+                # data mean.
+                rs = common.reduce_scatter_flat(
+                    g, common.axes_entry(pol.zero_axes),
+                    zero_count(pol), mean=False)
+                return rs / n_d
+            gp = lax.psum(g, pipe_axis)
+            if pol is not None and pol.compressor != "none" and has_data:
+                return compressed(name, gp, pol.compressor)
+            return lax.pmean(gp, d_axes) if has_data else gp
 
-        updates, new_opt = optimizer.update(grads, state["opt_state"],
-                                            vparams)
-        new_params = optax.apply_updates(vparams, updates)
+        u_grads = common.tree_from_names(grads, sync_one)
+
+        def u_param(name, p):
+            pol = zero_pol(name)
+            if pol is None:
+                return p
+            return common.local_flat_shard(
+                p, common.axes_entry(pol.zero_axes), zero_count(pol))
+
+        u_params = common.tree_from_names(vparams, u_param)
+        updates, new_opt = optimizer.update(u_grads, state["opt_state"],
+                                            u_params)
+        u_new = optax.apply_updates(u_params, updates)
+
+        from autodist_tpu.capture import path_to_name
+
+        def to_store(path, un, p_local):
+            name = path_to_name(path)
+            pol = zero_pol(name)
+            if pol is None:
+                return un
+            return common.all_gather_flat(
+                un, common.axes_entry(pol.zero_axes), p_local.shape)
+
+        new_params = jax.tree_util.tree_map_with_path(
+            to_store, u_new, vparams)
+        full_sync = dict(state["sync_state"])
+        full_sync.update(new_sync)
         return ({"step": state["step"] + 1, "params": new_params,
-                 "opt_state": new_opt, "extra": None, "sync_state": {}},
-                metrics)
+                 "opt_state": new_opt, "extra": None,
+                 "sync_state": full_sync}, metrics)
 
     batch_spec = P(d_entry) if has_data else P()
 
@@ -509,6 +668,33 @@ def lower_pipeline_ir(trainable, strategy, mesh):
             f"axis has {S} devices x {V} virtual stages")
     stacked = (trainable.params["stages"] if trainable.has_shared
                else trainable.params)
+
+    # Per-variable synchronizer configs (PS -> ZeRO-1, compressors)
+    # compose with the pipeline: stage variables zero/compress over the
+    # data axes (they are pipe-sharded already), shared variables zero
+    # over pipe x data jointly.
+    from autodist_tpu.parallel._spmd import policies_from_node_configs
+    from autodist_tpu.utils import logging
+
+    d_axes = tuple(a for a in (const.DCN_AXIS, const.DATA_AXIS)
+                   if a in mesh.shape)
+    shared_axes = (const.PIPE_AXIS, *d_axes)
+
+    def axes_for(name):
+        if not trainable.has_shared or name.startswith("stages/"):
+            return d_axes
+        return shared_axes
+
+    policies = policies_from_node_configs(
+        strategy, mesh, replicated_axes=shared_axes, axes_for=axes_for)
+    if not d_axes:
+        dropped = sorted(nm for nm, p in policies.items()
+                         if p.compressor != "none")
+        if dropped:
+            logging.warning(
+                "pipe-only mesh: compressor configs on %d variable(s) "
+                "(e.g. %s) have no data axis to compress over; syncing "
+                "uncompressed", len(dropped), dropped[0])
     return _build_pipeline(
         trainable.stage_fn, stacked, trainable.loss_head,
         trainable.optimizer, mesh,
@@ -517,4 +703,5 @@ def lower_pipeline_ir(trainable, strategy, mesh):
         shared_params=(trainable.params["shared"] if trainable.has_shared
                        else None),
         prologue=trainable.prologue,
-        virtual_stages=V, stage_aux=trainable.stage_aux)
+        virtual_stages=V, stage_aux=trainable.stage_aux,
+        policies=policies)

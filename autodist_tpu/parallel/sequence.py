@@ -48,7 +48,8 @@ def global_positions(local_len: int, *, seq_axis: str = const.SEQ_AXIS,
 
 
 def _build_sequence(trainable, mesh, *, seq_leaves: Sequence[str],
-                    seq_axis: str, data_axis: str, accum: int = 1):
+                    seq_axis: str, data_axis: str, accum: int = 1,
+                    policies=None):
     """Shared construction for both the direct API and the Strategy-IR
     lowering; returns a :class:`~autodist_tpu.kernel.lowering.SimpleLowered`.
 
@@ -92,7 +93,8 @@ def _build_sequence(trainable, mesh, *, seq_leaves: Sequence[str],
     base_spec = P((*d_axes, seq_axis) if has_data else (seq_axis,))
     return build_replicated_spmd(
         trainable, mesh, sync_axes=sync_axes,
-        batch_spec_fn=batch_spec_fn, batch_spec=base_spec, accum=accum)
+        batch_spec_fn=batch_spec_fn, batch_spec=base_spec, accum=accum,
+        policies=policies)
 
 
 def lower_sequence_parallel(trainable, mesh, *,
@@ -119,10 +121,20 @@ def lower_sequence_ir(trainable, strategy, mesh):
     (built by :class:`~autodist_tpu.strategy.parallel_builders.SequenceParallel`)
     — the serializable form of sequence parallelism that flows through
     ``AutoDist.build``, the chief→worker handoff, and ``Saver``."""
+    from autodist_tpu.parallel._spmd import policies_from_node_configs
+
     cfg = strategy.graph_config
     seq_leaves = tuple(cfg.parallel.get("seq_leaves", ("x", "y")))
+    seq_axis = cfg.parallel.get("seq_axis", const.SEQ_AXIS)
+    d_axes = tuple(a for a in (const.DCN_AXIS, const.DATA_AXIS)
+                   if a in mesh.shape)
+    # Per-variable synchronizer configs compose with the sequence axes:
+    # PS -> ZeRO-1 over (dcn x data x seq) — all axes the parameter is
+    # replicated across, the maximal optimizer-state sharding — and
+    # compressors ride the same replica set.
+    policies = policies_from_node_configs(
+        strategy, mesh, replicated_axes=(*d_axes, seq_axis))
     return _build_sequence(
         trainable, mesh, seq_leaves=seq_leaves,
-        seq_axis=cfg.parallel.get("seq_axis", const.SEQ_AXIS),
-        data_axis=const.DATA_AXIS,
-        accum=max(cfg.accum_steps, 1))
+        seq_axis=seq_axis, data_axis=const.DATA_AXIS,
+        accum=max(cfg.accum_steps, 1), policies=policies)

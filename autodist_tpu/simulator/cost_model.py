@@ -222,15 +222,26 @@ class CostModel:
         tokens_per_group = (tokens / n) if tokens else 0.0
         comm_bytes = mem_bytes = 0.0
         num_collectives = 0
-        for node in strategy.node_configs:
-            info = infos.get(node.var_name)
-            if info is None:
-                continue
+        # Iterate var_infos: variables a hand-edited strategy omitted a
+        # node config for still train replicated — price them too.
+        nodes_by_name = {nc.var_name: nc for nc in strategy.node_configs}
+        _no_node = type("_NoNode", (), {"partitioner": None,
+                                        "synchronizer": None})()
+        for info in infos.values():
+            node = nodes_by_name.get(info.name, _no_node)
             bytes_ = float(info.byte_size)
             shards, uses_data = self._gspmd_shards(node, mesh)
+            is_ps = getattr(node.synchronizer, "kind", "") == "ps"
             if shards > 1:
-                mem_bytes += bytes_ * (2.0 + self.opt_state_multiplier) \
-                    / shards
+                # PS on a TP-sharded var: kernel/gspmd.py additionally
+                # shards the state's dim 0 over the data axes when it
+                # divides — a further 1/n on the opt term.
+                opt_div = shards
+                if is_ps and n > 1 and info.shape \
+                        and info.shape[0] % (shards * n) == 0:
+                    opt_div = shards * n
+                mem_bytes += bytes_ * 2.0 / shards \
+                    + bytes_ * self.opt_state_multiplier / opt_div
                 comm_bytes += ring * (bytes_ if uses_data
                                       else bytes_ / shards)
                 num_collectives += 2
@@ -252,9 +263,15 @@ class CostModel:
                         * info.shape[-1] * _ACT_BYTES
                     num_collectives += 2
             else:
-                mem_bytes += bytes_ * (2.0 + self.opt_state_multiplier)
+                # PS(sync=True) under gspmd = GSPMD ZeRO-1 (opt state's
+                # leading dim shards over the data axes, kernel/gspmd.py);
+                # reduce-scatter + all-gather replace the allreduce at
+                # ring-equivalent volume.
+                opt_div = n if (is_ps and n > 1) else 1
+                mem_bytes += bytes_ * 2.0 \
+                    + bytes_ * self.opt_state_multiplier / opt_div
                 comm_bytes += ring * bytes_
-                num_collectives += 1
+                num_collectives += 2 if opt_div > 1 else 1
         if tokens and act_hint:
             # Activations divide by the number of batch shards (the data
             # axis), not all devices: a TP group processes the same
@@ -290,7 +307,6 @@ class CostModel:
         for v in mesh.values():
             total_devices *= v
         infos = list(trainable.var_infos())
-        param_bytes = float(sum(v.byte_size for v in infos))
         opt_mult = self.opt_state_multiplier
         comm = 0.0
         colls = 0
@@ -300,13 +316,39 @@ class CostModel:
         def ring(k: int) -> float:
             return 2.0 * (k - 1) / k if k > 1 else 0.0
 
+        # Iterate var_infos (not node_configs): a hand-edited strategy
+        # omitting node configs for some variables still trains them
+        # (the lowerings default missing nodes to plain AllReduce), so
+        # the pricing must cover every variable.
+        nodes_by_name = {nc.var_name: nc for nc in strategy.node_configs}
+
+        def node_factor(node) -> float:
+            """Compressor wire factor (AllReduce nodes only; PS reduces
+            at full precision)."""
+            sync = getattr(node, "synchronizer", None)
+            if sync is None or getattr(sync, "kind", "allreduce") == "ps":
+                return 1.0
+            return COMPRESSOR_FACTOR.get(
+                (getattr(sync, "compressor", "none") or "none")
+                .partition(":")[0], 1.0)
+
+        def node_is_ps(node) -> bool:
+            return getattr(getattr(node, "synchronizer", None),
+                           "kind", "") == "ps"
+
         if kind == "sequence":
             S = mesh.get(const.SEQ_AXIS, 1)
             n_sync = n_data * S
-            # params replicated; per-var grad pmean over data x seq
-            comm += ring(n_sync) * param_bytes
-            colls += len(infos)
-            mem += param_bytes * (2.0 + opt_mult)
+            # params replicated; per-var sync over data x seq.  PS ->
+            # ZeRO-1 (parallel/_spmd.py): same ring-equivalent volume,
+            # opt state at 1/n_sync; compressors scale the wire bytes.
+            for info in infos:
+                node = nodes_by_name.get(info.name)
+                bytes_ = float(info.byte_size)
+                opt_div = n_sync if (node_is_ps(node) and n_sync > 1) else 1
+                mem += bytes_ * 2.0 + bytes_ * opt_mult / opt_div
+                comm += ring(n_sync) * bytes_ * node_factor(node)
+                colls += 2 if opt_div > 1 else 1
             if tokens:
                 # ring attention: each device rotates its local k/v
                 # (2 tensors of tokens_local x hidden) S-1 hops forward,
@@ -322,11 +364,34 @@ class CostModel:
                 "num_microbatches", 1)), 1)
             V = max(int(strategy.graph_config.parallel.get(
                 "virtual_stages", 1)), 1)
-            # V chunks of C = S*V total live per device -> params/opt at
-            # 1/S; grads pmean over the data axis only
-            mem += param_bytes * (2.0 + opt_mult) / S
-            comm += ring(n_data) * param_bytes / S
-            colls += len(infos)
+            # V chunks of C = S*V total live per device -> stage
+            # params/opt at 1/S, grads sync over the data axis; shared
+            # (embedding/unembedding) vars replicate and sync over
+            # pipe x data.  PS -> ZeRO-1: stage state at 1/(S*n_data),
+            # shared state at 1/(S*n_data) too (pipe x data joint shard).
+            for info in infos:
+                node = nodes_by_name.get(info.name)
+                bytes_ = float(info.byte_size)
+                part = node.partitioner if node is not None else None
+                is_stage = part is not None and (
+                    (part.spec is not None
+                     and const.PIPE_AXIS in part.spec)
+                    or (part.spec is None
+                        and part.mesh_axis == const.PIPE_AXIS
+                        and part.num_shards > 1))
+                if is_stage:
+                    per_dev = bytes_ / S
+                    opt_div = n_data if (node_is_ps(node)
+                                         and n_data > 1) else 1
+                    mem += per_dev * 2.0 + per_dev * opt_mult / opt_div
+                    comm += ring(n_data) * per_dev * node_factor(node)
+                    colls += 2 if opt_div > 1 else 1
+                else:
+                    n_pd = S * n_data
+                    opt_div = n_pd if node_is_ps(node) else 1
+                    mem += bytes_ * 2.0 + bytes_ * opt_mult / opt_div
+                    comm += ring(n_pd) * bytes_ * node_factor(node)
+                    colls += 2 if opt_div > 1 else 1
             if tokens:
                 # activation hop per schedule tick (ppermute ring), fwd +
                 # transposed bwd; T = M*V + S - 1 ticks of a microbatch
@@ -342,26 +407,28 @@ class CostModel:
                     mem += act_hint * tokens_local / M
         else:  # expert
             E = mesh.get(const.EXPERT_AXIS, 1)
-            expert_bytes = 0.0
-            for node in strategy.node_configs:
-                info = next((v for v in infos if v.name == node.var_name),
-                            None)
-                if info is None:
-                    continue
-                part = node.partitioner
+            # dense params replicate + sync over data x expert (PS ->
+            # ZeRO-1 over both); expert tables live 1/E and sync over
+            # data only (PS degrades to plain there — state already
+            # sharded with the table).
+            for info in infos:
+                node = nodes_by_name.get(info.name)
+                bytes_ = float(info.byte_size)
+                part = node.partitioner if node is not None else None
                 is_expert = part is not None and (
                     (part.spec is not None and const.EXPERT_AXIS in part.spec)
                     or part.mesh_axis == const.EXPERT_AXIS)
                 if is_expert:
-                    expert_bytes += info.byte_size
-            dense_bytes = param_bytes - expert_bytes
-            # dense params replicate + sync over data x expert; expert
-            # tables live 1/E and sync over data only
-            mem += dense_bytes * (2.0 + opt_mult) \
-                + expert_bytes * (2.0 + opt_mult) / E
-            comm += ring(n_data * E) * dense_bytes \
-                + ring(n_data) * expert_bytes / E
-            colls += len(infos)
+                    mem += bytes_ * (2.0 + opt_mult) / E
+                    comm += ring(n_data) * (bytes_ / E) * node_factor(node)
+                    colls += 1
+                else:
+                    n_sync = n_data * E
+                    opt_div = n_sync if (node_is_ps(node)
+                                         and n_sync > 1) else 1
+                    mem += bytes_ * 2.0 + bytes_ * opt_mult / opt_div
+                    comm += ring(n_sync) * bytes_ * node_factor(node)
+                    colls += 2 if opt_div > 1 else 1
             if tokens:
                 # all_to_all dispatch + combine, fwd + bwd: 4 passes of
                 # the local token activations, (E-1)/E leaving the device

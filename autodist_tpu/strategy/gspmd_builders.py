@@ -13,7 +13,8 @@ from typing import Optional, Sequence
 from autodist_tpu import const
 from autodist_tpu.strategy.base import StrategyBuilder
 from autodist_tpu.strategy.ir import (AllReduceSynchronizer, NodeConfig,
-                                      PartitionerConfig, Strategy)
+                                      PartitionerConfig, PSSynchronizer,
+                                      Strategy)
 
 
 class Sharded(StrategyBuilder):
@@ -28,10 +29,17 @@ class Sharded(StrategyBuilder):
 
     First matching rule wins; unmatched variables are replicated (pure DP
     via the sharded batch).
+
+    ``zero1=True`` emits ``PSSynchronizer`` node configs: the gspmd
+    lowering shards each variable's optimizer-state leading dim over the
+    data axes (GSPMD ZeRO-1; XLA derives the reduce-scatter/all-gather)
+    — composable with TP sharding of the other dims.
     """
 
-    def __init__(self, rules: Sequence[tuple[str, list]] = ()):
+    def __init__(self, rules: Sequence[tuple[str, list]] = (), *,
+                 zero1: bool = False):
         self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.zero1 = zero1
 
     def spec_for(self, info) -> Optional[list]:
         for pat, spec in self.rules:
@@ -43,7 +51,9 @@ class Sharded(StrategyBuilder):
         nodes = []
         for info in trainable.var_infos():
             node = NodeConfig(var_name=info.name,
-                              synchronizer=AllReduceSynchronizer(),
+                              synchronizer=(PSSynchronizer()
+                                            if getattr(self, "zero1", False)
+                                            else AllReduceSynchronizer()),
                               is_sparse=info.is_sparse)
             spec = self.spec_for(info)
             if spec is not None:
@@ -73,8 +83,10 @@ class TensorParallel(Sharded):
     """Megatron-style TP for the bundled transformer stack; extra rules
     can extend/override the defaults."""
 
-    def __init__(self, extra_rules: Sequence[tuple[str, list]] = ()):
-        super().__init__(tuple(extra_rules) + TRANSFORMER_TP_RULES)
+    def __init__(self, extra_rules: Sequence[tuple[str, list]] = (), *,
+                 zero1: bool = False):
+        super().__init__(tuple(extra_rules) + TRANSFORMER_TP_RULES,
+                         zero1=zero1)
 
 
 class FSDPSharded(Sharded):

@@ -23,7 +23,25 @@ from autodist_tpu import const
 from autodist_tpu.strategy.base import StrategyBuilder
 from autodist_tpu.utils import logging
 from autodist_tpu.strategy.ir import (AllReduceSynchronizer, NodeConfig,
-                                      PartitionerConfig, Strategy)
+                                      PartitionerConfig, PSSynchronizer,
+                                      Strategy)
+
+
+def _default_sync(zero1: bool, compressor: str):
+    """The per-variable synchronizer a parallel builder emits: PS ≙
+    ZeRO-1 sharded optimizer state (the reference's PS semantics on TPU,
+    ``ir.py:56-73``), AllReduce with an optional compressor otherwise.
+    Heterogeneous per-variable mixes (the reference's Parallax trick,
+    ``parallax_strategy.py:24-71``) remain available by editing the
+    emitted node configs before ``AutoDist.build``."""
+    if zero1 and compressor not in ("", "none"):
+        raise ValueError(
+            "zero1 and compressor are mutually exclusive per variable: "
+            "PS (ZeRO-1) sync reduces at full precision; compression is "
+            "an AllReduce knob")
+    if zero1:
+        return lambda: PSSynchronizer()
+    return lambda: AllReduceSynchronizer(compressor=compressor or "none")
 
 
 class SequenceParallel(StrategyBuilder):
@@ -37,8 +55,10 @@ class SequenceParallel(StrategyBuilder):
     :func:`autodist_tpu.parallel.sequence.global_positions`.
     """
 
-    def __init__(self, seq_leaves: Sequence[str] = ("x", "y")):
+    def __init__(self, seq_leaves: Sequence[str] = ("x", "y"), *,
+                 zero1: bool = False, compressor: str = "none"):
         self.seq_leaves = tuple(seq_leaves)
+        self.make_sync = _default_sync(zero1, compressor)
 
     def build(self, trainable, resource_spec):
         shape = resource_spec.resolved_mesh_shape()
@@ -48,7 +68,7 @@ class SequenceParallel(StrategyBuilder):
                 f"spec resolves to {shape} — declare e.g. "
                 "mesh: {data: ..., seq: ...}")
         nodes = [NodeConfig(var_name=i.name,
-                            synchronizer=AllReduceSynchronizer(),
+                            synchronizer=self.make_sync(),
                             is_sparse=i.is_sparse)
                  for i in trainable.var_infos()]
         cfg = self._graph_config(resource_spec)
@@ -69,13 +89,15 @@ class Pipeline(StrategyBuilder):
     schedule.
     """
 
-    def __init__(self, num_microbatches: int = 1, virtual_stages: int = 1):
+    def __init__(self, num_microbatches: int = 1, virtual_stages: int = 1,
+                 *, zero1: bool = False, compressor: str = "none"):
         if num_microbatches < 1:
             raise ValueError("num_microbatches must be >= 1")
         if virtual_stages < 1:
             raise ValueError("virtual_stages must be >= 1")
         self.num_microbatches = num_microbatches
         self.virtual_stages = virtual_stages
+        self.make_sync = _default_sync(zero1, compressor)
 
     def build(self, trainable, resource_spec):
         shape = resource_spec.resolved_mesh_shape()
@@ -101,7 +123,7 @@ class Pipeline(StrategyBuilder):
         nodes = []
         for i in trainable.var_infos():
             node = NodeConfig(var_name=i.name,
-                              synchronizer=AllReduceSynchronizer(),
+                              synchronizer=self.make_sync(),
                               is_sparse=i.is_sparse)
             # shared-group vars (embedding/unembedding of a pipelined
             # transformer) replicate; stage vars shard on the pipe axis.
@@ -136,9 +158,11 @@ class ExpertParallel(StrategyBuilder):
     """
 
     def __init__(self, expert_params: Sequence[str] = (),
-                 detect: bool = True):
+                 detect: bool = True, *, zero1: bool = False,
+                 compressor: str = "none"):
         self.expert_params = tuple(expert_params)
         self.detect = detect
+        self.make_sync = _default_sync(zero1, compressor)
 
     def build(self, trainable, resource_spec):
         shape = resource_spec.resolved_mesh_shape()
@@ -169,7 +193,7 @@ class ExpertParallel(StrategyBuilder):
                     "expert_params=(%r,) if it is a per-expert table",
                     i.name, i.name.rsplit("/", 1)[-1])
             node = NodeConfig(var_name=i.name,
-                              synchronizer=AllReduceSynchronizer(),
+                              synchronizer=self.make_sync(),
                               is_sparse=i.is_sparse)
             if explicit or auto:
                 matched.add(i.name)

@@ -36,9 +36,14 @@ while true; do
     echo "$(date -u +%H:%M:%S) tunnel healthy — starting queue" >> "$LOG"
     timeout 2500 python bench.py > /tmp/hw_bench.json 2>/tmp/hw_bench.err
     echo "$(date -u +%H:%M:%S) bench rc=$? $(tail -c 300 /tmp/hw_bench.json)" >> "$LOG"
-    # Only continue if the bench actually produced a number — otherwise the
-    # window was illusory; go back to waiting.
-    if grep -q '"value": 0\.[1-9]' /tmp/hw_bench.json; then
+    # Only continue if the bench actually produced a measurement (no
+    # "error" key and a nonzero value — bench.py emits value 0.0 exactly
+    # when the backend was unavailable); otherwise the window was
+    # illusory; go back to waiting.  A low-but-real MFU still advances
+    # the queue: calibration/crossover validity doesn't depend on it.
+    if ! grep -q '"error"' /tmp/hw_bench.json \
+        && grep -q '"value"' /tmp/hw_bench.json \
+        && ! grep -q '"value": 0\.0[,}]' /tmp/hw_bench.json; then
       timeout 1800 python examples/benchmark/imagenet.py --model resnet50 \
         --train-steps 30 --warmup-steps 3 --json \
         > /tmp/hw_resnet50.out 2>/tmp/hw_resnet50.err
