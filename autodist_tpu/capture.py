@@ -208,7 +208,7 @@ class PipelineTrainable(Trainable):
     def __init__(self, stage_fn, stacked_params, loss_head, optimizer, *,
                  num_stages: int, batch_key: str = "x",
                  stage_aux: bool = False, shared_params=None,
-                 prologue=None, **kw):
+                 prologue=None, stage_rng: bool = False, **kw):
         sizes = set()
         for l in jax.tree_util.tree_leaves(stacked_params):
             shape = getattr(l, "shape", ())
@@ -239,6 +239,11 @@ class PipelineTrainable(Trainable):
         self.shared_params = shared_params
         self.prologue = prologue
         self.has_shared = shared_params is not None
+        # stage_fn takes (chunk, x, chunk_rng, rows): per-(chunk, sample)
+        # stochasticity (dropout) — keyed so the pipelined schedule and
+        # this sequential loss draw identical masks for any microbatch
+        # count (parallel/pipeline.py pipeline_apply docstring).
+        self.stage_rng = stage_rng
 
         has_shared = self.has_shared
 
@@ -249,14 +254,22 @@ class PipelineTrainable(Trainable):
                 x = prologue(shared, batch)
             else:
                 x = batch[batch_key]
+            rows = (jnp.arange(jax.tree_util.tree_leaves(x)[0].shape[0])
+                    if stage_rng else None)
             aux_total = 0.0
             for i in range(num_stages):
                 chunk = jax.tree_util.tree_map(lambda p: p[i], stages)
+                if stage_rng:
+                    rng_c = (jax.random.fold_in(rng, i)
+                             if rng is not None else None)
+                    res = stage_fn(chunk, x, rng_c, rows)
+                else:
+                    res = stage_fn(chunk, x)
                 if stage_aux:
-                    x, aux = stage_fn(chunk, x)
+                    x, aux = res
                     aux_total = aux_total + aux
                 else:
-                    x = stage_fn(chunk, x)
+                    x = res
             if has_shared:
                 loss, metrics = loss_head(x, batch, shared)
             else:

@@ -111,7 +111,8 @@ def local_axis_shard(x, axis_name: str, n: int, axis: int):
 # lowering paths.
 # --------------------------------------------------------------------------- #
 def accumulate_microbatches(micro_fn, params_like, batch, rng, extra,
-                            accum: int):
+                            accum: int, *, with_index: bool = False,
+                            split_rng: bool = True):
     """Scan ``accum`` microbatches; returns (grads, new_extra, metrics).
 
     ``micro_fn(mb, rng, extra) -> ((loss, (new_extra, metrics)), grads)``
@@ -120,6 +121,13 @@ def accumulate_microbatches(micro_fn, params_like, batch, rng, extra,
     (duplicate-feed).  Gradients and float metrics average; integer
     metrics (counts) sum; bool metrics OR — each matching what the
     equivalent single full batch would report.
+
+    ``with_index=True`` calls ``micro_fn(mb, rng, extra, slice_idx)`` —
+    for callers whose stochasticity keys on global sample indices (the
+    pipeline's per-row dropout).  ``split_rng=False`` hands every slice
+    the *same* step rng instead of per-slice splits: safe only when the
+    callee keys draws on (slice-unique) indices, where it makes the
+    accumulated step reproduce the single full-batch draw exactly.
     """
     def split(x):
         if jnp.ndim(x) == 0:
@@ -132,14 +140,21 @@ def accumulate_microbatches(micro_fn, params_like, batch, rng, extra,
 
     def body(carry, mb_rng):
         g_acc, extra_c = carry
-        mb, r = mb_rng
-        (_, (new_extra, metrics)), g = micro_fn(mb, r, extra_c)
+        if with_index:
+            mb, r, i = mb_rng
+            (_, (new_extra, metrics)), g = micro_fn(mb, r, extra_c, i)
+        else:
+            mb, r = mb_rng
+            (_, (new_extra, metrics)), g = micro_fn(mb, r, extra_c)
         return (jax.tree.map(jnp.add, g_acc, g), new_extra), metrics
 
+    rngs = (jax.random.split(rng, accum) if split_rng
+            else jnp.broadcast_to(rng[None], (accum, *jnp.shape(rng))))
+    xs = (jax.tree.map(split, batch), rngs)
+    if with_index:
+        xs = (*xs, jnp.arange(accum))
     g0 = jax.tree.map(jnp.zeros_like, params_like)
-    (g_sum, new_extra), metric_stack = lax.scan(
-        body, (g0, extra),
-        (jax.tree.map(split, batch), jax.random.split(rng, accum)))
+    (g_sum, new_extra), metric_stack = lax.scan(body, (g0, extra), xs)
     grads = jax.tree.map(lambda g: g / accum, g_sum)
 
     def reduce_metric(m):

@@ -36,14 +36,7 @@ def make_pipeline_lm_trainable(cfg: TransformerConfig, optimizer, rng, *,
     from autodist_tpu.capture import PipelineTrainable
 
     num_stages = num_stages or cfg.num_layers
-    if cfg.dropout_rate or cfg.attention_dropout_rate:
-        # The stage ring runs layers with deterministic=True (threading
-        # per-tick dropout rngs through the schedule is not implemented);
-        # silently training an unregularized model would misrepresent
-        # the config the user asked for.
-        raise ValueError(
-            "pipeline LM stages run without dropout; build the config "
-            "with dropout_rate=0 and attention_dropout_rate=0")
+    needs_rng = bool(cfg.dropout_rate or cfg.attention_dropout_rate)
     H = cfg.hidden_size
     layer = EncoderLayer(cfg)
     probe_x = jnp.zeros((2, min(cfg.max_len, 32), H), cfg.dtype)
@@ -71,10 +64,23 @@ def make_pipeline_lm_trainable(cfg: TransformerConfig, optimizer, rng, *,
         x = shared["embedding"][tokens].astype(cfg.dtype)
         return x + shared["pos_embed"][None, :L].astype(cfg.dtype)
 
-    def stage_fn(chunk, x):
+    def stage_fn(chunk, x, rng_c=None, rows=None):
+        """One encoder layer; with dropout configured, masks key on
+        (chunk, global sample index) — drawn per row under vmap — so the
+        pipelined schedule and the sequential reference produce
+        identical masks for any microbatch count / data sharding
+        (pipeline_apply's stage_rng contract)."""
         L = x.shape[1]
         mask = jnp.tril(jnp.ones((L, L), bool))[None, None]
-        return layer.apply({"params": chunk}, x, mask, True)
+        if not needs_rng or rng_c is None:
+            return layer.apply({"params": chunk}, x, mask, True)
+        keys = jax.vmap(lambda r: jax.random.fold_in(rng_c, r))(rows)
+
+        def one_row(xr, key):
+            return layer.apply({"params": chunk}, xr[None], mask, False,
+                               rngs={"dropout": key})[0]
+
+        return jax.vmap(one_row)(x, keys)
 
     def loss_head(outputs, batch, shared):
         x = _layer_norm(outputs, shared["ln_final_scale"],
@@ -90,4 +96,5 @@ def make_pipeline_lm_trainable(cfg: TransformerConfig, optimizer, rng, *,
     return PipelineTrainable(stage_fn, stacked, loss_head, optimizer,
                              num_stages=num_stages,
                              shared_params=shared, prologue=prologue,
+                             stage_rng=needs_rng,
                              name="pipeline_lm", **kw)

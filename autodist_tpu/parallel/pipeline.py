@@ -101,7 +101,8 @@ def _tick_assignment(t, device, *, n: int, V: int, M: int):
 def pipeline_apply(stage_fn: Callable, stage_params, x, *,
                    axis_name: str = const.PIPE_AXIS,
                    num_microbatches: int, virtual_stages: int = 1,
-                   stage_aux: bool = False):
+                   stage_aux: bool = False, stage_rng: bool = False,
+                   rng=None, row_offset=0):
     """Run the pipeline schedule (call inside ``shard_map``).
 
     Args:
@@ -109,7 +110,15 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *,
         ``-> (activation, aux_scalar)`` with ``stage_aux=True``) — one
         pipeline chunk.  Activations are pytrees; chunk 0 consumes a
         microbatch of ``x``, so the activation structure/shapes must
-        match the microbatch's.
+        match the microbatch's.  With ``stage_rng=True`` the signature
+        is ``(chunk_params, activation, chunk_rng, rows)``: ``chunk_rng``
+        is ``fold_in(rng, global_chunk)`` (``None`` when ``rng`` is
+        ``None`` — eval), ``rows`` the *global* sample indices of the
+        microbatch —
+        keying stochasticity (dropout) per (chunk, sample) makes the
+        masks microbatching- and data-sharding-invariant, so the
+        pipelined run reproduces the sequential reference exactly for
+        any M (see ``models/pipeline_lm.py``).
       stage_params: this device's chunk parameters — the local shard.
         ``virtual_stages == 1``: the chunk's params directly;
         ``virtual_stages == V > 1``: leaves carry a leading ``[V]`` dim
@@ -121,6 +130,8 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *,
       virtual_stages: V — chunks per device (Megatron interleaving).
       stage_aux: stage_fn also returns a scalar accumulated over every
         (microbatch, chunk) — per-stage auxiliary losses.
+      stage_rng / rng / row_offset: per-chunk rng threading (above);
+        ``row_offset`` is this data-shard's first global sample index.
 
     Returns the last chunk's outputs ``[B, ...]`` (zeros on other
     devices — use :func:`last_stage_value` or a psum to extract), plus
@@ -146,9 +157,26 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *,
                 f"dim {leaf.shape[0]} (expected [V, ...] per-device "
                 "layout)")
 
+    mb_size = B // M
+
+    def call_stage(pv, act, m, v):
+        if not stage_rng:
+            return stage_fn(pv, act)
+        c_global = v * n + lax.axis_index(axis_name)
+        rng_c = (jax.random.fold_in(rng, c_global)
+                 if rng is not None else None)
+        rows = row_offset + m * mb_size + jnp.arange(mb_size)
+        return stage_fn(pv, act, rng_c, rows)
+
     mb0 = jax.tree.map(lambda a: a[0], mb)
-    probe = jax.eval_shape(
-        stage_fn, jax.tree.map(lambda p: p[0], vparams), mb0)
+    pv0 = jax.tree.map(lambda p: p[0], vparams)
+    if stage_rng:
+        probe = jax.eval_shape(
+            lambda pv, act: call_stage(pv, act, jnp.zeros((), jnp.int32),
+                                       jnp.zeros((), jnp.int32)),
+            pv0, mb0)
+    else:
+        probe = jax.eval_shape(stage_fn, pv0, mb0)
     act_probe = probe[0] if stage_aux else probe
     in_probe = jax.eval_shape(lambda t: t, mb0)
     if (jax.tree.structure(act_probe) != jax.tree.structure(in_probe)
@@ -175,7 +203,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, *,
         pv = jax.tree.map(
             lambda p: lax.dynamic_index_in_dim(p, v, keepdims=False),
             vparams)
-        res = stage_fn(pv, my_in)
+        res = call_stage(pv, my_in, m, v)
         out, aux = res if stage_aux else (res, None)
         if stage_aux:
             aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
@@ -261,7 +289,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                     accum: int = 1, batch_key: str = "x",
                     virtual_stages: int = 1, stage_aux: bool = False,
                     shared_params=None, prologue: Callable = None,
-                    policies=None):
+                    policies=None, stage_rng: bool = False):
     """Shared construction for the direct API and the Strategy-IR entry;
     returns a Lowered-contract container.
 
@@ -457,7 +485,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
 
     init_fn = jax.jit(_init, out_shardings=state_shardings)
 
-    def _forward_loss(vp, batch):
+    def _forward_loss(vp, batch, rng=None, slice_idx=0, slices=1):
         """Masked local loss+metrics of one batch slice (the head loss is
         nonzero on the last device only; per-stage aux losses are local
         to every device.  Gradients reach earlier chunks through the
@@ -471,10 +499,24 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         local = stages if V > 1 else jax.tree.map(lambda p: p[0], stages)
         x_in = prologue(shared, batch) if prologue is not None \
             else batch[batch_key]
+        if stage_rng:
+            # Global sample index of this (data shard, accum slice)'s
+            # first row keys per-row stochasticity (dropout) shard- and
+            # slice-invariantly: global row = shard*full_shard_rows +
+            # slice*slice_rows + i (shards split the batch before
+            # accumulation slices it).
+            b_local = jax.tree.leaves(x_in)[0].shape[0]
+            offset = slice_idx * b_local
+            if has_data:
+                offset = offset + lax.axis_index(d_axes) * (slices * b_local)
+        else:
+            offset = 0
         res = pipeline_apply(stage_fn, local, x_in,
                              axis_name=pipe_axis,
                              num_microbatches=num_microbatches,
-                             virtual_stages=V, stage_aux=stage_aux)
+                             virtual_stages=V, stage_aux=stage_aux,
+                             stage_rng=stage_rng, rng=rng,
+                             row_offset=offset)
         outputs, aux = res if stage_aux else (res, None)
         loss, metrics = loss_head(outputs, batch, shared) if has_shared \
             else loss_head(outputs, batch)
@@ -515,9 +557,9 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     def _local_step(state, batch, rng):
         vparams = state["params"]  # local [V, ...] chunks
 
-        def micro_grads(mb, rng_, extra_in):
+        def micro_grads(mb, rng_, extra_in, idx=0):
             def loss_of(vp):
-                masked, metrics = _forward_loss(vp, mb)
+                masked, metrics = _forward_loss(vp, mb, rng_, idx, accum)
                 return masked, (extra_in, metrics)
 
             return jax.value_and_grad(loss_of, has_aux=True)(vparams)
@@ -525,8 +567,12 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         if accum == 1:
             (_, (_, metrics)), grads = micro_grads(batch, rng, None)
         else:
+            # stage_rng keys draws on global (chunk, row): slices share
+            # the step rng so the accumulated step reproduces the single
+            # full-batch draw exactly (common.accumulate_microbatches).
             grads, _, metrics = common.accumulate_microbatches(
-                micro_grads, vparams, batch, rng, None, accum)
+                micro_grads, vparams, batch, rng, None, accum,
+                with_index=True, split_rng=not stage_rng)
 
         metrics = _broadcast_metrics(metrics)
         new_sync: dict = {}
@@ -608,7 +654,8 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     step_fn = jax.jit(_step, donate_argnums=(0,))
 
     def _local_eval(state, batch, rng):
-        _, metrics = _forward_loss(state["params"], batch)
+        # Eval is deterministic: no rng reaches the stages (dropout off).
+        _, metrics = _forward_loss(state["params"], batch, None)
         return _broadcast_metrics(metrics)
 
     def _eval(state, batch, rng):
@@ -704,4 +751,4 @@ def lower_pipeline_ir(trainable, strategy, mesh):
                        else None),
         prologue=trainable.prologue,
         virtual_stages=V, stage_aux=trainable.stage_aux,
-        policies=policies)
+        policies=policies, stage_rng=trainable.stage_rng)

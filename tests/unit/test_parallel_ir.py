@@ -543,7 +543,10 @@ def test_pipelined_lm_interleaved_virtual_stages():
     assert np.isfinite(float(np.asarray(m["accuracy"])))
 
 
-def test_pipelined_lm_rejects_dropout_config():
+def test_pipelined_lm_accepts_dropout_config():
+    """Round-4 Missing #6 closed: a regularized pipelined LM builds with
+    stage_rng threading (equivalence goldens live in
+    test_pipeline_dropout.py)."""
     import optax
 
     from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
@@ -552,9 +555,9 @@ def test_pipelined_lm_rejects_dropout_config():
     cfg = TransformerConfig(vocab_size=64, hidden_size=32, num_layers=4,
                             num_heads=2, mlp_dim=64, max_len=32,
                             dropout_rate=0.1, causal=True)
-    with pytest.raises(ValueError, match="without dropout"):
-        make_pipeline_lm_trainable(cfg, optax.sgd(0.1),
+    t = make_pipeline_lm_trainable(cfg, optax.sgd(0.1),
                                    jax.random.PRNGKey(0))
+    assert t.stage_rng
 
 
 def test_pipeline_shared_leaf_with_stagecount_dim_stays_replicated():
