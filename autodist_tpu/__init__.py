@@ -43,6 +43,7 @@ from autodist_tpu.strategy.parallel_builders import (ExpertParallel,
 from autodist_tpu.strategy.ir import Strategy
 from autodist_tpu.simulator import AutoStrategy
 from autodist_tpu.train import fit
+from autodist_tpu.fetches import fetch
 
 __all__ = [
     "AutoDist", "Trainable", "PipelineTrainable", "VarInfo", "ResourceSpec",
@@ -51,5 +52,5 @@ __all__ = [
     "UnevenPartitionedPS", "PartitionedAR", "RandomAxisPartitionAR",
     "Parallax", "ZeRO", "AutoStrategy", "GradAccumulation", "fit",
     "Sharded", "TensorParallel", "FSDPSharded",
-    "SequenceParallel", "Pipeline", "ExpertParallel",
+    "SequenceParallel", "Pipeline", "ExpertParallel", "fetch",
 ]

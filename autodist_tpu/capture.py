@@ -65,6 +65,22 @@ _SPARSE_NAME_RE = re.compile(r"(embed|embedding|lookup|vocab)", re.IGNORECASE)
 _SPARSE_MIN_ROWS = 8192
 
 
+def _with_fetches(loss_fn):
+    """Wrap a canonical loss so values tagged via
+    :func:`autodist_tpu.fetches.fetch` inside it surface as
+    ``fetch/<name>`` metrics (≙ reference ``session.run(fetches)``,
+    ``remapper.py:125-185``) — one wrapper here serves every lowering,
+    since they all call ``trainable.loss``/``eval_loss``."""
+    from autodist_tpu import fetches as _fetches
+
+    def wrapped(params, extra, batch, rng):
+        with _fetches.collecting() as fd:
+            loss, new_extra, metrics = loss_fn(params, extra, batch, rng)
+        return loss, new_extra, _fetches.merge_into_metrics(metrics, fd)
+
+    return wrapped
+
+
 class Trainable:
     """The unit strategies are built for and lowering consumes.
 
@@ -72,6 +88,10 @@ class Trainable:
     (loss, new_extra, metrics)`` where ``extra`` is non-trained state
     (e.g. batch-norm statistics) and ``metrics`` a dict of scalars.
     Use the factories for simpler signatures.
+
+    Intermediates tagged with :func:`autodist_tpu.fetch` inside the loss
+    surface as ``fetch/<name>`` metrics under every lowering (the
+    arbitrary-tensor fetch contract; see :mod:`autodist_tpu.fetches`).
     """
 
     def __init__(
@@ -89,7 +109,7 @@ class Trainable:
         act_bytes_per_token: Optional[float] = None,
         sequence_ready: bool = False,
     ):
-        self.loss = loss
+        self.loss = _with_fetches(loss)
         self.params = params
         self.optimizer = optimizer
         self.extra = extra
@@ -112,7 +132,8 @@ class Trainable:
         # Inference-mode loss for runner.eval_step/evaluate: same signature
         # as ``loss`` but must apply the model with dropout off and BatchNorm
         # running averages.  Falls back to the train loss when not given.
-        self.eval_loss = eval_loss if eval_loss is not None else loss
+        self.eval_loss = (_with_fetches(eval_loss)
+                          if eval_loss is not None else self.loss)
         self.name = name
         self._explicit_sparse = set(sparse_params)
         self._detect_sparse = detect_sparse

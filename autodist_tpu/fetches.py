@@ -1,0 +1,103 @@
+"""Arbitrary-tensor fetch: tag intermediates inside a loss for retrieval.
+
+The reference's session could fetch *any* named graph tensor without
+changing the training graph (``remapper.py:125-185``: fetches resolved
+against the transformed graph, values read off the master replica).  On
+TPU there is no graph to name tensors in — everything is one traced
+function — so the TPU-native contract is a tagging call at the point
+where the value exists:
+
+    from autodist_tpu import fetch
+
+    def loss_fn(params, batch):
+        h = encoder(params, batch["x"])
+        fetch("encoder_norm", jnp.linalg.norm(h))   # tagged, not returned
+        ...
+        return loss
+
+Tagged values surface in the step metrics under ``fetch/<name>`` —
+riding the existing metrics plumbing through every lowering (collective
+/ gspmd / sequence / expert), gradient accumulation, and the
+cross-replica metric reduction (floats average, ints sum, bools OR).
+``runner.step(...)["fetch/encoder_norm"]`` therefore works under FSDP,
+ZeRO, compressed sync, etc. with no per-lowering code.
+
+Pipeline stages run inside a ``lax.scan`` over schedule ticks, where a
+trace-time collector cannot carry values out; there, tag inside the
+*loss head* (runs outside the tick scan, masked to the last stage like
+other head metrics) or use ``stage_aux`` for per-stage scalars.
+
+Caveats (documented, loud): fetched floats are *averaged* across
+replicas — fetch replica-invariant values or statistics whose mean is
+meaningful (norms, entropies, counts); per-sample tensors come back as
+the mean of the per-shard values, not a gathered batch.
+"""
+from __future__ import annotations
+
+import contextlib
+
+_ACTIVE: list[dict] = []
+
+
+def fetch(name: str, value):
+    """Tag ``value`` for retrieval as ``fetch/<name>`` in the step
+    metrics.  A no-op (returns ``value``) outside a collecting context —
+    the same model code runs unchanged under plain jax.
+
+    Tag names must be unique within one step (a per-layer loop should
+    suffix the index); the value must be live at the loss's own trace
+    level — tagging inside a ``lax.scan``/``cond``/``while`` body cannot
+    carry the value out (see :func:`merge_into_metrics`'s guard)."""
+    if _ACTIVE:
+        d = _ACTIVE[-1]
+        key = str(name)
+        if key in d:
+            raise ValueError(
+                f"fetch tag {key!r} already used in this step; silent "
+                "overwrite would keep only the last value — use distinct "
+                "names (e.g. suffix the layer index)")
+        d[key] = value
+    return value
+
+
+@contextlib.contextmanager
+def collecting():
+    """Trace-time collector: values tagged by :func:`fetch` inside the
+    block land in the yielded dict (used by Trainable's loss wrapper)."""
+    d: dict = {}
+    _ACTIVE.append(d)
+    try:
+        yield d
+    finally:
+        _ACTIVE.pop()
+
+
+def merge_into_metrics(metrics: dict, collected: dict) -> dict:
+    """``fetch/<name>`` keys merged into a metrics dict (collision with
+    an explicit metric of the same name is an error — silent overwrite
+    would corrupt whichever the user meant).
+
+    Values tagged inside an inner control-flow scope (``lax.scan`` /
+    ``cond`` / ``while`` body) are dead tracers by the time the loss
+    returns; probing them here turns JAX's distant
+    ``UnexpectedTracerError`` into an immediate error naming the tag."""
+    if not collected:
+        return metrics
+    out = dict(metrics)
+    for k, v in collected.items():
+        key = f"fetch/{k}"
+        if key in out:
+            raise ValueError(
+                f"fetch tag {k!r} collides with an existing metric {key!r}")
+        if hasattr(v, "aval"):  # a jax tracer: probe that it is still live
+            try:
+                v + 0
+            except Exception as e:
+                raise ValueError(
+                    f"fetch tag {k!r} holds a value traced inside an "
+                    "inner control-flow scope (lax.scan/cond/while body) "
+                    "— it cannot escape to the step metrics; compute the "
+                    "statistic outside the loop, or return it from the "
+                    "scan body and tag it after") from e
+        out[key] = v
+    return out

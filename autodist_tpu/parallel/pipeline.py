@@ -48,6 +48,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from autodist_tpu import const
+from autodist_tpu import fetches as _fetches
 from autodist_tpu.kernel import common
 from autodist_tpu.kernel.lowering import SimpleLowered
 
@@ -518,8 +519,14 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                              stage_rng=stage_rng, rng=rng,
                              row_offset=offset)
         outputs, aux = res if stage_aux else (res, None)
-        loss, metrics = loss_head(outputs, batch, shared) if has_shared \
-            else loss_head(outputs, batch)
+        # The loss head runs outside the tick scan, so fetch tags inside
+        # it can surface (stage_fn tags cannot escape the scan — see
+        # autodist_tpu.fetches); head fetch values get the same
+        # last-stage masking as other head metrics.
+        with _fetches.collecting() as fd:
+            loss, metrics = loss_head(outputs, batch, shared) \
+                if has_shared else loss_head(outputs, batch)
+        metrics = _fetches.merge_into_metrics(metrics, fd)
         idx = lax.axis_index(pipe_axis)
         masked = jnp.where(idx == n - 1, loss, 0.0)
         metrics = dict(metrics, loss=loss)
