@@ -35,8 +35,18 @@ the mean of the per-shard values, not a gathered batch.
 from __future__ import annotations
 
 import contextlib
+import threading
 
-_ACTIVE: list[dict] = []
+
+class _Stack(threading.local):
+    """Per-thread collector stack: concurrent tracing in two threads
+    (train here, evaluate there) must not cross-contaminate."""
+
+    def __init__(self):
+        self.items: list[dict] = []
+
+
+_TLS = _Stack()
 
 
 def fetch(name: str, value):
@@ -48,8 +58,8 @@ def fetch(name: str, value):
     suffix the index); the value must be live at the loss's own trace
     level — tagging inside a ``lax.scan``/``cond``/``while`` body cannot
     carry the value out (see :func:`merge_into_metrics`'s guard)."""
-    if _ACTIVE:
-        d = _ACTIVE[-1]
+    if _TLS.items:
+        d = _TLS.items[-1]
         key = str(name)
         if key in d:
             raise ValueError(
@@ -65,11 +75,11 @@ def collecting():
     """Trace-time collector: values tagged by :func:`fetch` inside the
     block land in the yielded dict (used by Trainable's loss wrapper)."""
     d: dict = {}
-    _ACTIVE.append(d)
+    _TLS.items.append(d)
     try:
         yield d
     finally:
-        _ACTIVE.pop()
+        _TLS.items.pop()
 
 
 def merge_into_metrics(metrics: dict, collected: dict) -> dict:

@@ -290,7 +290,8 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                     accum: int = 1, batch_key: str = "x",
                     virtual_stages: int = 1, stage_aux: bool = False,
                     shared_params=None, prologue: Callable = None,
-                    policies=None, stage_rng: bool = False):
+                    policies=None, stage_rng: bool = False,
+                    remat: bool = False):
     """Shared construction for the direct API and the Strategy-IR entry;
     returns a Lowered-contract container.
 
@@ -332,6 +333,12 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     V = virtual_stages
     C = n * V
     policies = policies or {}
+    if remat:
+        # Each chunk recomputes its forward in the backward pass: live
+        # residuals shrink from every chunk intermediate to the chunk
+        # boundary activations (the Pipeline(remat=True) strategy knob;
+        # the cost model prices both envelopes).
+        stage_fn = jax.checkpoint(stage_fn)
     # Replica axes include dcn on multi-slice meshes (data-only sync
     # would skip cross-slice gradient exchange).
     d_axes = tuple(a for a in (const.DCN_AXIS, data_axis)
@@ -512,18 +519,21 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                 offset = offset + lax.axis_index(d_axes) * (slices * b_local)
         else:
             offset = 0
-        res = pipeline_apply(stage_fn, local, x_in,
-                             axis_name=pipe_axis,
-                             num_microbatches=num_microbatches,
-                             virtual_stages=V, stage_aux=stage_aux,
-                             stage_rng=stage_rng, rng=rng,
-                             row_offset=offset)
-        outputs, aux = res if stage_aux else (res, None)
         # The loss head runs outside the tick scan, so fetch tags inside
-        # it can surface (stage_fn tags cannot escape the scan — see
-        # autodist_tpu.fetches); head fetch values get the same
-        # last-stage masking as other head metrics.
+        # it can surface; head fetch values get the same last-stage
+        # masking as other head metrics.  The collector also spans
+        # pipeline_apply so a tag inside stage_fn — which CANNOT escape
+        # the tick scan — is caught as a dead tracer by the merge guard
+        # (loud error naming the tag) instead of silently vanishing
+        # while the sequential reference loss reports it.
         with _fetches.collecting() as fd:
+            res = pipeline_apply(stage_fn, local, x_in,
+                                 axis_name=pipe_axis,
+                                 num_microbatches=num_microbatches,
+                                 virtual_stages=V, stage_aux=stage_aux,
+                                 stage_rng=stage_rng, rng=rng,
+                                 row_offset=offset)
+            outputs, aux = res if stage_aux else (res, None)
             loss, metrics = loss_head(outputs, batch, shared) \
                 if has_shared else loss_head(outputs, batch)
         metrics = _fetches.merge_into_metrics(metrics, fd)
@@ -758,4 +768,5 @@ def lower_pipeline_ir(trainable, strategy, mesh):
                        else None),
         prologue=trainable.prologue,
         virtual_stages=V, stage_aux=trainable.stage_aux,
-        policies=policies, stage_rng=trainable.stage_rng)
+        policies=policies, stage_rng=trainable.stage_rng,
+        remat=bool(cfg.parallel.get("remat", False)))

@@ -40,6 +40,11 @@ def default_candidates() -> list[StrategyBuilder]:
         # and the candidate is skipped.
         parallel_builders.SequenceParallel(),
         parallel_builders.Pipeline(num_microbatches=4),
+        # Remat variant: survives the memory feasibility gate when the
+        # plain pipeline's activation envelope exceeds HBM (long
+        # pipelines); costs recompute FLOPs the time model doesn't see,
+        # so it only wins when the plain variant is infeasible.
+        parallel_builders.Pipeline(num_microbatches=4, remat=True),
         # Interleaved variant matches trainables with 2 chunks per pipe
         # device (num_stages == 2 x pipe axis); mismatches are skipped.
         parallel_builders.Pipeline(num_microbatches=4, virtual_stages=2),

@@ -402,9 +402,24 @@ class CostModel:
                 T = M * V + S - 1
                 comm += 2.0 * T * (tokens_local / M) * hidden * _ACT_BYTES
                 colls += 2 * T
+                # The [M, B/M, hidden] output buffer rides the tick scan
+                # on every device regardless of remat.
+                mem += tokens_local * hidden * _ACT_BYTES
+                remat = bool(strategy.graph_config.parallel.get(
+                    "remat", False))
                 if act_hint:
-                    # one microbatch's activations live per stage
-                    mem += act_hint * tokens_local / M
+                    if remat:
+                        # jax.checkpoint around each chunk: only the
+                        # chunk boundary inputs stay live across the
+                        # schedule — M*V executions x (tokens_local/M)
+                        # boundary tokens x hidden.
+                        mem += V * tokens_local * hidden * _ACT_BYTES
+                    else:
+                        # AD through the tick scan keeps every chunk
+                        # execution's residuals: M*V executions, each
+                        # holding its 1/(S*V) share of the per-token
+                        # fwd+bwd footprint -> act_hint*tokens_local/S.
+                        mem += act_hint * tokens_local / S
         else:  # expert
             E = mesh.get(const.EXPERT_AXIS, 1)
             # dense params replicate + sync over data x expert (PS ->

@@ -90,13 +90,19 @@ class Pipeline(StrategyBuilder):
     """
 
     def __init__(self, num_microbatches: int = 1, virtual_stages: int = 1,
-                 *, zero1: bool = False, compressor: str = "none"):
+                 *, zero1: bool = False, compressor: str = "none",
+                 remat: bool = False):
         if num_microbatches < 1:
             raise ValueError("num_microbatches must be >= 1")
         if virtual_stages < 1:
             raise ValueError("virtual_stages must be >= 1")
         self.num_microbatches = num_microbatches
         self.virtual_stages = virtual_stages
+        # Rematerialize each chunk in the backward (jax.checkpoint around
+        # stage_fn): per-chunk residuals shrink to the boundary
+        # activation, trading recompute FLOPs for the memory that
+        # otherwise grows with M x V chunk executions per device.
+        self.remat = remat
         self.make_sync = _default_sync(zero1, compressor)
 
     def build(self, trainable, resource_spec):
@@ -136,7 +142,8 @@ class Pipeline(StrategyBuilder):
         cfg = self._graph_config(resource_spec)
         cfg.lowering = "pipeline"
         cfg.parallel = {"num_microbatches": self.num_microbatches,
-                        "virtual_stages": self.virtual_stages}
+                        "virtual_stages": self.virtual_stages,
+                        "remat": self.remat}
         return Strategy(node_configs=nodes, graph_config=cfg)
 
 

@@ -175,3 +175,31 @@ def test_fetch_inside_scan_fails_loudly():
     with pytest.raises(ValueError, match="scan_h"):
         t.loss(t.params, None,
                {"x": jnp.ones((2, DIM), jnp.float32)}, None)
+
+
+def test_fetch_inside_pipeline_stage_fails_loudly():
+    """A tag inside stage_fn cannot escape the tick scan; the pipeline
+    lowering rejects it naming the tag (instead of silently dropping it
+    while the sequential reference loss reports it)."""
+    S, H = 4, 8
+    r = np.random.RandomState(0)
+    stacked = {"w": jnp.asarray(r.randn(S, H, H) * 0.4, jnp.float32)}
+
+    def stage(p, x):
+        h = jnp.tanh(x @ p["w"])
+        fetch("stage_h", jnp.linalg.norm(h))
+        return h
+
+    def head(outputs, b):
+        return jnp.mean((outputs - b["y"]) ** 2), {}
+
+    t = PipelineTrainable(stage, stacked, head, optax.sgd(0.05),
+                          num_stages=S)
+    runner = AutoDist(
+        {"topology": {"platform": "cpu", "num_devices": 8},
+         "mesh": {"data": 2, "pipe": 4}}, "Pipeline",
+        num_microbatches=2).build(t)
+    bh = {"x": r.randn(8, H).astype(np.float32),
+          "y": r.randn(8, H).astype(np.float32)}
+    with pytest.raises(Exception, match="stage_h"):
+        runner.step(bh)  # trace time: the tag is named in the error

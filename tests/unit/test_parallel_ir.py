@@ -253,10 +253,12 @@ def test_pipeline_strategy_serializes():
     strategy = ad.build_or_load_strategy(make_pipeline_trainable())
     assert strategy.graph_config.lowering == "pipeline"
     assert strategy.graph_config.parallel == {"num_microbatches": 2,
-                                              "virtual_stages": 1}
+                                              "virtual_stages": 1,
+                                              "remat": False}
     clone = Strategy.from_json(strategy.to_json())
     assert clone.graph_config.parallel == {"num_microbatches": 2,
-                                           "virtual_stages": 1}
+                                           "virtual_stages": 1,
+                                           "remat": False}
     # every stage variable is pipe-sharded in the IR
     for n in clone.node_configs:
         assert n.partitioner.spec[0] == "pipe"

@@ -458,3 +458,46 @@ def test_gspmd_zero1_shards_opt_state_and_matches():
     # on dim 0 when divisible.
     wo = mu1["encoder"]["layer_0"]["mlp"]["wo"]["kernel"]
     assert wo.sharding.spec == P(("model", "data"), None), wo.sharding.spec
+
+
+# --------------------------------------------------------------------------- #
+# Pipeline remat (Pipeline(remat=True))
+# --------------------------------------------------------------------------- #
+def test_pipeline_remat_matches_plain_numerics():
+    """jax.checkpoint around the chunks changes memory, not math: the
+    remat pipeline reproduces the plain pipeline exactly."""
+    r0 = AutoDist(PIPE_SPEC, "Pipeline", num_microbatches=2).build(
+        make_pipeline_trainable())
+    r1 = AutoDist(PIPE_SPEC, "Pipeline", num_microbatches=2,
+                  remat=True).build(make_pipeline_trainable())
+    bs = pipe_batches(3)
+    for b in bs:
+        r0.step(b)
+        r1.step(b)
+    assert_trees_close(r1.get_params(), r0.get_params(), rtol=1e-6,
+                       atol=1e-7)
+
+
+def test_cost_model_remat_rescues_infeasible_pipeline():
+    """VERDICT round-4 'done' bar: an infeasible-without-remat case
+    ranks Pipeline(remat=True) feasible (the activation envelope is
+    priced; remat shrinks it to boundary activations)."""
+    from autodist_tpu import PipelineTrainable
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.simulator.cost_model import CostModel
+    from autodist_tpu.strategy.parallel_builders import Pipeline
+
+    t = make_pipeline_trainable()
+    # Enormous per-token activation footprint vs tiny chip HBM: the
+    # plain pipeline's act_hint*tokens/S term blows the budget; remat's
+    # boundary-only term fits.
+    t.tokens_per_step = 1 << 16
+    t.act_bytes_per_token = 4e6
+    rs = ResourceSpec(PIPE_SPEC)
+    cm = CostModel(rs)
+    plain = cm.strategy_cost(t, Pipeline(num_microbatches=2).build(t, rs))
+    remat = cm.strategy_cost(
+        t, Pipeline(num_microbatches=2, remat=True).build(t, rs))
+    assert not plain.feasible
+    assert remat.feasible
+    assert remat.mem_bytes_per_device < plain.mem_bytes_per_device
