@@ -227,10 +227,16 @@ class AsyncPSRunner:
     PARAMS_KEY = "asyncps/params"
     VERSION_KEY = "asyncps/version"  # tiny: polled without moving the blob
 
+    # Host blob exchange is O(model size); warn above this (the honest
+    # scalability limit — beyond it use a synchronous ZeRO/FSDP strategy).
+    BLOB_WARN_BYTES = 256 << 20
+
     def __init__(self, trainable, *, staleness: int = 0,
                  rng: Optional[Any] = None, ssp_worker: Optional[str] = None,
                  ssp_num_workers: Optional[int] = None,
-                 is_chief: Optional[bool] = None):
+                 is_chief: Optional[bool] = None,
+                 publish_max_lag: int = 8,
+                 publish_max_interval_s: float = 0.1):
         from autodist_tpu.runtime import coordination
 
         if trainable.extra is not None:
@@ -238,6 +244,22 @@ class AsyncPSRunner:
                 "async PS does not support mutable extra state (batch "
                 "stats); train those models synchronously")
         self.trainable = trainable
+        # Param-publish gating: under a burst of queued gradients the PS
+        # serializes the whole tree at most once per `publish_max_lag`
+        # applied updates (or `publish_max_interval_s`), and always when
+        # the queue drains — so host serialization stops scaling with the
+        # push rate while pull-after-drain semantics stay exact.
+        self._publish_max_lag = max(int(publish_max_lag), 1)
+        self._publish_max_interval_s = float(publish_max_interval_s)
+        blob_bytes = sum(v.byte_size for v in trainable.var_infos())
+        if blob_bytes > self.BLOB_WARN_BYTES:
+            logging.warning(
+                "async PS exchanges whole-tree host blobs: %.0f MB per "
+                "push/publish. Expect seconds per update at this size — "
+                "the async path is a semantics-parity feature, not a "
+                "large-model transport; use a synchronous ZeRO/FSDP "
+                "strategy beyond ~%d MB",
+                blob_bytes / 1e6, self.BLOB_WARN_BYTES >> 20)
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._host_step = 0
         self._closed = False
@@ -330,12 +352,28 @@ class AsyncPSRunner:
         self._client.put(self.VERSION_KEY, struct.pack("<q", 0))
         coord_addr = os.environ.get("AUTODIST_TPU_COORD_SERVICE", "")
 
+        lag = self._publish_max_lag
+        interval = self._publish_max_interval_s
+        self.ps_publish_count = 0  # observable for tests/diagnostics
+
         def loop():
             from autodist_tpu.runtime.coordination import CoordClient
             nonlocal ps_params, ps_opt_state
             host, _, port = coord_addr.rpartition(":")
             ps_client = CoordClient(host or "127.0.0.1", int(port))
             version = 0
+            published = 0
+            last_pub = time.time()
+
+            def publish():
+                nonlocal published, last_pub
+                ps_client.put(self.PARAMS_KEY,
+                              _pack_tree(version, ps_params))
+                ps_client.put(self.VERSION_KEY, struct.pack("<q", version))
+                published = version
+                last_pub = time.time()
+                self.ps_publish_count += 1
+
             while not self._ps_stop_event.is_set():
                 try:
                     msg = ps_client.queue_get(self.GRADS_QUEUE,
@@ -344,14 +382,25 @@ class AsyncPSRunner:
                     break  # service shut down
                 if msg is None:
                     continue
-                _, grads = _unpack_tree(msg, ps_params)
-                updates, ps_opt_state = apply_fn(grads, ps_opt_state,
-                                                 ps_params)
-                ps_params = optax.apply_updates(ps_params, updates)
-                version += 1
-                ps_client.put(self.PARAMS_KEY,
-                              _pack_tree(version, ps_params))
-                ps_client.put(self.VERSION_KEY, struct.pack("<q", version))
+                # Drain the burst, publishing at most every `lag` applied
+                # updates / `interval` seconds; one publish after the
+                # drain keeps pull-after-wait_applied semantics exact.
+                while msg is not None and not self._ps_stop_event.is_set():
+                    _, grads = _unpack_tree(msg, ps_params)
+                    updates, ps_opt_state = apply_fn(grads, ps_opt_state,
+                                                     ps_params)
+                    ps_params = optax.apply_updates(ps_params, updates)
+                    version += 1
+                    if (version - published >= lag
+                            or time.time() - last_pub > interval):
+                        publish()
+                    try:
+                        msg = ps_client.queue_get(self.GRADS_QUEUE,
+                                                  timeout_ms=0)
+                    except OSError:
+                        msg = None
+                if version > published:
+                    publish()
             ps_client.close()
 
         self._ps_thread = threading.Thread(target=loop, daemon=True,

@@ -165,3 +165,71 @@ def test_ssp_gate_through_runner_step():
         from autodist_tpu.runtime import coordination
         coordination.reset_service_client()
         server.stop()
+
+
+def test_async_ps_burst_publishes_fewer_than_applies():
+    """Publish gating (round-4 Weak #3): a backlog of queued gradients
+    is applied with at most one params serialization per
+    publish_max_lag updates (+ the drain publish) — deterministic: the
+    backlog is enqueued BEFORE the PS loop exists, so the PS always
+    sees a 24-deep queue."""
+    import os
+
+    from autodist_tpu.runner import _pack_tree
+    from autodist_tpu.runtime import coordination
+
+    t = make_trainable()
+    server = coordination.CoordServer()
+    prev = os.environ.get("AUTODIST_TPU_COORD_SERVICE")
+    os.environ["AUTODIST_TPU_COORD_SERVICE"] = f"127.0.0.1:{server.port}"
+    coordination.reset_service_client()
+    runner = None
+    try:
+        client = coordination.service_client()
+        g = jax.tree.map(lambda p: np.full(p.shape, 0.01, np.float32),
+                         t.params)
+        for i in range(24):
+            client.queue_put(AsyncPSRunner.GRADS_QUEUE, _pack_tree(i, g))
+
+        runner = AsyncPSRunner(t, publish_max_lag=8,
+                               publish_max_interval_s=3600.0)
+        runner.wait_applied(24, timeout_s=60.0)
+        # lag publishes at versions 8, 16, 24; drain adds none (24 is
+        # already published) — allow the one extra for scheduling skew.
+        assert runner.ps_publish_count <= 4, runner.ps_publish_count
+        # every update is SGD with the constant grad: exact expectation
+        expected = jax.tree.map(
+            lambda p, gg: np.asarray(p) - 0.1 * 24 * gg, t.params, g)
+        jax.tree.map(
+            lambda a, e: np.testing.assert_allclose(
+                np.asarray(a), e, rtol=1e-5, atol=1e-6),
+            runner.get_params(), expected)
+    finally:
+        if runner is not None:
+            runner.close()
+        if prev is None:
+            os.environ.pop("AUTODIST_TPU_COORD_SERVICE", None)
+        else:
+            os.environ["AUTODIST_TPU_COORD_SERVICE"] = prev
+        coordination.reset_service_client()
+        server.stop()
+
+
+def test_async_ps_exactness_survives_publish_gating():
+    """The 1-worker == sync SGD exactness golden with gating active:
+    pull-after-wait_applied sees the drain publish."""
+    t = make_trainable()
+    runner = AsyncPSRunner(t, publish_max_lag=8,
+                           publish_max_interval_s=3600.0)
+    try:
+        bs = [make_batch(seed=i) for i in range(4)]
+        for i, b in enumerate(bs):
+            runner.step(b)
+            runner.wait_applied(i + 1, timeout_s=30.0)
+        expected = single_device_reference(make_trainable(), bs)
+        jax.tree.map(
+            lambda a, e: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), rtol=1e-5, atol=1e-6),
+            runner.get_params(), jax.device_get(expected))
+    finally:
+        runner.close()
