@@ -365,27 +365,40 @@ class AsyncPSRunner:
             published = 0
             last_pub = time.time()
 
-            def publish():
+            def publish() -> bool:
+                """False when the service is gone (exit the loop cleanly
+                instead of dying on an uncaught OSError)."""
                 nonlocal published, last_pub
-                ps_client.put(self.PARAMS_KEY,
-                              _pack_tree(version, ps_params))
-                ps_client.put(self.VERSION_KEY, struct.pack("<q", version))
+                try:
+                    ps_client.put(self.PARAMS_KEY,
+                                  _pack_tree(version, ps_params))
+                    ps_client.put(self.VERSION_KEY,
+                                  struct.pack("<q", version))
+                except OSError:
+                    return False
                 published = version
                 last_pub = time.time()
                 self.ps_publish_count += 1
+                return True
 
-            while not self._ps_stop_event.is_set():
+            alive = True
+            while alive and not self._ps_stop_event.is_set():
                 try:
                     msg = ps_client.queue_get(self.GRADS_QUEUE,
                                               timeout_ms=200)
                 except OSError:
                     break  # service shut down
                 if msg is None:
+                    if version > published and not publish():
+                        break
                     continue
                 # Drain the burst, publishing at most every `lag` applied
                 # updates / `interval` seconds; one publish after the
                 # drain keeps pull-after-wait_applied semantics exact.
-                while msg is not None and not self._ps_stop_event.is_set():
+                # A popped message is ALWAYS applied (the pop is
+                # destructive — dropping it on a stop-event race would
+                # lose the update); the stop event only ends the drain.
+                while msg is not None:
                     _, grads = _unpack_tree(msg, ps_params)
                     updates, ps_opt_state = apply_fn(grads, ps_opt_state,
                                                      ps_params)
@@ -393,14 +406,19 @@ class AsyncPSRunner:
                     version += 1
                     if (version - published >= lag
                             or time.time() - last_pub > interval):
-                        publish()
+                        if not publish():
+                            alive = False
+                            break
+                    if self._ps_stop_event.is_set():
+                        break
                     try:
                         msg = ps_client.queue_get(self.GRADS_QUEUE,
                                                   timeout_ms=0)
                     except OSError:
-                        msg = None
-                if version > published:
-                    publish()
+                        alive = False
+                        break
+                if alive and version > published and not publish():
+                    break
             ps_client.close()
 
         self._ps_thread = threading.Thread(target=loop, daemon=True,
