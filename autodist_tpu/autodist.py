@@ -65,6 +65,32 @@ class AutoDist:
 
         strategy_id = const.ENV.AUTODIST_TPU_STRATEGY_ID.val
         client = coordination.service_client()
+        if not IS_CHIEF and client is not None and not strategy_id:
+            # Measured-refinement rendezvous: a worker launched without a
+            # strategy id whose builder is a measuring AutoStrategy joins
+            # the chief's candidate-timing loop (every process must
+            # participate in the SPMD steps) and adopts the published
+            # winner (simulator/auto_strategy.py:_measure_multihost).
+            from autodist_tpu.simulator.auto_strategy import AutoStrategy
+            sb = self.strategy_builder
+            if (isinstance(sb, AutoStrategy) and sb.measure_top_k > 1
+                    and sb.example_batch is not None):
+                winner = sb.join_measurement(trainable, self)
+                if winner is not None:
+                    logging.info("strategy (measured winner):\n%s", winner)
+                    return winner
+                # Falling through would run the CHIEF planning path on a
+                # worker — bumping the shared generation counter and
+                # stalling alone at a join barrier.  With no strategy id
+                # there is nothing sensible to load: fail fast
+                # (framework policy §5.3) so the launcher's watcher
+                # restarts or kills the job.
+                raise RuntimeError(
+                    "worker failed to join the AutoStrategy measurement "
+                    "rendezvous (chief fell back, a peer died, or the "
+                    "join timed out) and no AUTODIST_TPU_STRATEGY_ID is "
+                    "set; relaunch workers, or launch them with a fixed "
+                    "strategy id to skip measured refinement")
         if not IS_CHIEF and strategy_id:
             if client is not None:
                 try:

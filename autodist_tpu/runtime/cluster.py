@@ -220,11 +220,16 @@ class Cluster:
 
         ``strategy`` is the built Strategy object (published to the
         coordination service so workers without a shared filesystem can
-        load it) or a bare strategy-id string (env handoff only).
+        load it), a bare strategy-id string (env handoff only), or
+        ``None`` — the strategy is decided *after* workers join (the
+        AutoStrategy measured-refinement flow, where every process must
+        participate in timing the candidates before a winner exists).
         """
         if not self.is_chief:
             return []
-        strategy_id = strategy if isinstance(strategy, str) else strategy.id
+        strategy_id = ("" if strategy is None
+                       else strategy if isinstance(strategy, str)
+                       else strategy.id)
         coord_addr = ""
         if self._use_coord_service:
             try:
@@ -233,7 +238,8 @@ class Cluster:
                 logging.warning(
                     "coordination service unavailable (%s); workers fall "
                     "back to the shared strategy dir", e)
-        if coord_addr and not isinstance(strategy, str):
+        if coord_addr and strategy is not None \
+                and not isinstance(strategy, str):
             from autodist_tpu.runtime.coordination import service_client
             client = service_client()
             if client is not None:
