@@ -106,7 +106,7 @@ def _merge_chunks(o_a, lse_a, o_b, lse_b):
 
 def ring_flash_attention(q, k, v, *, axis_name: str, causal: bool = False,
                          scale: Optional[float] = None,
-                         block_q: int = 128, block_k: int = 128):
+                         block_q=None, block_k=None):
     """Ring attention with the Pallas flash kernel as the per-chunk
     compute: never materializes [Lc, Lc] scores in HBM, so the win over
     :func:`ring_self_attention` grows with the local chunk length.
@@ -179,8 +179,8 @@ def make_ring_attention_fn(*, seq_axis: str = "seq", causal: bool = False):
 
 
 def make_ring_flash_attention_fn(*, seq_axis: str = "seq",
-                                 causal: bool = False, block_q: int = 128,
-                                 block_k: int = 128):
+                                 causal: bool = False, block_q=None,
+                                 block_k=None):
     """Like :func:`make_ring_attention_fn` with the Pallas flash kernel
     per chunk — the long-chunk configuration (HBM-bound per-chunk scores
     are what the fused kernel removes)."""

@@ -206,6 +206,7 @@ def _bench(dog):
     # config and score the winner by examples/sec.  A config that OOMs
     # just loses its probe.
     from autodist_tpu.ops import make_attention_fn
+    from autodist_tpu.ops.flash_attention import flash_wins
     attn_impls = {"einsum": None}
     if on_accel:
         attn_impls["flash"] = make_attention_fn(causal=False)
@@ -214,11 +215,22 @@ def _bench(dog):
         # probes whether HBM still has room — an OOM just loses its
         # probe), flash only at batch 32 (flash at the base batch
         # already measured slower than einsum on v5e, BASELINE.md
-        # round-3 table).
+        # round-3 table).  A committed flash_tuning.json settles the
+        # flash question without burning a probe: measured-lost at this
+        # length drops the flash candidate, measured-won probes it at
+        # the base batch too.
         candidates = [("einsum", batch_per_chip),
                       ("einsum", 2 * batch_per_chip),
-                      ("einsum", 4 * batch_per_chip),
-                      ("flash", 2 * batch_per_chip)]
+                      ("einsum", 4 * batch_per_chip)]
+        fw = flash_wins(seq_len, causal=False)
+        if fw is True:
+            candidates += [("flash", batch_per_chip),
+                           ("flash", 2 * batch_per_chip)]
+        elif fw is None:
+            candidates.append(("flash", 2 * batch_per_chip))
+        else:
+            print("# flash_tuning.json: einsum wins at this length; "
+                  "skipping flash probe", flush=True)
     else:
         candidates = [("einsum", batch_per_chip)]
     rates = {}     # config -> examples/sec from the probe

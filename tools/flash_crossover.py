@@ -72,6 +72,11 @@ def main():
     ap.add_argument("--blocks", default="128,256,512",
                     help="flash block sizes to try (best reported)")
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--write", default="",
+                    help="merge results into this flash_tuning.json "
+                         "(per-length best blocks + crossover_len; the "
+                         "kernel's default blocks and the flash_wins() "
+                         "helper read it — commit it at the repo root)")
     args = ap.parse_args()
 
     H, D = args.heads, args.head_dim
@@ -128,6 +133,48 @@ def main():
         "backend": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
     }))
+    if args.write:
+        # Merge into the tuning table the kernel reads, PER LENGTH:
+        # previously measured lengths (and the other causal-ness branch)
+        # are preserved; lengths where flash failed to run write nothing
+        # — a measurement failure must stay distinguishable from "flash
+        # measured and lost" (flash_wins derives the verdict from the
+        # per-length speedup records at read time).
+        ok = [r for r in records
+              if r["flash_block"] and r["flash_speedup"] is not None]
+        if not ok:
+            print("# no successful flash timing; tuning table unchanged",
+                  file=sys.stderr)
+        else:
+            table = {}
+            if os.path.exists(args.write):
+                try:
+                    with open(args.write) as f:
+                        loaded = json.load(f)
+                    table = loaded if isinstance(loaded, dict) else {}
+                except (OSError, ValueError):
+                    table = {}
+            key = "causal" if causal else "noncausal"
+            branch = table.get(key)
+            branch = dict(branch) if isinstance(branch, dict) else {}
+            blocks = branch.get("blocks")
+            blocks = dict(blocks) if isinstance(blocks, dict) else {}
+            speedup = branch.get("speedup")
+            speedup = dict(speedup) if isinstance(speedup, dict) else {}
+            for r in ok:
+                blocks[str(r["seq"])] = r["flash_block"]
+                speedup[str(r["seq"])] = r["flash_speedup"]
+            branch["blocks"] = blocks
+            branch["speedup"] = speedup
+            measured_wins = sorted(int(k) for k, v in speedup.items()
+                                   if v > 1.0)
+            branch["crossover_len"] = (measured_wins[0] if measured_wins
+                                       else None)
+            table[key] = branch
+            table["device_kind"] = jax.devices()[0].device_kind
+            with open(args.write, "w") as f:
+                json.dump(table, f, indent=1)
+            print(f"# wrote {args.write}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -26,8 +26,13 @@
 #   1. bench.py                      -> /tmp/hw_bench.json      (headline MFU)
 #   2. examples/benchmark/imagenet.py -> /tmp/hw_resnet50.out   (images/sec/chip)
 #   3. tools/calibrate_compressors.py -> /tmp/hw_calib.out      (calibration.json input)
-#   4. tools/flash_crossover.py       -> /tmp/hw_flash_causal.out (flash-vs-einsum curve)
-# Results must then be recorded in BASELINE.md and calibration.json committed.
+#   4. tools/flash_crossover.py --causal --write flash_tuning.json
+#                                     -> /tmp/hw_flash_causal.out
+#   5. tools/flash_crossover.py --write flash_tuning.json (non-causal)
+#                                     -> /tmp/hw_flash_noncausal.out
+# Afterwards: record results in BASELINE.md; COMMIT calibration.json AND
+# flash_tuning.json (the kernel's default block sizes and the bench's
+# flash-vs-einsum choice read the committed table).
 LOG=${HW_SESSION_LOG:-/tmp/hw_session.log}
 echo "$(date -u +%H:%M:%S) session start" >> "$LOG"
 cd "$(dirname "$0")/.."
@@ -51,9 +56,14 @@ while true; do
       timeout 1500 python tools/calibrate_compressors.py \
         > /tmp/hw_calib.out 2>/tmp/hw_calib.err
       echo "$(date -u +%H:%M:%S) calib rc=$?" >> "$LOG"
-      timeout 2400 python tools/flash_crossover.py --causal \
+      timeout 1500 python tools/flash_crossover.py --causal \
+        --write flash_tuning.json \
         > /tmp/hw_flash_causal.out 2>/tmp/hw_flash_causal.err
-      echo "$(date -u +%H:%M:%S) flash rc=$?" >> "$LOG"
+      echo "$(date -u +%H:%M:%S) flash-causal rc=$?" >> "$LOG"
+      timeout 1500 python tools/flash_crossover.py \
+        --write flash_tuning.json \
+        > /tmp/hw_flash_noncausal.out 2>/tmp/hw_flash_noncausal.err
+      echo "$(date -u +%H:%M:%S) flash-noncausal rc=$?" >> "$LOG"
       echo "$(date -u +%H:%M:%S) queue complete" >> "$LOG"
       exit 0
     fi
