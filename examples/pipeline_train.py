@@ -23,6 +23,11 @@ def main():
     ap.add_argument("--virtual-stages", type=int, default=1)
     ap.add_argument("--microbatches", type=int, default=2)
     ap.add_argument("--accum-steps", type=int, default=1)
+    ap.add_argument("--zero1", action="store_true",
+                    help="ZeRO-1: shard optimizer state over the data "
+                         "axes (stage vars) / pipe x data (shared vars)")
+    ap.add_argument("--remat", action="store_true",
+                    help="jax.checkpoint each chunk (memory for compute)")
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--batch", type=int, default=32)
     args = ap.parse_args()
@@ -57,7 +62,8 @@ def main():
     trainable = PipelineTrainable(stage, stacked, head, optax.adam(1e-3),
                                   num_stages=C)
     builder = Pipeline(num_microbatches=args.microbatches,
-                       virtual_stages=args.virtual_stages)
+                       virtual_stages=args.virtual_stages,
+                       zero1=args.zero1, remat=args.remat)
     if args.accum_steps > 1:
         builder = GradAccumulation(builder, steps=args.accum_steps)
     mesh = {"data": dp, "pipe": pp} if dp > 1 else {"pipe": pp}
