@@ -145,7 +145,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     block_q = q_ref.shape[1]
     head_dim = q_ref.shape[2]
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
+    # Matmul inputs stay in their native dtype (bf16 runs the MXU at
+    # full rate; an fp32 upcast would halve it) with fp32 accumulation
+    # via preferred_element_type; softmax statistics are fp32 throughout.
+    q = q_ref[0]                              # [BQ, D]
 
     num_kb = pl.cdiv(seq_len, block_k)
     if causal:
@@ -154,11 +157,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
 
     def body(kb, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [BQ, BK]
+            preferred_element_type=jnp.float32) * scale  # [BQ, BK] fp32
         cols = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         if causal:
@@ -172,7 +175,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
         p = jnp.exp(s - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -238,8 +241,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, g_ref, dq_ref,
     """One (batch·head, q-block) program: dq via recompute over k blocks."""
     block_q = q_ref.shape[1]
     iq = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)        # [BQ, D]
-    g = g_ref[0].astype(jnp.float32)        # [BQ, D]
+    # Native-dtype matmul inputs (bf16 at full MXU rate), fp32
+    # accumulation + fp32 softmax math — same policy as the forward.
+    q = q_ref[0]                            # [BQ, D]
+    g = g_ref[0]                            # [BQ, D]
     lse = lse_ref[0]                        # [BQ, 1]
     delta = delta_ref[0]                    # [BQ, 1]
 
@@ -248,8 +253,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, g_ref, dq_ref,
         num_kb = jnp.minimum(num_kb, pl.cdiv((iq + 1) * block_q, block_k))
 
     def body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         cols = kb * block_k + jax.lax.broadcasted_iota(
@@ -265,7 +270,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, g_ref, dq_ref,
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     dq0 = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
@@ -281,16 +286,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, g_ref,
     block_k = k_ref.shape[1]
     head_dim = k_ref.shape[2]
     ik = pl.program_id(1)
-    k_blk = k_ref[0].astype(jnp.float32)    # [BK, D]
-    v_blk = v_ref[0].astype(jnp.float32)    # [BK, D]
+    # Native-dtype matmul inputs, fp32 accumulation (see _fwd_kernel).
+    k_blk = k_ref[0]                        # [BK, D]
+    v_blk = v_ref[0]                        # [BK, D]
 
     num_qb = pl.cdiv(seq_len, block_q)
     qb0 = (ik * block_k) // block_q if causal else 0
 
     def body(qb, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        g = g_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        g = g_ref[0, pl.ds(qb * block_q, block_q), :]
         lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]     # [BQ, 1]
         delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
@@ -305,13 +311,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, lse_ref, delta_ref, g_ref,
             s = jnp.where(cols < valid_len, s, NEG_INF)
         p = jnp.exp(s - lse)                                   # [BQ, BK]
         dv = dv + jax.lax.dot_general(
-            p, g, (((0,), (0,)), ((), ())),
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                # [BK, D]
         dp = jax.lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         dk = dk + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk, dv
 

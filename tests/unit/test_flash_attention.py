@@ -275,3 +275,41 @@ def test_flash_wins_prefers_per_length_speedups(tmp_path, monkeypatch):
     finally:
         monkeypatch.delenv("AUTODIST_TPU_FLASH_TUNING")
         fa.load_tuning(reload=True)
+
+
+def test_flash_bf16_inputs_match_einsum_reference():
+    """bf16 q/k/v (the bench/crossover operating dtype): matmul inputs
+    stay bf16 (full MXU rate) with fp32 accumulation + fp32 softmax —
+    forward and grads match a reference that computes the same
+    mixed-precision einsum attention."""
+    r = np.random.RandomState(3)
+    B, L, H, D = 2, 128, 2, 32
+    q, k, v = (jnp.asarray(r.randn(B, L, H, D), jnp.bfloat16)
+               for _ in range(3))
+
+    def ref(q, k, v):
+        s = jnp.einsum("blhd,bmhd->bhlm", q, k,
+                       preferred_element_type=jnp.float32) / np.sqrt(D)
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        p = jax.nn.softmax(jnp.where(mask[None, None], s, -1e30), -1)
+        return jnp.einsum("bhlm,bmhd->blhd", p.astype(jnp.bfloat16), v,
+                          preferred_element_type=jnp.float32)
+
+    out = flash_attention(q, k, v, causal=True).astype(jnp.float32)
+    expected = ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=0.05, atol=0.02)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(ref(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, e in zip(gf, gr):
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float32), np.asarray(e, np.float32),
+            rtol=0.1, atol=0.05)

@@ -34,10 +34,32 @@
 # flash_tuning.json (the kernel's default block sizes and the bench's
 # flash-vs-einsum choice read the committed table).
 LOG=${HW_SESSION_LOG:-/tmp/hw_session.log}
-echo "$(date -u +%H:%M:%S) session start" >> "$LOG"
+# HW_SESSION_DEADLINE (epoch seconds): exit before it so this watcher can
+# never contend with an externally launched bench (e.g. the round driver's
+# end-of-round bench.py run) — the single-client lesson of round 4.
+DEADLINE=${HW_SESSION_DEADLINE:-0}
+echo "$(date -u +%H:%M:%S) session start (deadline=$DEADLINE)" >> "$LOG"
 cd "$(dirname "$0")/.."
+
+# have_time BUDGET: true iff a step bounded by BUDGET seconds finishes
+# before the deadline.  Checked before EVERY queue step, not just at the
+# top of the loop — a queue that starts near the deadline must stop
+# between steps rather than overrun it by hours.
+have_time() {
+  [ "$DEADLINE" -le 0 ] && return 0
+  [ $(( $(date +%s) + $1 )) -lt "$DEADLINE" ]
+}
+
 while true; do
+  if ! have_time 130; then
+    echo "$(date -u +%H:%M:%S) deadline reached — exiting" >> "$LOG"
+    exit 0
+  fi
   if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    if ! have_time 2510; then
+      echo "$(date -u +%H:%M:%S) healthy but no time for bench — exiting" >> "$LOG"
+      exit 0
+    fi
     echo "$(date -u +%H:%M:%S) tunnel healthy — starting queue" >> "$LOG"
     timeout 2500 python bench.py > /tmp/hw_bench.json 2>/tmp/hw_bench.err
     echo "$(date -u +%H:%M:%S) bench rc=$? $(tail -c 300 /tmp/hw_bench.json)" >> "$LOG"
@@ -46,20 +68,27 @@ while true; do
     # when the backend was unavailable); otherwise the window was
     # illusory; go back to waiting.  A low-but-real MFU still advances
     # the queue: calibration/crossover validity doesn't depend on it.
+    # Every later step re-checks the deadline (have_time) so a queue
+    # that started late stops BETWEEN steps instead of overrunning into
+    # an externally launched bench.
     if ! grep -q '"error"' /tmp/hw_bench.json \
         && grep -q '"value"' /tmp/hw_bench.json \
         && ! grep -q '"value": 0\.0[,}]' /tmp/hw_bench.json; then
+      have_time 1810 || { echo "$(date -u +%H:%M:%S) deadline — stop after bench" >> "$LOG"; exit 0; }
       timeout 1800 python examples/benchmark/imagenet.py --model resnet50 \
         --train-steps 30 --warmup-steps 3 --json \
         > /tmp/hw_resnet50.out 2>/tmp/hw_resnet50.err
       echo "$(date -u +%H:%M:%S) resnet50 rc=$?" >> "$LOG"
+      have_time 1510 || { echo "$(date -u +%H:%M:%S) deadline — stop after resnet" >> "$LOG"; exit 0; }
       timeout 1500 python tools/calibrate_compressors.py \
         > /tmp/hw_calib.out 2>/tmp/hw_calib.err
       echo "$(date -u +%H:%M:%S) calib rc=$?" >> "$LOG"
+      have_time 1510 || { echo "$(date -u +%H:%M:%S) deadline — stop after calib" >> "$LOG"; exit 0; }
       timeout 1500 python tools/flash_crossover.py --causal \
         --write flash_tuning.json \
         > /tmp/hw_flash_causal.out 2>/tmp/hw_flash_causal.err
       echo "$(date -u +%H:%M:%S) flash-causal rc=$?" >> "$LOG"
+      have_time 1510 || { echo "$(date -u +%H:%M:%S) deadline — stop after flash-causal" >> "$LOG"; exit 0; }
       timeout 1500 python tools/flash_crossover.py \
         --write flash_tuning.json \
         > /tmp/hw_flash_noncausal.out 2>/tmp/hw_flash_noncausal.err
