@@ -130,23 +130,31 @@ class AutoDist:
               strategy: Optional[Strategy] = None) -> Lowered:
         strategy = strategy or self.build_or_load_strategy(trainable)
         kind = strategy.graph_config.lowering
+        if kind == "collective":
+            return lower(trainable, strategy, self.mesh)
         if kind == "gspmd":
             from autodist_tpu.kernel.gspmd import lower_gspmd
-            return lower_gspmd(trainable, strategy, self.mesh)
-        if kind == "sequence":
+            lowered = lower_gspmd(trainable, strategy, self.mesh)
+        elif kind == "sequence":
             from autodist_tpu.parallel.sequence import lower_sequence_ir
-            return lower_sequence_ir(trainable, strategy, self.mesh)
-        if kind == "pipeline":
+            lowered = lower_sequence_ir(trainable, strategy, self.mesh)
+        elif kind == "pipeline":
             from autodist_tpu.parallel.pipeline import lower_pipeline_ir
-            return lower_pipeline_ir(trainable, strategy, self.mesh)
-        if kind == "expert":
+            lowered = lower_pipeline_ir(trainable, strategy, self.mesh)
+        elif kind == "expert":
             from autodist_tpu.parallel.moe import lower_expert_ir
-            return lower_expert_ir(trainable, strategy, self.mesh)
-        if kind != "collective":
+            lowered = lower_expert_ir(trainable, strategy, self.mesh)
+        else:
             raise ValueError(
                 f"unknown lowering {kind!r}; expected one of 'collective', "
                 "'gspmd', 'sequence', 'pipeline', 'expert'")
-        return lower(trainable, strategy, self.mesh)
+        # SSP bound stamped ONCE at the dispatch site (the collective
+        # path carries it in its Plan): a future lowering added above
+        # gets the host gate automatically instead of silently shipping
+        # staleness=0.
+        from autodist_tpu.parallel._spmd import ssp_staleness_from
+        lowered.ssp_staleness = ssp_staleness_from(strategy)
+        return lowered
 
     def build(self, trainable: Trainable,
               strategy: Optional[Strategy] = None, *,

@@ -69,16 +69,12 @@ def lower_gspmd(trainable: Trainable, strategy: Strategy, mesh) -> GspmdLowered:
     from autodist_tpu.strategy.ir import PSSynchronizer
 
     for n in strategy.node_configs:
-        if isinstance(n.synchronizer, PSSynchronizer):
-            if not n.synchronizer.sync:
-                raise NotImplementedError(
-                    f"PS(sync=False) on {n.var_name}: asynchronous "
-                    "training does not lower to one SPMD program; build "
-                    "through AutoDist (AsyncPSRunner) or use sync=True")
-            if n.synchronizer.staleness > 0:
-                raise NotImplementedError(
-                    f"PS(staleness>0) on {n.var_name}: SSP gating is "
-                    "implemented for the collective lowering only")
+        if isinstance(n.synchronizer, PSSynchronizer) \
+                and not n.synchronizer.sync:
+            raise NotImplementedError(
+                f"PS(sync=False) on {n.var_name}: asynchronous "
+                "training does not lower to one SPMD program; build "
+                "through AutoDist (AsyncPSRunner) or use sync=True")
     ps_vars = {n.var_name for n in strategy.node_configs
                if isinstance(n.synchronizer, PSSynchronizer)}
     ignored = sorted({

@@ -398,6 +398,10 @@ class SimpleLowered:
     plan: Any = None
     eval_fn: Any = None
     batch_spec_fn: Any = None
+    # SSP bound from PS(staleness>0) node configs — the runner's host
+    # gate is lowering-agnostic, so parallel/gspmd lowerings carry the
+    # bound here instead of a Plan.
+    ssp_staleness: int = 0
 
     def init_state(self, params=None, extra=None, trainable=None):
         params = params if params is not None else trainable.params

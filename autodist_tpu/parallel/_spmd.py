@@ -62,6 +62,18 @@ class VarPolicy:
     scale: float = 1.0
 
 
+def ssp_staleness_from(strategy) -> int:
+    """Max PS ``staleness`` over the strategy's node configs — the
+    bound the runner's host-side SSP gate enforces (the gate is
+    lowering-agnostic: inside one SPMD process group the program is
+    lockstep anyway; the gate bounds skew between processes)."""
+    from autodist_tpu.strategy.ir import PSSynchronizer
+
+    return max((nc.synchronizer.staleness for nc in strategy.node_configs
+                if isinstance(nc.synchronizer, PSSynchronizer)
+                and nc.synchronizer.sync), default=0)
+
+
 def policies_from_node_configs(strategy, mesh, *, replicated_axes,
                                axes_for: Optional[Callable] = None,
                                scale_for: Optional[Callable] = None,
@@ -93,10 +105,6 @@ def policies_from_node_configs(strategy, mesh, *, replicated_axes,
                     "not lower to a synchronous SPMD program; build through "
                     "AutoDist (which dispatches to AsyncPSRunner) or use "
                     "sync=True")
-            if sync.staleness > 0:
-                raise NotImplementedError(
-                    f"PS(staleness>0) on {name}: SSP gating is implemented "
-                    "for the collective lowering only")
             if name in sharded_vars:
                 logging.warning(
                     "%s: parameter is stored sharded by this lowering; its "

@@ -53,7 +53,8 @@ class DistributedRunner:
         coordination service is reachable.  Inside one SPMD process group
         the program is lockstep regardless; the gate bounds skew *between*
         processes of the job."""
-        staleness = getattr(self.lowered.plan, "ssp_staleness", 0)
+        staleness = (getattr(self.lowered.plan, "ssp_staleness", 0)
+                     or getattr(self.lowered, "ssp_staleness", 0))
         if staleness <= 0:
             return None
         from autodist_tpu.runtime import coordination

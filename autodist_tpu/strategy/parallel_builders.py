@@ -27,21 +27,43 @@ from autodist_tpu.strategy.ir import (AllReduceSynchronizer, NodeConfig,
                                       Strategy)
 
 
-def _default_sync(zero1: bool, compressor: str):
-    """The per-variable synchronizer a parallel builder emits: PS ≙
-    ZeRO-1 sharded optimizer state (the reference's PS semantics on TPU,
-    ``ir.py:56-73``), AllReduce with an optional compressor otherwise.
-    Heterogeneous per-variable mixes (the reference's Parallax trick,
-    ``parallax_strategy.py:24-71``) remain available by editing the
-    emitted node configs before ``AutoDist.build``."""
+def _default_sync(zero1: bool, compressor: str,
+                  zero_min_bytes=None):
+    """The per-variable synchronizer a parallel builder emits, as a
+    function of the variable's :class:`~autodist_tpu.capture.VarInfo`:
+    PS ≙ ZeRO-1 sharded optimizer state (the reference's PS semantics on
+    TPU, ``ir.py:56-73``), AllReduce with an optional compressor
+    otherwise.
+
+    ``zero_min_bytes`` is the heterogeneous Parallax-style mix
+    (``parallax_strategy.py:24-71``): variables at or above the
+    threshold get ZeRO-1, smaller ones the (optionally compressed)
+    allreduce — the classic big-tensors-sharded / small-tensors-cheap
+    split, per variable in the serialized strategy.  Arbitrary mixes
+    remain available by editing the emitted node configs before
+    ``AutoDist.build``."""
     if zero1 and compressor not in ("", "none"):
         raise ValueError(
             "zero1 and compressor are mutually exclusive per variable: "
             "PS (ZeRO-1) sync reduces at full precision; compression is "
-            "an AllReduce knob")
-    if zero1:
-        return lambda: PSSynchronizer()
-    return lambda: AllReduceSynchronizer(compressor=compressor or "none")
+            "an AllReduce knob (zero_min_bytes composes them: large "
+            "vars ZeRO, small vars compressed)")
+    if zero1 and zero_min_bytes is not None:
+        raise ValueError(
+            "zero1=True already applies ZeRO-1 to every variable; a "
+            "zero_min_bytes threshold would be a silent no-op — pass "
+            "only zero_min_bytes for the size-split mix")
+    comp = compressor or "none"
+
+    def sync_for(info):
+        if zero_min_bytes is not None \
+                and info.byte_size >= zero_min_bytes:
+            return PSSynchronizer()
+        if zero1:
+            return PSSynchronizer()
+        return AllReduceSynchronizer(compressor=comp)
+
+    return sync_for
 
 
 class SequenceParallel(StrategyBuilder):
@@ -56,9 +78,10 @@ class SequenceParallel(StrategyBuilder):
     """
 
     def __init__(self, seq_leaves: Sequence[str] = ("x", "y"), *,
-                 zero1: bool = False, compressor: str = "none"):
+                 zero1: bool = False, compressor: str = "none",
+                 zero_min_bytes=None):
         self.seq_leaves = tuple(seq_leaves)
-        self.make_sync = _default_sync(zero1, compressor)
+        self.make_sync = _default_sync(zero1, compressor, zero_min_bytes)
 
     def build(self, trainable, resource_spec):
         shape = resource_spec.resolved_mesh_shape()
@@ -68,7 +91,7 @@ class SequenceParallel(StrategyBuilder):
                 f"spec resolves to {shape} — declare e.g. "
                 "mesh: {data: ..., seq: ...}")
         nodes = [NodeConfig(var_name=i.name,
-                            synchronizer=self.make_sync(),
+                            synchronizer=self.make_sync(i),
                             is_sparse=i.is_sparse)
                  for i in trainable.var_infos()]
         cfg = self._graph_config(resource_spec)
@@ -91,7 +114,7 @@ class Pipeline(StrategyBuilder):
 
     def __init__(self, num_microbatches: int = 1, virtual_stages: int = 1,
                  *, zero1: bool = False, compressor: str = "none",
-                 remat: bool = False):
+                 zero_min_bytes=None, remat: bool = False):
         if num_microbatches < 1:
             raise ValueError("num_microbatches must be >= 1")
         if virtual_stages < 1:
@@ -103,7 +126,7 @@ class Pipeline(StrategyBuilder):
         # activation, trading recompute FLOPs for the memory that
         # otherwise grows with M x V chunk executions per device.
         self.remat = remat
-        self.make_sync = _default_sync(zero1, compressor)
+        self.make_sync = _default_sync(zero1, compressor, zero_min_bytes)
 
     def build(self, trainable, resource_spec):
         shape = resource_spec.resolved_mesh_shape()
@@ -129,7 +152,7 @@ class Pipeline(StrategyBuilder):
         nodes = []
         for i in trainable.var_infos():
             node = NodeConfig(var_name=i.name,
-                              synchronizer=self.make_sync(),
+                              synchronizer=self.make_sync(i),
                               is_sparse=i.is_sparse)
             # shared-group vars (embedding/unembedding of a pipelined
             # transformer) replicate; stage vars shard on the pipe axis.
@@ -166,10 +189,10 @@ class ExpertParallel(StrategyBuilder):
 
     def __init__(self, expert_params: Sequence[str] = (),
                  detect: bool = True, *, zero1: bool = False,
-                 compressor: str = "none"):
+                 compressor: str = "none", zero_min_bytes=None):
         self.expert_params = tuple(expert_params)
         self.detect = detect
-        self.make_sync = _default_sync(zero1, compressor)
+        self.make_sync = _default_sync(zero1, compressor, zero_min_bytes)
 
     def build(self, trainable, resource_spec):
         shape = resource_spec.resolved_mesh_shape()
@@ -200,7 +223,7 @@ class ExpertParallel(StrategyBuilder):
                     "expert_params=(%r,) if it is a per-expert table",
                     i.name, i.name.rsplit("/", 1)[-1])
             node = NodeConfig(var_name=i.name,
-                              synchronizer=self.make_sync(),
+                              synchronizer=self.make_sync(i),
                               is_sparse=i.is_sparse)
             if explicit or auto:
                 matched.add(i.name)

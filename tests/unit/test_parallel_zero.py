@@ -501,3 +501,76 @@ def test_cost_model_remat_rescues_infeasible_pipeline():
     assert not plain.feasible
     assert remat.feasible
     assert remat.mem_bytes_per_device < plain.mem_bytes_per_device
+
+
+# --------------------------------------------------------------------------- #
+# Heterogeneous mixes + SSP + stateful-ring compressors under parallel
+# lowerings
+# --------------------------------------------------------------------------- #
+def test_sequence_zero_min_bytes_mixes_per_variable():
+    """Parallax-style heterogeneity through one knob: big variables get
+    ZeRO-1 (flat sharded moments), small ones the compressed allreduce —
+    per-variable node configs in the serialized strategy, both honored
+    by the lowering."""
+    from autodist_tpu.strategy.ir import (AllReduceSynchronizer,
+                                          PSSynchronizer)
+
+    trainable = make_lm_trainable(sharded=True)
+    # every variable sits below a 16KB threshold -> uniform compressed AR
+    ad = AutoDist(SEQ_SPEC, "SequenceParallel",
+                  zero_min_bytes=16 * 1024, compressor="bf16")
+    strategy = ad.build_or_load_strategy(trainable)
+    by_name = {n.var_name: n for n in strategy.node_configs}
+    assert all(isinstance(n.synchronizer, AllReduceSynchronizer)
+               for n in by_name.values())
+    # 5KB threshold splits: embed [64x32] f32 = 8KB -> PS; small -> AR
+    ad2 = AutoDist(SEQ_SPEC, "SequenceParallel",
+                   zero_min_bytes=5 * 1024, compressor="bf16")
+    strategy2 = ad2.build_or_load_strategy(trainable)
+    by_name2 = {n.var_name: n for n in strategy2.node_configs}
+    assert isinstance(by_name2["embed/embedding"].synchronizer,
+                      PSSynchronizer)
+    assert isinstance(by_name2["ln/scale"].synchronizer,
+                      AllReduceSynchronizer)
+    assert by_name2["ln/scale"].synchronizer.compressor == "bf16"
+
+    runner = ad2.build(trainable, strategy2)
+    b = lm_batches(1)[0]
+    runner.step(b, rng=jax.random.PRNGKey(0))
+    mu = runner.state["opt_state"][0].mu
+    assert mu["embed"]["embedding"].ndim == 1          # ZeRO flat
+    assert mu["embed"]["embedding"].sharding.spec == P(("data", "seq"))
+    assert mu["ln"]["scale"].ndim == 1 and \
+        mu["ln"]["scale"].shape == (DIM,)              # replicated
+
+
+def test_sequence_ssp_staleness_threads_to_runner():
+    """PS(staleness>0) node configs under a parallel lowering reach the
+    runner's host SSP gate (lowering-agnostic; without a coordination
+    service it warns and runs lockstep)."""
+    from autodist_tpu.strategy.ir import PSSynchronizer
+
+    ad = AutoDist(SEQ_SPEC, "SequenceParallel", zero1=True)
+    trainable = make_lm_trainable(sharded=True)
+    strategy = ad.build_or_load_strategy(trainable)
+    for nc in strategy.node_configs:
+        nc.synchronizer = PSSynchronizer(staleness=2)
+    runner = ad.build(trainable, strategy)
+    assert runner.lowered.ssp_staleness == 2
+    # no coordination service in this test -> gate disabled, lockstep
+    assert runner._ssp is None
+    m = runner.step(lm_batches(1)[0], rng=jax.random.PRNGKey(0))
+    assert np.isfinite(float(np.asarray(m["loss"])))
+
+
+def test_sequence_int8_ring_compressor_over_tuple_axes():
+    """The stateful ppermute-ring compressor runs over the combined
+    (data x seq) axis group (ring over the linearized 8-device group)."""
+    ad = AutoDist(SEQ_SPEC, "SequenceParallel", compressor="int8_ring")
+    trainable = make_lm_trainable(sharded=True, opt=optax.sgd(0.05))
+    runner = ad.build(trainable)
+    for b in lm_batches(2):
+        m = runner.step(b, rng=jax.random.PRNGKey(0))
+    assert np.isfinite(float(np.asarray(m["loss"])))
+    for row in jax.tree.leaves(runner.state["sync_state"]):
+        assert row.shape[0] == 8
