@@ -284,7 +284,24 @@ def _bench(dog):
     if mem.get("bytes_in_use"):
         record["hbm_gb_in_use"] = round(mem["bytes_in_use"] / 1e9, 2)
     dog.disarm()
-    print(json.dumps(record))
+    print(json.dumps(record), flush=True)
+
+    # Optional trace capture AFTER the record is emitted (a timeout mid-
+    # capture must never discard an already-completed measurement) and
+    # only when the number is actionable: a sub-target MFU needs a
+    # profile to close the gap, and the hardware window may not come
+    # back for a second run.
+    prof_dir = os.environ.get("AUTODIST_TPU_BENCH_PROFILE", "")
+    if prof_dir and on_accel and mfu < 0.45:
+        dog.stage = "profile capture (post-report)"
+        try:
+            with jax.profiler.trace(prof_dir):
+                for _ in range(3):
+                    metrics = runner.step(data)
+                fence(metrics["loss"])
+            print(f"# profile trace written to {prof_dir}", flush=True)
+        except Exception as e:  # pragma: no cover - capture must not kill bench
+            print(f"# profile capture failed: {e}", flush=True)
 
 
 if __name__ == "__main__":

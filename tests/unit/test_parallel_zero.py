@@ -574,3 +574,23 @@ def test_sequence_int8_ring_compressor_over_tuple_axes():
     assert np.isfinite(float(np.asarray(m["loss"])))
     for row in jax.tree.leaves(runner.state["sync_state"]):
         assert row.shape[0] == 8
+
+
+def test_expert_compressor_on_sharded_vars_sizes_ef_locally():
+    """Stateful compressor on expert-SHARDED variables: the EF residual
+    row is sized from the per-device shard (global size / E), not the
+    global size — and training runs (pins the local-size fix)."""
+    ad = AutoDist(EXPERT_SPEC, "ExpertParallel", compressor="bf16_ef")
+    trainable = make_moe_trainable(opt=optax.sgd(0.05))
+    runner = ad.build(trainable)
+    for b in moe_batches(2):
+        m = runner.step(b, rng=jax.random.PRNGKey(0))
+    assert np.isfinite(float(np.asarray(m["loss"])))
+    sync = runner.state["sync_state"]
+    assert sync, "stateful compressor rows expected"
+    # expert_wi global [4, 8, 16] = 512 elems over 4 expert shards ->
+    # local 128-length residual rows
+    wi_rows = sync["moe/expert_wi"]
+    assert wi_rows.shape == (8, 128), wi_rows.shape
+    # replicated gate [8, 4] = 32 elems -> full-size rows
+    assert sync["moe/gate"].shape == (8, 32)

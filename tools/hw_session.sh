@@ -61,7 +61,7 @@ while true; do
       exit 0
     fi
     echo "$(date -u +%H:%M:%S) tunnel healthy — starting queue" >> "$LOG"
-    timeout 2500 python bench.py > /tmp/hw_bench.json 2>/tmp/hw_bench.err
+    AUTODIST_TPU_BENCH_PROFILE=/tmp/hw_profile       timeout 2500 python bench.py > /tmp/hw_bench.json 2>/tmp/hw_bench.err
     echo "$(date -u +%H:%M:%S) bench rc=$? $(tail -c 300 /tmp/hw_bench.json)" >> "$LOG"
     # Only continue if the bench actually produced a measurement (no
     # "error" key and a nonzero value — bench.py emits value 0.0 exactly
