@@ -98,6 +98,15 @@ class _Watchdog:
             pass
 
 
+def _fail_record(msg: str) -> str:
+    """The one failure-record shape: hw_session.sh greps these exact keys
+    (``"error"``/``"value"``) to gate the measurement queue, so every
+    in-process failure path must emit the same dict."""
+    return json.dumps({
+        "metric": "bert_base_mlm_mfu", "value": 0.0, "unit": "mfu",
+        "vs_baseline": 0.0, "error": msg})
+
+
 def mlm_model_flops_per_example(cfg, seq_len: int, num_masked: int) -> float:
     """Analytic matmul FLOPs for one BERT MLM training example (fwd x3 for
     fwd+bwd).  Counts encoder matmuls (qkv 6H^2 + out-proj 2H^2 + mlp
@@ -128,10 +137,7 @@ def main():
         if "UNAVAILABLE" not in str(e) and "backend" not in str(e):
             raise
         dog.disarm()
-        print(json.dumps({
-            "metric": "bert_base_mlm_mfu", "value": 0.0,
-            "unit": "mfu", "vs_baseline": 0.0,
-            "error": f"accelerator backend unavailable: {e}"}))
+        print(_fail_record(f"accelerator backend unavailable: {e}"))
         sys.exit(3)
     finally:
         dog.disarm()   # every exit path reaps the monitor + stage file
@@ -245,10 +251,21 @@ def _bench(dog):
             rates[(name, b)] = b * n * (5 if on_accel else 1) / dt
         except Exception as e:  # pragma: no cover - probe must not kill bench
             print(f"# bench probe {name}/b{b} failed: {e}", flush=True)
+            if not rates and ("UNAVAILABLE" in str(e) or "Connection" in str(e)):
+                # Transport-level failure before ANY probe succeeded
+                # (observed: device enumeration succeeds while the
+                # tunnel's remote-compile endpoint refuses connections,
+                # each attempt burning ~20 min of retry backoff).  Every
+                # probe shares the same PJRT client, so later probes
+                # cannot fare better — report the outage immediately
+                # instead of eating the window.  Once a probe has
+                # *succeeded* the client is demonstrably alive: keep
+                # going and score what was collected.
+                dog.disarm()
+                print(_fail_record(f"accelerator transport unavailable: {e}"))
+                sys.exit(3)
     if not rates:
-        print(json.dumps({
-            "metric": "bert_base_mlm_mfu", "value": 0.0, "unit": "mfu",
-            "vs_baseline": 0.0, "error": "every bench probe failed"}))
+        print(_fail_record("every bench probe failed"))
         sys.exit(4)
     best, best_b = max(rates, key=rates.get)
     runner, data, batch = runners[best], batches[best_b], best_b * n
@@ -294,6 +311,17 @@ def _bench(dog):
     prof_dir = os.environ.get("AUTODIST_TPU_BENCH_PROFILE", "")
     if prof_dir and on_accel and mfu < 0.45:
         dog.stage = "profile capture (post-report)"
+        # The record above is already printed, so a wedged capture step
+        # must not hang until the driver's outer timeout (observed
+        # failure mode: un-interruptible C call in PJRT).  The printing
+        # watchdog is disarmed for good — its error line would follow
+        # the real record — so arm a KILL-ONLY child: sleep, then
+        # SIGKILL the bench, printing nothing.
+        reaper = subprocess.Popen(
+            [sys.executable, "-c",
+             "import os,sys,time\ntime.sleep(float(sys.argv[2]))\n"
+             "try: os.kill(int(sys.argv[1]), 9)\nexcept OSError: pass",
+             str(os.getpid()), "300"], stderr=subprocess.DEVNULL)
         try:
             with jax.profiler.trace(prof_dir):
                 for _ in range(3):
@@ -302,6 +330,9 @@ def _bench(dog):
             print(f"# profile trace written to {prof_dir}", flush=True)
         except Exception as e:  # pragma: no cover - capture must not kill bench
             print(f"# profile capture failed: {e}", flush=True)
+        finally:
+            reaper.kill()
+            reaper.wait()
 
 
 if __name__ == "__main__":

@@ -55,7 +55,11 @@ while true; do
     echo "$(date -u +%H:%M:%S) deadline reached — exiting" >> "$LOG"
     exit 0
   fi
-  if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  # Probe = a real (tiny) compile + execute, not just device enumeration:
+  # observed 2026-07-31, `jax.devices()` can succeed while the tunnel's
+  # remote-compile endpoint refuses connections — enumeration alone calls
+  # a window healthy that cannot run a single step.
+  if timeout 180 python -c "import jax, jax.numpy as jnp; jax.jit(lambda x: (x * 2).sum())(jnp.ones((128, 128))).block_until_ready()" >/dev/null 2>&1; then
     if ! have_time 2510; then
       echo "$(date -u +%H:%M:%S) healthy but no time for bench — exiting" >> "$LOG"
       exit 0
