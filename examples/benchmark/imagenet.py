@@ -51,24 +51,93 @@ def main():
 
     rs = ResourceSpec({})
     n = rs.num_devices()
+    on_accel = jax.default_backend() != "cpu"
     if args.preset == "tiny":
-        image_size, batch = 32, 8 * n
+        image_size, candidates = 32, [8 * n]
     else:
         image_size = 299 if args.model == "inceptionv3" else 224
-        batch = args.batch_size or 32 * n
+        if args.batch_size:
+            candidates = [args.batch_size]
+        elif on_accel:
+            # Self-tune the per-chip batch: conv utilization keeps
+            # climbing until HBM runs out, and the knee is
+            # hardware/model dependent — measure a few steps of each
+            # size and score the examples/sec winner (an OOM just
+            # loses its probe).  Ascending order so the riskiest
+            # allocation comes last.
+            candidates = [32 * n, 128 * n, 256 * n]
+        else:
+            candidates = [32 * n]
+    import os
+    env_cands = os.environ.get("AUTODIST_TPU_BATCH_CANDIDATES")
+    if env_cands and not args.batch_size:
+        # Per-chip candidate list override: lets a hardware session
+        # re-scope the probe (and CPU tests exercise the probe path)
+        # without editing code.
+        try:
+            candidates = [int(s) * n for s in env_cands.split(",")]
+        except ValueError:
+            raise SystemExit(
+                f"AUTODIST_TPU_BATCH_CANDIDATES={env_cands!r} is not a "
+                f"comma-separated list of per-chip batch sizes")
+    # Ascending: the probe loop stops at the first failure on the grounds
+    # that every LARGER size shares its fate.
+    candidates = sorted(candidates)
+    if len(candidates) > 1 and jax.process_count() > 1:
+        # Each process would pick from its own wall-clock timings; within
+        # noise two hosts could choose different global batches and issue
+        # shape-mismatched collectives.  Self-tuning is a single-host
+        # convenience — multi-host runs state their batch explicitly.
+        print("# multi-host run: skipping batch self-tune "
+              f"(using {candidates[0] // n}/chip; set --batch-size to override)")
+        candidates = candidates[:1]
     chunk = args.chunk_size or CHUNK_SIZES.get(args.model, DEFAULT_CHUNK)
 
-    trainable = make_image_trainable(
-        build_model(args.model), optax.sgd(0.1, momentum=0.9),
-        jax.random.PRNGKey(0), image_size=image_size, batch_size=2,
-        name=args.model)
-    builder = builders.create(args.strategy, **(
-        {"chunk_size": chunk} if args.strategy == "AllReduce" else {}))
-    runner = AutoDist(rs, builder).build(trainable)
+    def build_runner():
+        trainable = make_image_trainable(
+            build_model(args.model), optax.sgd(0.1, momentum=0.9),
+            jax.random.PRNGKey(0), image_size=image_size, batch_size=2,
+            name=args.model)
+        builder = builders.create(args.strategy, **(
+            {"chunk_size": chunk} if args.strategy == "AllReduce" else {}))
+        return AutoDist(rs, builder).build(trainable)
 
+    runner = build_runner()
     rng = np.random.RandomState(0)
-    x = rng.rand(batch, image_size, image_size, 3).astype(np.float32)
-    y = rng.randint(0, 1000, (batch,)).astype(np.int32)
+
+    def make_data(b):
+        return {"x": rng.rand(b, image_size, image_size, 3).astype(np.float32),
+                "y": rng.randint(0, 1000, (b,)).astype(np.int32)}
+
+    batch = candidates[0]
+    if len(candidates) > 1:
+        import time
+        rates, failed = {}, False
+        for b in candidates:
+            try:
+                data = make_data(b)
+                m = runner.step(data)                      # compile
+                float(np.asarray(m["loss"]))
+                t0 = time.perf_counter()
+                for _ in range(3):
+                    m = runner.step(data)
+                float(np.asarray(m["loss"]))
+                rates[b] = 3 * b / (time.perf_counter() - t0)
+                print(f"# probe batch {b // n}/chip: {rates[b]:.1f} ex/s")
+            except Exception as e:
+                print(f"# probe batch {b // n}/chip failed: {e}")
+                failed = True
+                break  # larger sizes can only fail the same way
+        if not rates:
+            raise SystemExit("every batch-size probe failed")
+        batch = max(rates, key=rates.get)
+        if failed:
+            # An OOM'd step may have consumed donated state buffers;
+            # rebuild from the deterministic seed for the scored run.
+            runner.close()
+            runner = build_runner()
+
+    data = make_data(batch)
 
     logger = BenchmarkLogger(args.benchmark_log_dir)
     flops_per_example = peak_flops = None
@@ -76,7 +145,7 @@ def main():
         flops_per_example = 3.0 * FWD_GFLOPS[args.model] * 1e9
         peak_flops = rs.chip.peak_bf16_tflops * 1e12 * n
     summary = run_benchmark(
-        runner, lambda step: {"x": x, "y": y}, batch_size=batch,
+        runner, lambda step: data, batch_size=batch,
         train_steps=args.train_steps, warmup_steps=args.warmup_steps,
         log_steps=args.log_steps, logger=logger,
         flops_per_example=flops_per_example, peak_flops=peak_flops)
