@@ -67,6 +67,14 @@ def load_tuning(path: Optional[str] = None, *, reload: bool = False) -> dict:
             table = loaded if isinstance(loaded, dict) else {}
         except (OSError, ValueError):
             table = {}
+    if path is None and table.get("backend") == "cpu":
+        # Dev-smoke artifact: interpret-mode timings say nothing about
+        # the TPU kernel, so auto-load ignores a CPU-provenance table
+        # (an explicit ``path`` argument still wins).
+        from autodist_tpu.utils import logging
+        logging.warning("ignoring CPU-provenance flash tuning table %s "
+                        "(pass the path explicitly to force)", p)
+        table = {}
     if path is None:
         _tuning_cache = table
     return table

@@ -74,6 +74,18 @@ def load_calibration(path: Optional[str] = None) -> dict:
         if p and os.path.exists(p):
             with open(p) as f:
                 data = json.load(f)
+            meta = data.get("meta")
+            backend = meta.get("backend") if isinstance(meta, dict) else None
+            if path is None and backend == "cpu":
+                # A dev-smoke artifact (tools/calibrate_compressors.py on
+                # a CPU mesh) measures compute overhead with no real wire
+                # and would silently skew accelerator planning; auto-load
+                # skips it.  An explicit ``path`` argument overrides.
+                from autodist_tpu.utils import logging
+                logging.warning(
+                    "ignoring CPU-provenance calibration file %s "
+                    "(pass the path explicitly to force)", p)
+                continue
             factors = dict(data.get("compressor_factor", {}))
             COMPRESSOR_FACTOR.update(factors)
             return factors

@@ -181,6 +181,29 @@ def test_tuning_table_resolution(tmp_path, monkeypatch):
         fa.load_tuning(reload=True)
 
 
+def test_cpu_provenance_tuning_skipped_on_autoload(tmp_path, monkeypatch):
+    """A table written by a CPU (interpret-mode) crossover run must not
+    steer TPU kernel defaults: auto-load ignores backend=cpu tables; an
+    explicit path still loads them."""
+    import json
+
+    import importlib
+    fa = importlib.import_module("autodist_tpu.ops.flash_attention")
+
+    table = {"causal": {"blocks": {"512": 512}}, "backend": "cpu"}
+    p = tmp_path / "flash_tuning.json"
+    p.write_text(json.dumps(table))
+    monkeypatch.setenv("AUTODIST_TPU_FLASH_TUNING", str(p))
+    fa.load_tuning(reload=True)
+    try:
+        assert fa.tuned_blocks(512, True) == (fa.DEFAULT_BLOCK,
+                                              fa.DEFAULT_BLOCK)
+        assert fa.load_tuning(str(p))["causal"]["blocks"]["512"] == 512
+    finally:
+        monkeypatch.delenv("AUTODIST_TPU_FLASH_TUNING")
+        fa.load_tuning(reload=True)
+
+
 def test_tuning_absent_defaults(monkeypatch, tmp_path):
     import importlib
     fa = importlib.import_module("autodist_tpu.ops.flash_attention")

@@ -379,3 +379,23 @@ def test_calibration_file_overrides_factors(tmp_path, monkeypatch):
     applied = cm.load_calibration(str(calib))
     assert applied == {"int8_ring": 0.61}
     assert cm.COMPRESSOR_FACTOR["int8_ring"] == 0.61
+
+
+def test_cpu_provenance_calibration_skipped_on_autoload(tmp_path, monkeypatch):
+    """A dev-smoke artifact (calibrate_compressors.py on a CPU mesh) must
+    not skew accelerator planning: auto-load (env-var candidate) skips a
+    file whose meta records backend=cpu; an explicit path still wins."""
+    import json
+
+    from autodist_tpu.simulator import cost_model as cm
+
+    calib = tmp_path / "calibration.json"
+    calib.write_text(json.dumps(
+        {"compressor_factor": {"int8_ring": 37.4},
+         "meta": {"backend": "cpu"}}))
+    monkeypatch.setitem(cm.COMPRESSOR_FACTOR, "int8_ring", 0.25)
+    monkeypatch.setenv("AUTODIST_TPU_CALIBRATION", str(calib))
+    assert cm.load_calibration() == {}
+    assert cm.COMPRESSOR_FACTOR["int8_ring"] == 0.25
+    # explicit path overrides the provenance gate
+    assert cm.load_calibration(str(calib)) == {"int8_ring": 37.4}

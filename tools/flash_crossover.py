@@ -154,6 +154,16 @@ def main():
                     table = loaded if isinstance(loaded, dict) else {}
                 except (OSError, ValueError):
                     table = {}
+            if table.get("backend", jax.default_backend()) \
+                    != jax.default_backend():
+                # Cross-backend merge would mislabel stale entries under
+                # this run's provenance stamp (or discard this run's via
+                # the old stamp) — measurements from different backends
+                # don't compose; start a fresh table.
+                print(f"# discarding {args.write} measured on "
+                      f"{table['backend']!r} (this run: "
+                      f"{jax.default_backend()!r})", file=sys.stderr)
+                table = {}
             key = "causal" if causal else "noncausal"
             branch = table.get(key)
             branch = dict(branch) if isinstance(branch, dict) else {}
@@ -172,6 +182,9 @@ def main():
                                        else None)
             table[key] = branch
             table["device_kind"] = jax.devices()[0].device_kind
+            # Provenance: load_tuning refuses to auto-load CPU-measured
+            # tables (interpret-mode timings would mislead TPU defaults).
+            table["backend"] = jax.default_backend()
             with open(args.write, "w") as f:
                 json.dump(table, f, indent=1)
             print(f"# wrote {args.write}", file=sys.stderr)
