@@ -154,14 +154,14 @@ def main():
                     table = loaded if isinstance(loaded, dict) else {}
                 except (OSError, ValueError):
                     table = {}
-            if table.get("backend", jax.default_backend()) \
-                    != jax.default_backend():
+            if table and table.get("backend") != jax.default_backend():
                 # Cross-backend merge would mislabel stale entries under
                 # this run's provenance stamp (or discard this run's via
                 # the old stamp) — measurements from different backends
-                # don't compose; start a fresh table.
+                # don't compose; start a fresh table.  Unstamped legacy
+                # tables have unknown provenance: same treatment.
                 print(f"# discarding {args.write} measured on "
-                      f"{table['backend']!r} (this run: "
+                      f"{table.get('backend')!r} (this run: "
                       f"{jax.default_backend()!r})", file=sys.stderr)
                 table = {}
             key = "causal" if causal else "noncausal"
