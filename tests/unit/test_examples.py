@@ -61,6 +61,25 @@ def test_benchmark_imagenet_tiny():
     assert "resnet18/AllReduce" in out
 
 
+def test_benchmark_imagenet_batch_probe(monkeypatch):
+    """The self-tuning batch probe (exercised via the candidate override)
+    times each size, picks the examples/sec winner, and reports its
+    per-chip batch in the JSON headline."""
+    monkeypatch.setenv("AUTODIST_TPU_BATCH_CANDIDATES", "1,2")
+    out = run_script("examples/benchmark/imagenet.py", "--model",
+                     "resnet18", "--preset", "tiny", "--train-steps",
+                     "2", "--log-steps", "2", "--warmup-steps", "1",
+                     "--json")
+    # both probes must SUCCEED (the failure form prints "failed:")
+    assert len([l for l in out.splitlines()
+                if l.startswith("# probe batch") and "ex/s" in l]) == 2
+    assert "failed" not in out
+    import json as _json
+    headline = _json.loads(
+        [l for l in out.splitlines() if '"metric"' in l][-1])
+    assert headline["batch_per_chip"] in (1, 2)
+
+
 def test_benchmark_bert_tiny_flash(tmp_path):
     out = run_script("examples/benchmark/bert.py", "--preset", "tiny",
                      "--train-steps", "4", "--log-steps", "2",
