@@ -247,9 +247,12 @@ def test_crossover_tool_write_merges(tmp_path):
     import sys
 
     out = tmp_path / "flash_tuning.json"
+    # backend stamp matches the run below: same-provenance tables merge
+    # (unstamped/cross-backend ones are discarded — separate test below)
     out.write_text(json.dumps(
         {"causal": {"crossover_len": 777, "blocks": {"512": 64}},
-         "noncausal": {"blocks": {"999": 32}, "speedup": {"999": 2.0}}}))
+         "noncausal": {"blocks": {"999": 32}, "speedup": {"999": 2.0}},
+         "backend": "cpu"}))
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("PALLAS_AXON_POOL_IPS", None)
     proc = subprocess.run(
@@ -267,6 +270,32 @@ def test_crossover_tool_write_merges(tmp_path):
     assert "128" in nb["blocks"] and "128" in nb["speedup"]
     # crossover derived from per-length speedups (999 won at 2.0)
     assert nb["crossover_len"] in (128, 999)
+    assert table["backend"] == "cpu", "written table must carry provenance"
+
+
+def test_crossover_tool_write_discards_unstamped(tmp_path):
+    """A tuning table without a backend stamp (or from another backend)
+    has unknown provenance: --write starts fresh instead of merging, so
+    stale entries can't masquerade under this run's stamp."""
+    import json
+    import subprocess
+    import sys
+
+    out = tmp_path / "flash_tuning.json"
+    out.write_text(json.dumps(
+        {"causal": {"crossover_len": 777, "blocks": {"512": 64}}}))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, "tools/flash_crossover.py", "--seqs", "128",
+         "--heads", "2", "--head-dim", "16", "--tokens", "256",
+         "--blocks", "64", "--steps", "1", "--write", str(out)],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    table = json.loads(out.read_text())
+    assert "causal" not in table, "unstamped table must be discarded"
+    assert "128" in table["noncausal"]["blocks"]
 
 
 def test_flash_wins_prefers_per_length_speedups(tmp_path, monkeypatch):
