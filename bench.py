@@ -51,9 +51,13 @@ try:
 except (OSError, ValueError):
     pass
 if record and record.get("value"):
-    record["partial"] = (f"watchdog fired after {int(secs)}s during stage "
-                         f"{stage!r}; value is the best probe rate, not the "
-                         f"scored run")
+    if not record.get("scored"):
+        # Only probe-grade data landed before the hang: flag it.  A
+        # record carrying "scored" already IS a completed measured run
+        # (the bench scores first, then tunes) — report it unflagged.
+        record["partial"] = (f"watchdog fired after {int(secs)}s during "
+                             f"stage {stage!r}; value is the best probe "
+                             f"rate, not the scored run")
     print(json.dumps(record), flush=True)
 else:
     print(json.dumps({
@@ -97,6 +101,7 @@ class _Watchdog:
             pass
 
     def arm(self):
+        self.armed_at = time.monotonic()   # the budget clock _bench reads
         self._proc = subprocess.Popen(
             [sys.executable, "-c", _MONITOR_SRC,
              str(os.getpid()), self._stage_path, str(self.seconds),
@@ -226,29 +231,90 @@ def _bench(dog):
         fence(metrics["loss"])
         return time.perf_counter() - t0
 
-    # Self-tuning over {attention impl} x {per-chip batch}: on v5e at seq
-    # 512 plain einsum beats this repo's Pallas flash kernel (attention is
-    # ~10% of BERT FLOPs; flash wins at longer sequences) and larger
-    # batches fill the MXU better until HBM runs out — but both margins
-    # are hardware/compiler dependent, so measure a few steps of each
-    # config and score the winner by examples/sec.  A config that OOMs
-    # just loses its probe.
+    # Score-first discipline (learned on round 5's degraded window:
+    # remote compiles intermittently fail with INTERNAL/UNAVAILABLE and
+    # can take >10 min each, so a probe-every-config-then-score plan
+    # burned the whole watchdog budget before the scored run started and
+    # the round's number was a 5-step probe flagged "partial").  Run the
+    # FULL scored measurement at the known-good base config FIRST, then
+    # spend whatever budget remains probing better configs — larger
+    # batches fill the MXU until HBM runs out (an OOM just loses its
+    # probe); the flash kernel wins at longer sequences — and re-score
+    # only a winning probe, whose executable the probe itself already
+    # compiled.
     from autodist_tpu.ops import make_attention_fn
     from autodist_tpu.ops.flash_attention import flash_wins
+
+    def time_left():
+        # Measured against the watchdog's OWN clock: it was armed before
+        # backend init, which can itself block for many minutes on a
+        # degraded tunnel — a second clock started here would green-light
+        # probes the watchdog is guaranteed to kill mid-run.
+        return dog.seconds - (time.monotonic() - dog.armed_at)
+
+    flops_per_example = mlm_model_flops_per_example(cfg, seq_len, num_masked)
+    peak = rs.chip.peak_bf16_tflops * 1e12 * n
+
+    def make_record(name, b, rate, dt_step=None):
+        m = profiling.mfu(rate, flops_per_example, peak)
+        rec = {"metric": "bert_base_mlm_mfu", "value": round(m, 4),
+               "unit": "mfu", "vs_baseline": round(m / 0.45, 4),
+               "examples_per_sec": round(rate, 2), "devices": n,
+               "chip": rs.chip.name, "attention": name,
+               "batch_per_chip": b}
+        if dt_step is not None:
+            rec["step_ms"] = round(dt_step * 1e3, 2)
+            rec["scored"] = True    # a completed scored window, not a probe
+        return rec
+
+    def save_snapshot(rec):
+        # Best-so-far snapshot for the watchdog: a timeout later in the
+        # run reports this measured record instead of a bare diagnostic
+        # (un-flagged if already scored).  Written atomically — the
+        # watchdog may read at any instant.
+        tmp = dog.partial_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, dog.partial_path)
+
     attn_impls = {"einsum": None}
     if on_accel:
         attn_impls["flash"] = make_attention_fn(causal=False)
+
+    # ---- Stage 1: scored run at the base config -----------------------
+    dog.stage = f"scored run (einsum/b{batch_per_chip}: build+compile+steps)"
+    runners = {}   # attention name -> runner (shared across batch sizes)
+    batches = {batch_per_chip: make_batch(batch_per_chip)}
+    try:
+        runners["einsum"] = build_runner(None)
+        dt = timed(runners["einsum"], batches[batch_per_chip], steps)
+    except Exception as e:
+        # Nothing has been measured yet, so every failure here must
+        # still end in the one well-formed fail-record shape the driver
+        # greps (see _fail_record) — never a bare traceback.  Transport
+        # failures (observed: device enumeration succeeds while the
+        # tunnel's remote-compile endpoint refuses connections, each
+        # attempt burning ~20 min of retry backoff) exit immediately:
+        # every config shares the same PJRT client, so nothing
+        # downstream can fare better.
+        dog.disarm()
+        if "UNAVAILABLE" in str(e) or "Connection" in str(e):
+            print(_fail_record(f"accelerator transport unavailable: {e}"))
+            sys.exit(3)
+        print(_fail_record(f"base scored run failed: {e}"))
+        sys.exit(4)
+    base_rate = batch_per_chip * n * steps / dt
+    best = make_record("einsum", batch_per_chip, base_rate,
+                       dt_step=dt / steps)
+    save_snapshot(best)
+
+    # ---- Stage 2: opportunistic probes with the remaining budget ------
+    candidates = []
     if on_accel:
-        # 4 configs = 4 compiles: einsum at three batch sizes (batch 64
-        # probes whether HBM still has room — an OOM just loses its
-        # probe), flash only at batch 32 (flash at the base batch
-        # already measured slower than einsum on v5e, BASELINE.md
-        # round-3 table).  A committed flash_tuning.json settles the
-        # flash question without burning a probe: measured-lost at this
-        # length drops the flash candidate, measured-won probes it at
-        # the base batch too.
-        candidates = [("einsum", batch_per_chip),
-                      ("einsum", 2 * batch_per_chip),
+        # A committed flash_tuning.json settles whether this sequence
+        # length is worth a flash probe without burning one:
+        # measured-lost drops the candidate, measured-won promotes it.
+        candidates = [("einsum", 2 * batch_per_chip),
                       ("einsum", 4 * batch_per_chip)]
         fw = flash_wins(seq_len, causal=False)
         if fw is True:
@@ -259,84 +325,65 @@ def _bench(dog):
         else:
             print("# flash_tuning.json: einsum wins at this length; "
                   "skipping flash probe", flush=True)
-    else:
-        candidates = [("einsum", batch_per_chip)]
-    rates = {}     # config -> examples/sec from the probe
-    runners = {}   # attention name -> runner (shared across batch sizes)
-    batches = {b: make_batch(b) for _, b in candidates}
-    flops_per_example = mlm_model_flops_per_example(cfg, seq_len, num_masked)
-    peak = rs.chip.peak_bf16_tflops * 1e12 * n
-
-    def partial_record(name, b, rate):
-        m = profiling.mfu(rate, flops_per_example, peak)
-        return {"metric": "bert_base_mlm_mfu", "value": round(m, 4),
-                "unit": "mfu", "vs_baseline": round(m / 0.45, 4),
-                "examples_per_sec": round(rate, 2), "devices": n,
-                "chip": rs.chip.name, "attention": name,
-                "batch_per_chip": b}
-
+    # A cold compile on a degraded tunnel has been observed to take
+    # >10 min; a probe only starts with room for that compile plus its
+    # steps plus the stage-3 re-score.
+    PROBE_FLOOR = 900.0
+    retried = False
+    probes = {}    # config -> examples/sec from a 5-step probe
     for name, b in candidates:
+        if time_left() < PROBE_FLOOR:
+            print(f"# skipping probe {name}/b{b}: {int(time_left())}s "
+                  "left in budget", flush=True)
+            continue
         dog.stage = f"probe {name}/b{b} (build+compile+steps)"
-        try:
-            if name not in runners:
-                runners[name] = build_runner(attn_impls[name])
-            dt = timed(runners[name], batches[b], 5 if on_accel else 1)
-            rates[(name, b)] = b * n * (5 if on_accel else 1) / dt
-            if rates[(name, b)] >= max(rates.values()):
-                # Best-so-far snapshot for the watchdog: a timeout later
-                # in the run then reports this measured rate (flagged
-                # "partial") instead of a bare diagnostic.  Written
-                # atomically — the watchdog may read at any instant.
-                tmp = dog.partial_path + ".tmp"
-                with open(tmp, "w") as f:
-                    json.dump(partial_record(name, b, rates[(name, b)]), f)
-                os.replace(tmp, dog.partial_path)
-        except Exception as e:  # pragma: no cover - probe must not kill bench
-            print(f"# bench probe {name}/b{b} failed: {e}", flush=True)
-            if not rates and ("UNAVAILABLE" in str(e) or "Connection" in str(e)):
-                # Transport-level failure before ANY probe succeeded
-                # (observed: device enumeration succeeds while the
-                # tunnel's remote-compile endpoint refuses connections,
-                # each attempt burning ~20 min of retry backoff).  Every
-                # probe shares the same PJRT client, so later probes
-                # cannot fare better — report the outage immediately
-                # instead of eating the window.  Once a probe has
-                # *succeeded* the client is demonstrably alive: keep
-                # going and score what was collected.
-                dog.disarm()
-                print(_fail_record(f"accelerator transport unavailable: {e}"))
-                sys.exit(3)
-    if not rates:
-        print(_fail_record("every bench probe failed"))
-        sys.exit(4)
-    best, best_b = max(rates, key=rates.get)
-    runner, data, batch = runners[best], batches[best_b], best_b * n
-    for name in list(runners):
-        if name != best:
-            del runners[name]  # free the loser's params/opt state in HBM
+        if b not in batches:
+            batches[b] = make_batch(b)
+        for attempt in (0, 1):
+            try:
+                if name not in runners:
+                    runners[name] = build_runner(attn_impls[name])
+                dt = timed(runners[name], batches[b], 5)
+                probes[(name, b)] = b * n * 5 / dt
+                break
+            except Exception as e:  # pragma: no cover - must not kill bench
+                print(f"# bench probe {name}/b{b} failed: {e}", flush=True)
+                # One retry for the whole probe stage: compile-transport
+                # failures (INTERNAL/UNAVAILABLE) are often transient on
+                # a flaky tunnel, but every attempt can burn minutes —
+                # a failing flash build gets dropped, not drained.
+                if (retried or attempt or time_left() < PROBE_FLOOR
+                        or not ("INTERNAL" in str(e)
+                                or "UNAVAILABLE" in str(e))):
+                    break
+                retried = True
+                print(f"# retrying probe {name}/b{b} once", flush=True)
 
-    dog.stage = f"scored run ({best}/b{best_b})"
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        metrics = runner.step(data)
-    fence(metrics["loss"])
-    dt = time.perf_counter() - t0
+    # ---- Stage 3: re-score a winning probe ----------------------------
+    # The probe's own compile is cached, so the scored re-run costs only
+    # the steps themselves; the 2% bar covers 5-step probe jitter.
+    if probes:
+        (name, b), rate = max(probes.items(), key=lambda kv: kv[1])
+        if rate > base_rate * 1.02 and time_left() > 120:
+            dog.stage = f"scored run ({name}/b{b})"
+            try:
+                dt = timed(runners[name], batches[b], steps)
+                scored_rate = b * n * steps / dt
+                if scored_rate > base_rate:
+                    best = make_record(name, b, scored_rate,
+                                       dt_step=dt / steps)
+                    save_snapshot(best)
+            except Exception as e:  # pragma: no cover - must not kill bench
+                print(f"# re-score {name}/b{b} failed: {e}", flush=True)
+
     dog.stage = "memory stats + report"
-
-    examples_per_sec = batch * steps / dt
-    mfu = profiling.mfu(examples_per_sec, flops_per_example, peak)
-    record = {
-        "metric": "bert_base_mlm_mfu",
-        "value": round(mfu, 4),
-        "unit": "mfu",
-        "vs_baseline": round(mfu / 0.45, 4),
-        "examples_per_sec": round(examples_per_sec, 2),
-        "step_ms": round(dt / steps * 1e3, 2),
-        "devices": n,
-        "chip": rs.chip.name,
-        "attention": best,
-        "batch_per_chip": best_b,
-    }
+    mfu = best["value"]
+    runner = runners[best["attention"]]
+    data = batches[best["batch_per_chip"]]
+    for name in list(runners):
+        if name != best["attention"]:
+            del runners[name]  # free the loser's params/opt state in HBM
+    record = dict(best)
     mem = profiling.memory_summary()
     if mem.get("bytes_in_use"):
         record["hbm_gb_in_use"] = round(mem["bytes_in_use"] / 1e9, 2)

@@ -30,6 +30,20 @@ def run_script(rel_path, *args, timeout=240):
     return proc.stdout
 
 
+def test_bench_cpu_smoke():
+    """The driver-facing bench must emit one scored JSON record on its
+    CPU dev-smoke path (score-first: the record exists even if the
+    opportunistic tuning stages never run)."""
+    import json
+    out = run_script("bench.py")
+    line = [l for l in out.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["metric"] == "bert_base_mlm_mfu"
+    assert rec["scored"] is True and "error" not in rec
+    # toy-model MFU rounds to 0.0; the rate is the liveness signal
+    assert rec["examples_per_sec"] > 0 and rec["step_ms"] > 0
+
+
 def test_linear_regression():
     out = run_script("examples/linear_regression.py", "--steps", "6")
     assert "loss=" in out
