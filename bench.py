@@ -205,11 +205,18 @@ def _bench(dog):
         step."""
         return float(np.asarray(x))
 
-    def make_batch(b):
-        data = bert.synthetic_mlm_batch(0, b * n, seq_len, num_masked,
-                                        cfg.vocab_size)
-        data.pop("input_mask", None)  # unpadded: no mask pass over scores
-        return data
+    def make_batches(b, k):
+        """k DISTINCT synthetic batches stacked [k, B, ...] for one
+        ``run_steps`` dispatch (steps-per-loop: the whole timed window is
+        one RPC to the device, so tunnel/dispatch latency is paid once,
+        not per step)."""
+        def one(i):
+            data = bert.synthetic_mlm_batch(i, b * n, seq_len, num_masked,
+                                            cfg.vocab_size)
+            data.pop("input_mask", None)  # unpadded: no mask pass on scores
+            return data
+        return jax.tree.map(lambda *xs: np.stack(xs),
+                            *[one(i) for i in range(k)])
 
     def build_runner(attention_fn):
         # init batch is shape-only (params are batch-size independent);
@@ -222,13 +229,14 @@ def _bench(dog):
         # BERT chunk=256 (reference bert.py:62)
         return AutoDist(rs, AllReduce(chunk_size=256)).build(trainable)
 
-    def timed(runner, data, k):
-        metrics = runner.step(data)  # compile
-        fence(metrics["loss"])
+    def timed(runner, stacked):
+        """One warm dispatch (compile + k steps), then one timed
+        dispatch of the same k-step program (k = the stack's leading
+        dim)."""
+        fence(runner.run_steps(stacked)["loss"][-1])   # compile + warm
         t0 = time.perf_counter()
-        for _ in range(k):
-            metrics = runner.step(data)
-        fence(metrics["loss"])
+        metrics = runner.run_steps(stacked)
+        fence(metrics["loss"][-1])
         return time.perf_counter() - t0
 
     # Score-first discipline (learned on round 5's degraded window:
@@ -237,11 +245,12 @@ def _bench(dog):
     # burned the whole watchdog budget before the scored run started and
     # the round's number was a 5-step probe flagged "partial").  Run the
     # FULL scored measurement at the known-good base config FIRST, then
-    # spend whatever budget remains probing better configs — larger
+    # spend whatever budget remains on the other configs — larger
     # batches fill the MXU until HBM runs out (an OOM just loses its
-    # probe); the flash kernel wins at longer sequences — and re-score
-    # only a winning probe, whose executable the probe itself already
-    # compiled.
+    # attempt); the flash kernel wins at longer sequences.  With
+    # steps-per-loop every attempt IS a full scored window (the timed
+    # steps cost seconds; only compiles cost minutes), so there is no
+    # separate probe grade and no re-score stage.
     from autodist_tpu.ops import make_attention_fn
     from autodist_tpu.ops.flash_attention import flash_wins
 
@@ -284,10 +293,10 @@ def _bench(dog):
     # ---- Stage 1: scored run at the base config -----------------------
     dog.stage = f"scored run (einsum/b{batch_per_chip}: build+compile+steps)"
     runners = {}   # attention name -> runner (shared across batch sizes)
-    batches = {batch_per_chip: make_batch(batch_per_chip)}
+    batches = {batch_per_chip: make_batches(batch_per_chip, steps)}
     try:
         runners["einsum"] = build_runner(None)
-        dt = timed(runners["einsum"], batches[batch_per_chip], steps)
+        dt = timed(runners["einsum"], batches[batch_per_chip])
     except Exception as e:
         # Nothing has been measured yet, so every failure here must
         # still end in the one well-formed fail-record shape the driver
@@ -308,11 +317,11 @@ def _bench(dog):
                        dt_step=dt / steps)
     save_snapshot(best)
 
-    # ---- Stage 2: opportunistic probes with the remaining budget ------
+    # ---- Stage 2: scored attempts at the other configs ----------------
     candidates = []
     if on_accel:
         # A committed flash_tuning.json settles whether this sequence
-        # length is worth a flash probe without burning one:
+        # length is worth a flash attempt without burning one:
         # measured-lost drops the candidate, measured-won promotes it.
         candidates = [("einsum", 2 * batch_per_chip),
                       ("einsum", 4 * batch_per_chip)]
@@ -324,31 +333,35 @@ def _bench(dog):
             candidates.append(("flash", 2 * batch_per_chip))
         else:
             print("# flash_tuning.json: einsum wins at this length; "
-                  "skipping flash probe", flush=True)
+                  "skipping flash attempt", flush=True)
     # A cold compile on a degraded tunnel has been observed to take
-    # >10 min; a probe only starts with room for that compile plus its
-    # steps plus the stage-3 re-score.
+    # >10 min; an attempt only starts with room for that compile plus
+    # its two k-step dispatches.
     PROBE_FLOOR = 900.0
     retried = False
-    probes = {}    # config -> examples/sec from a 5-step probe
+    best_rate = base_rate
     for name, b in candidates:
         if time_left() < PROBE_FLOOR:
-            print(f"# skipping probe {name}/b{b}: {int(time_left())}s "
+            print(f"# skipping attempt {name}/b{b}: {int(time_left())}s "
                   "left in budget", flush=True)
             continue
-        dog.stage = f"probe {name}/b{b} (build+compile+steps)"
+        dog.stage = f"scored run ({name}/b{b}: build+compile+steps)"
         if b not in batches:
-            batches[b] = make_batch(b)
+            batches[b] = make_batches(b, steps)
         for attempt in (0, 1):
             try:
                 if name not in runners:
                     runners[name] = build_runner(attn_impls[name])
-                dt = timed(runners[name], batches[b], 5)
-                probes[(name, b)] = b * n * 5 / dt
+                dt = timed(runners[name], batches[b])
+                rate = b * n * steps / dt
+                if rate > best_rate:
+                    best_rate = rate
+                    best = make_record(name, b, rate, dt_step=dt / steps)
+                    save_snapshot(best)
                 break
             except Exception as e:  # pragma: no cover - must not kill bench
-                print(f"# bench probe {name}/b{b} failed: {e}", flush=True)
-                # One retry for the whole probe stage: compile-transport
+                print(f"# bench attempt {name}/b{b} failed: {e}", flush=True)
+                # One retry for the whole stage: compile-transport
                 # failures (INTERNAL/UNAVAILABLE) are often transient on
                 # a flaky tunnel, but every attempt can burn minutes —
                 # a failing flash build gets dropped, not drained.
@@ -357,24 +370,7 @@ def _bench(dog):
                                 or "UNAVAILABLE" in str(e))):
                     break
                 retried = True
-                print(f"# retrying probe {name}/b{b} once", flush=True)
-
-    # ---- Stage 3: re-score a winning probe ----------------------------
-    # The probe's own compile is cached, so the scored re-run costs only
-    # the steps themselves; the 2% bar covers 5-step probe jitter.
-    if probes:
-        (name, b), rate = max(probes.items(), key=lambda kv: kv[1])
-        if rate > base_rate * 1.02 and time_left() > 120:
-            dog.stage = f"scored run ({name}/b{b})"
-            try:
-                dt = timed(runners[name], batches[b], steps)
-                scored_rate = b * n * steps / dt
-                if scored_rate > base_rate:
-                    best = make_record(name, b, scored_rate,
-                                       dt_step=dt / steps)
-                    save_snapshot(best)
-            except Exception as e:  # pragma: no cover - must not kill bench
-                print(f"# re-score {name}/b{b} failed: {e}", flush=True)
+                print(f"# retrying attempt {name}/b{b} once", flush=True)
 
     dog.stage = "memory stats + report"
     mfu = best["value"]
@@ -411,9 +407,8 @@ def _bench(dog):
              str(os.getpid()), "300"], stderr=subprocess.DEVNULL)
         try:
             with jax.profiler.trace(prof_dir):
-                for _ in range(3):
-                    metrics = runner.step(data)
-                fence(metrics["loss"])
+                # one steps-per-loop dispatch: the exact scored program
+                fence(runner.run_steps(data)["loss"][-1])
             print(f"# profile trace written to {prof_dir}", flush=True)
         except Exception as e:  # pragma: no cover - capture must not kill bench
             print(f"# profile capture failed: {e}", flush=True)

@@ -69,19 +69,88 @@ def run_benchmark(runner, make_batch: Callable[[int], dict], *,
                   batch_size: int, train_steps: int, warmup_steps: int,
                   log_steps: int, logger: BenchmarkLogger,
                   flops_per_example: Optional[float] = None,
-                  peak_flops: Optional[float] = None) -> dict:
+                  peak_flops: Optional[float] = None,
+                  steps_per_loop: Optional[int] = None) -> dict:
     """Timed training loop with windowed examples/sec reports
     (≙ ``TimeHistory``: examples/sec = batch_size × log_steps / elapsed,
     reference ``examples/benchmark/imagenet.py:84-140``).
 
-    Batches ride the prefetching :class:`~autodist_tpu.data.DataLoader`
-    (host→HBM transfer overlaps compute) and each timed step is fenced by
-    fetching a metric scalar to the host — proxied/async backends may
-    return from ``block_until_ready`` before execution finishes."""
-    from autodist_tpu.data import DataLoader
+    When the runner supports :meth:`run_steps`, each report window runs
+    as ONE fused device dispatch of ``steps_per_loop`` (default
+    ``log_steps``) steps — host dispatch cost and the fencing round-trip
+    are paid once per window instead of once per step, which on
+    remote/tunneled backends is the difference between measuring the
+    chip and measuring the transport.  Pass ``steps_per_loop=1`` to
+    force the legacy per-step loop (per-step latency percentiles).
+    Every window reuses one executable shape: warmup is one fused
+    window, and ``train_steps`` is measured in ``train_steps //
+    steps_per_loop`` whole windows.
+
+    On the per-step path batches ride the prefetching
+    :class:`~autodist_tpu.data.DataLoader` (host→HBM transfer overlaps
+    compute) and each timed step is fenced by fetching a metric scalar —
+    proxied/async backends may return from ``block_until_ready`` before
+    execution finishes."""
+    import jax
 
     def fence(metrics):
-        return float(np.asarray(next(iter(metrics.values()))))
+        leaf = np.asarray(next(iter(metrics.values())))
+        return float(leaf if leaf.ndim == 0 else leaf[-1])
+
+    fused = steps_per_loop != 1 and hasattr(runner, "run_steps")
+    if fused:
+        # One executable shape for warmup and every window: k is capped
+        # by train_steps so a tiny run is not inflated to a full
+        # log_steps window, and the warmup dispatch (which is also the
+        # compile) replaces warmup_steps — it is always exactly k steps.
+        k = min(int(steps_per_loop or log_steps), train_steps)
+        windows = max(train_steps // k, 1)
+        if windows * k != train_steps:
+            print(f"# fused loop measures {windows * k} of "
+                  f"{train_steps} requested steps ({windows} whole "
+                  f"windows of {k}); pass steps_per_loop=1 for exact "
+                  "per-step counts", flush=True)
+
+        def stacked(i0):
+            bs = [make_batch(i0 + j) for j in range(k)]
+            return jax.tree.map(lambda *xs: np.stack(xs), *bs)
+
+        fence(runner.run_steps(stacked(0)))   # compile + warmup window
+        # Fence the *state* too: the donated-state update can outlive
+        # the metrics buffers and must not bleed into the timed window.
+        state = getattr(runner, "state", None)
+        if state is not None:
+            float(np.asarray(state["step"]))
+        times = []
+        for w in range(windows):
+            data = stacked(k * (w + 1))
+            t0 = time.perf_counter()
+            metrics = runner.run_steps(data)
+            fence(metrics)
+            dt = time.perf_counter() - t0
+            times.append(dt)
+            logger.log_metric("examples_per_sec", batch_size * k / dt,
+                              "examples/s", step=k * (w + 1))
+        mean_s = float(np.sum(times)) / (windows * k)
+        summary = {
+            "examples_per_sec": batch_size / mean_s,
+            "step_ms_mean": mean_s * 1e3,
+            # per-window mean; per-step percentiles need steps_per_loop=1
+            "step_ms_p50": float(np.percentile(times, 50) / k * 1e3),
+            "steps_per_loop": k,
+            "steps_measured": windows * k,
+        }
+        if flops_per_example and peak_flops:
+            summary["mfu"] = (summary["examples_per_sec"]
+                              * flops_per_example / peak_flops)
+        logger.log_metric("examples_per_sec_final",
+                          summary["examples_per_sec"], "examples/s",
+                          step=windows * k,
+                          extras={kk: v for kk, v in summary.items()
+                                  if kk != "examples_per_sec"})
+        return summary
+
+    from autodist_tpu.data import DataLoader
 
     loader = iter(DataLoader(make_batch, runner.mesh, buffer_size=2,
                              num_batches=warmup_steps + train_steps))

@@ -1,0 +1,80 @@
+"""run_steps: k optimizer steps in one dispatch must be bit-equivalent
+to k sequential step() calls (steps-per-loop is an execution detail, not
+a semantics change)."""
+import jax
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import AllReduce, AutoDist, PartitionedPS, ZeRO
+
+from test_end_to_end import make_batch, make_trainable
+
+
+def stack_batches(batches):
+    return jax.tree.map(lambda *xs: np.stack(xs), *batches)
+
+
+@pytest.mark.parametrize("name,builder", [
+    ("AllReduce", lambda: AllReduce(chunk_size=2)),
+    ("PartitionedPS", lambda: PartitionedPS()),
+    ("ZeRO2", lambda: ZeRO(stage=2)),
+], ids=["AllReduce", "PartitionedPS", "ZeRO2"])
+def test_run_steps_matches_sequential(name, builder):
+    batches = [make_batch(s) for s in range(4)]
+    rngs = jax.random.split(jax.random.PRNGKey(7), 4)
+
+    seq = AutoDist({}, builder()).build(
+        make_trainable(optimizer=optax.adam(1e-2)))
+    for b, r in zip(batches, rngs):
+        last = seq.step(b, rng=r)
+
+    fused = AutoDist({}, builder()).build(
+        make_trainable(optimizer=optax.adam(1e-2)))
+    metrics = fused.run_steps(stack_batches(batches), rngs=rngs)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
+        fused.get_params(), seq.get_params())
+    assert fused.step_count == seq.step_count == 4
+    # metrics carry the per-step leading axis; the last slice is step()'s
+    # fetch contract
+    np.testing.assert_allclose(np.asarray(metrics["loss"])[-1],
+                               np.asarray(last["loss"]), rtol=1e-6)
+    assert np.asarray(metrics["loss"]).shape[0] == 4
+
+
+def test_run_steps_then_step_interleave():
+    """State handoff between fused and per-step dispatch is seamless."""
+    batches = [make_batch(s) for s in range(3)]
+    rngs = jax.random.split(jax.random.PRNGKey(3), 3)
+
+    seq = AutoDist({}, AllReduce()).build(make_trainable())
+    for b, r in zip(batches, rngs):
+        seq.step(b, rng=r)
+
+    mixed = AutoDist({}, AllReduce()).build(make_trainable())
+    mixed.run_steps(stack_batches(batches[:2]), rngs=rngs[:2])
+    mixed.step(batches[2], rng=rngs[2])
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
+        mixed.get_params(), seq.get_params())
+
+
+def test_run_steps_ragged_leading_dim_raises():
+    runner = AutoDist({}, AllReduce()).build(make_trainable())
+    bad = {"x": np.zeros((2, 16, 6), np.float32),
+           "y": np.zeros((3, 16, 3), np.float32)}
+    with pytest.raises(ValueError, match="leading steps dimension"):
+        runner.run_steps(bad)
+
+
+def test_run_steps_scalar_leaf_raises():
+    """Duplicate-feed scalars must arrive stacked [k] (one per step) —
+    an unstacked scalar gets the contract error, not an IndexError."""
+    runner = AutoDist({}, AllReduce()).build(make_trainable())
+    bad = {"s": np.float32(1.0),
+           "x": np.zeros((2, 16, 6), np.float32)}
+    with pytest.raises(ValueError, match="leading steps dimension"):
+        runner.run_steps(bad)
