@@ -28,7 +28,7 @@ if _os.environ.get("JAX_PLATFORMS"):
 from autodist_tpu.autodist import AutoDist
 from autodist_tpu.capture import PipelineTrainable, Trainable, VarInfo
 from autodist_tpu.resource import ResourceSpec
-from autodist_tpu.runner import DistributedRunner
+from autodist_tpu.runner import DistributedRunner, stack_steps
 from autodist_tpu.strategy.builders import (AllReduce, GradAccumulation,
                                             Parallax, PartitionedAR,
                                             PartitionedPS, PS,
@@ -47,7 +47,7 @@ from autodist_tpu.fetches import fetch
 
 __all__ = [
     "AutoDist", "Trainable", "PipelineTrainable", "VarInfo", "ResourceSpec",
-    "DistributedRunner",
+    "DistributedRunner", "stack_steps",
     "Strategy", "AllReduce", "PS", "PSLoadBalancing", "PartitionedPS",
     "UnevenPartitionedPS", "PartitionedAR", "RandomAxisPartitionAR",
     "Parallax", "ZeRO", "AutoStrategy", "GradAccumulation", "fit",

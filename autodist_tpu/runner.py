@@ -30,6 +30,15 @@ from autodist_tpu.kernel.lowering import Lowered
 from autodist_tpu.utils import logging
 
 
+def stack_steps(batches):
+    """Stack a list of per-step batch pytrees into the ``[k, ...]`` feed
+    :meth:`DistributedRunner.run_steps` consumes (every leaf — scalars
+    included — gains a leading steps axis).  The single definition of
+    that stacking contract; benchmarks and tests share it."""
+    return jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches)
+
+
 class DistributedRunner:
     """Owns (mesh, compiled step fns, state); the training session."""
 

@@ -210,13 +210,14 @@ def _bench(dog):
         ``run_steps`` dispatch (steps-per-loop: the whole timed window is
         one RPC to the device, so tunnel/dispatch latency is paid once,
         not per step)."""
+        from autodist_tpu import stack_steps
+
         def one(i):
             data = bert.synthetic_mlm_batch(i, b * n, seq_len, num_masked,
                                             cfg.vocab_size)
             data.pop("input_mask", None)  # unpadded: no mask pass on scores
             return data
-        return jax.tree.map(lambda *xs: np.stack(xs),
-                            *[one(i) for i in range(k)])
+        return stack_steps([one(i) for i in range(k)])
 
     def build_runner(attention_fn):
         # init batch is shape-only (params are batch-size independent);
@@ -361,6 +362,13 @@ def _bench(dog):
                 break
             except Exception as e:  # pragma: no cover - must not kill bench
                 print(f"# bench attempt {name}/b{b} failed: {e}", flush=True)
+                # A failure mid-dispatch may have consumed the runner's
+                # donated state buffers ("Array has been deleted" on any
+                # later use): drop the runner so a retry — or a later
+                # attempt sharing the name — rebuilds from scratch.
+                bad = runners.pop(name, None)
+                if bad is not None:
+                    bad.close()
                 # One retry for the whole stage: compile-transport
                 # failures (INTERNAL/UNAVAILABLE) are often transient on
                 # a flaky tunnel, but every attempt can burn minutes —
@@ -374,7 +382,11 @@ def _bench(dog):
 
     dog.stage = "memory stats + report"
     mfu = best["value"]
-    runner = runners[best["attention"]]
+    # The best config's runner can be gone: a LATER failed attempt at
+    # another batch size consumed its donated state (the record is
+    # already measured and safe; only the optional profile re-run needs
+    # the live runner).
+    runner = runners.get(best["attention"])
     data = batches[best["batch_per_chip"]]
     for name in list(runners):
         if name != best["attention"]:
@@ -392,7 +404,7 @@ def _bench(dog):
     # profile to close the gap, and the hardware window may not come
     # back for a second run.
     prof_dir = os.environ.get("AUTODIST_TPU_BENCH_PROFILE", "")
-    if prof_dir and on_accel and mfu < 0.45:
+    if prof_dir and on_accel and mfu < 0.45 and runner is not None:
         dog.stage = "profile capture (post-report)"
         # The record above is already printed, so a wedged capture step
         # must not hang until the driver's outer timeout (observed

@@ -56,7 +56,8 @@ def main():
     summary = run_benchmark(
         runner, make_batch, batch_size=batch,
         train_steps=args.train_steps, warmup_steps=args.warmup_steps,
-        log_steps=args.log_steps, logger=logger)
+        log_steps=args.log_steps, logger=logger,
+        steps_per_loop=args.steps_per_loop)
     print(f"ncf/{args.strategy}: {summary['examples_per_sec']:.0f} "
           f"examples/s ({summary['step_ms_mean']:.2f} ms/step, {n} devices)")
     logger.close()

@@ -75,6 +75,18 @@ def test_benchmark_imagenet_tiny():
     assert "resnet18/AllReduce" in out
 
 
+def test_benchmark_imagenet_per_step_loop():
+    """--steps-per-loop 1 keeps the legacy per-step timed loop (true
+    per-step latency percentiles via the prefetching DataLoader)."""
+    out = run_script("examples/benchmark/imagenet.py", "--model", "resnet18",
+                     "--preset", "tiny", "--train-steps", "4",
+                     "--log-steps", "2", "--warmup-steps", "1",
+                     "--steps-per-loop", "1")
+    assert "examples_per_sec_final" in out
+    assert "step_ms_p50" in out          # per-step stat, not window-derived
+    assert "steps_per_loop" not in out   # fused-path keys absent
+
+
 def test_benchmark_imagenet_batch_probe(monkeypatch):
     """The self-tuning batch probe (exercised via the candidate override)
     times each size, picks the examples/sec winner, and reports its

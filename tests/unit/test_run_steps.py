@@ -6,13 +6,10 @@ import numpy as np
 import optax
 import pytest
 
-from autodist_tpu import AllReduce, AutoDist, PartitionedPS, ZeRO
+from autodist_tpu import (AllReduce, AutoDist, PartitionedPS, ZeRO,
+                          stack_steps as stack_batches)
 
 from test_end_to_end import make_batch, make_trainable
-
-
-def stack_batches(batches):
-    return jax.tree.map(lambda *xs: np.stack(xs), *batches)
 
 
 @pytest.mark.parametrize("name,builder", [
@@ -60,6 +57,51 @@ def test_run_steps_then_step_interleave():
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
         mixed.get_params(), seq.get_params())
+
+
+def test_run_steps_sequence_parallel_matches_sequential():
+    """run_steps through the SimpleLowered path (sequence-parallel
+    lowering on a data x seq mesh) — same bit-equivalence contract."""
+    from test_parallel_zero import (SEQ_SPEC, assert_trees_close,
+                                    lm_batches, make_lm_trainable)
+
+    bs = lm_batches(3)
+    rngs = jax.random.split(jax.random.PRNGKey(11), 3)
+
+    seq = AutoDist(SEQ_SPEC, "SequenceParallel").build(
+        make_lm_trainable(sharded=True))
+    for b, r in zip(bs, rngs):
+        seq.step(b, rng=r)
+
+    fused = AutoDist(SEQ_SPEC, "SequenceParallel").build(
+        make_lm_trainable(sharded=True))
+    m = fused.run_steps(stack_batches(bs), rngs=rngs)
+    assert np.asarray(m["loss"]).shape[0] == 3
+    assert_trees_close(fused.get_params(), seq.get_params(),
+                       rtol=1e-6, atol=1e-7)
+
+
+def test_run_steps_pipeline_matches_sequential():
+    """run_steps through the pipeline lowering (data x pipe mesh)."""
+    from test_parallel_ir import (PIPE_SPEC, make_pipeline_trainable,
+                                  pipe_batches)
+
+    bs = pipe_batches(3)
+    rngs = jax.random.split(jax.random.PRNGKey(5), 3)
+
+    seq = AutoDist(PIPE_SPEC, "Pipeline", num_microbatches=2).build(
+        make_pipeline_trainable())
+    for b, r in zip(bs, rngs):
+        seq.step(b, rng=r)
+
+    fused = AutoDist(PIPE_SPEC, "Pipeline", num_microbatches=2).build(
+        make_pipeline_trainable())
+    m = fused.run_steps(stack_batches(bs), rngs=rngs)
+    assert np.asarray(m["loss"]).shape[0] == 3
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
+        fused.get_params(), seq.get_params())
 
 
 def test_run_steps_ragged_leading_dim_raises():
