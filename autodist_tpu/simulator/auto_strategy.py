@@ -242,7 +242,8 @@ class AutoStrategy(StrategyBuilder):
     @staticmethod
     def _fence_metrics(metrics):
         import numpy as np
-        return float(np.asarray(next(iter(metrics.values()))))
+        leaf = np.asarray(next(iter(metrics.values())))
+        return float(leaf if leaf.ndim == 0 else leaf[-1])
 
     @staticmethod
     def _fence_state(runner):
@@ -267,15 +268,30 @@ class AutoStrategy(StrategyBuilder):
             return None
         runner = runner_ctor()
         try:
-            self._fence_metrics(runner.step(self.example_batch))  # compile
+            # Steps-per-loop when the runner supports it: the timed
+            # window is ONE dispatch, so per-step host dispatch noise
+            # cannot skew the candidate ranking.  hasattr is
+            # class-determined, so chief and workers take the same
+            # branch for the same strategy (the SPMD step-count
+            # lockstep requirement).
+            fused = hasattr(runner, "run_steps")
+            if fused:
+                from autodist_tpu.runner import stack_steps
+                stacked = stack_steps([self.example_batch] * max(steps, 1))
+                self._fence_metrics(runner.run_steps(stacked))  # compile
+            else:
+                self._fence_metrics(runner.step(self.example_batch))
             self._fence_state(runner)
             if not client.barrier(f"autostrategy/{gen}/c{i}/t", P,
                                   timeout_ms=self.MEASURE_BARRIER_MS):
                 return None
             t0 = time.perf_counter()
-            for _ in range(steps):
-                metrics = runner.step(self.example_batch)
-            self._fence_metrics(metrics)
+            if fused:
+                self._fence_metrics(runner.run_steps(stacked))
+            else:
+                for _ in range(steps):
+                    metrics = runner.step(self.example_batch)
+                self._fence_metrics(metrics)
             self._fence_state(runner)
             return (time.perf_counter() - t0) / max(steps, 1)
         finally:
@@ -440,20 +456,15 @@ class AutoStrategy(StrategyBuilder):
             return self._measure_multihost(trainable, resource_spec, scored)
         ad = AutoDist(resource_spec, self)
 
-        def fence(metrics):
-            # Same invariant as examples/benchmark/common.py: the
-            # Trainable contract guarantees scalar metrics, not a "loss"
-            # key specifically.
-            return float(np.asarray(next(iter(metrics.values()))))
-
-        def fence_state(runner):
-            # The donated-state update can outlive the metrics buffers
-            # (examples/benchmark/common.py:90-94) and its tail — e.g. a
-            # PS param all-gather — differs per candidate, so both window
-            # edges must fence state, not just metrics.
-            state = getattr(runner, "state", None)
-            if state is not None and "step" in state:
-                float(np.asarray(state["step"]))
+        # ONE fencing contract for single-process and multihost
+        # measurement (a drifted copy would silently skew their relative
+        # candidate timings): the Trainable contract guarantees scalar
+        # metrics ([k]-stacked on the fused path), and the donated-state
+        # update can outlive the metrics buffers with a per-candidate
+        # tail (e.g. a PS param all-gather), so both window edges fence
+        # state too.
+        fence = self._fence_metrics
+        fence_state = self._fence_state
 
         best = None   # (dt, name, strategy, runner)
         top = [t for t in scored if t[1].feasible][: self.measure_top_k]
@@ -461,13 +472,27 @@ class AutoStrategy(StrategyBuilder):
             runner = None
             try:
                 runner = ad.build(trainable, strategy)
-                fence(runner.step(self.example_batch))   # compile step
-                fence_state(runner)
-                t0 = time.perf_counter()
-                for _ in range(self.measure_steps):
-                    metrics = runner.step(self.example_batch)
-                fence(metrics)
-                fence_state(runner)
+                if hasattr(runner, "run_steps"):
+                    # One dispatch per window: per-step host dispatch
+                    # noise cannot skew the ranking (AsyncPSRunner has
+                    # no fused path — its host loop IS the thing being
+                    # measured).
+                    from autodist_tpu.runner import stack_steps
+                    stacked = stack_steps(
+                        [self.example_batch] * self.measure_steps)
+                    fence(runner.run_steps(stacked))     # compile + warm
+                    fence_state(runner)
+                    t0 = time.perf_counter()
+                    fence(runner.run_steps(stacked))
+                    fence_state(runner)
+                else:
+                    fence(runner.step(self.example_batch))   # compile step
+                    fence_state(runner)
+                    t0 = time.perf_counter()
+                    for _ in range(self.measure_steps):
+                        metrics = runner.step(self.example_batch)
+                    fence(metrics)
+                    fence_state(runner)
                 dt = (time.perf_counter() - t0) / self.measure_steps
                 self.measured[name] = dt
                 logging.info("auto-strategy measured %-18s %7.3f ms/step",

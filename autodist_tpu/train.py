@@ -24,7 +24,8 @@ def fit(runner, source: Iterable | Callable[[int], Any], *,
         saver=None, save_every: int = 0,
         resume: bool = True,
         log_every: int = 100,
-        prefetch: int = 2) -> dict:
+        prefetch: int = 2,
+        steps_per_loop: int = 1) -> dict:
     """Train ``runner`` for ``steps`` optimizer steps.
 
     Args:
@@ -42,6 +43,15 @@ def fit(runner, source: Iterable | Callable[[int], Any], *,
         preempted jobs pick up where they left off.
       log_every: throughput/loss log cadence (0 = silent).
       prefetch: device-prefetch depth (see :class:`DataLoader`).
+      steps_per_loop: fuse up to this many steps into one device
+        dispatch (:meth:`DistributedRunner.run_steps`); windows never
+        cross a log/eval/save boundary, so every cadence fires at
+        exactly the same steps as the per-step loop.  Each DISTINCT
+        window size compiles its own k-step program — pick a
+        steps_per_loop that divides the active cadences (or vice versa)
+        to keep one size; misaligned cadences still work but pay a
+        compile per size.  1 (default) keeps per-step dispatch with
+        DataLoader prefetch.
 
     Returns a history dict: ``{"steps", "loss", "eval", "examples_per_sec"}``.
     """
@@ -64,18 +74,52 @@ def fit(runner, source: Iterable | Callable[[int], Any], *,
         # responsibility to fast-forward.
         inner = source
         source = lambda i: inner(start + i)  # noqa: E731
-    loader = iter(DataLoader(source, runner.mesh, buffer_size=prefetch,
-                             num_batches=remaining,
-                             lowered=getattr(runner, "lowered", None)))
     import time
+
+    fused = steps_per_loop > 1 and hasattr(runner, "run_steps")
+    if fused:
+        from autodist_tpu.runner import stack_steps
+
+        it = ((source(i) for i in range(remaining)) if callable(source)
+              else iter(source))
+
+        def next_window_size(step: int) -> int:
+            """Largest window ending at (not crossing) the next cadence
+            boundary, so logs/evals/saves fire at the same steps as the
+            per-step loop."""
+            k = min(steps_per_loop, start + remaining - step)
+            for every in (log_every,
+                          eval_every if eval_source is not None else 0,
+                          save_every if saver is not None else 0):
+                if every:
+                    k = min(k, every - step % every)
+            return k
+
+        batch_iter = lambda k: [b for _, b in zip(range(k), it)]  # noqa: E731
+    loader = None if fused else iter(
+        DataLoader(source, runner.mesh, buffer_size=prefetch,
+                   num_batches=remaining,
+                   lowered=getattr(runner, "lowered", None)))
 
     t0 = time.perf_counter()
     examples = window_examples = 0
     t_window = t0
-    for batch in loader:
-        metrics = runner.step(batch)
+    while runner.step_count < start + remaining:
+        if fused:
+            window = batch_iter(next_window_size(runner.step_count))
+            if not window:
+                break
+            stacked_metrics = runner.run_steps(stack_steps(window))
+            metrics = {k: v[-1] for k, v in stacked_metrics.items()}
+            bsz = _batch_size(window[0]) * len(window)
+        else:
+            try:
+                batch = next(loader)
+            except StopIteration:
+                break
+            metrics = runner.step(batch)
+            bsz = _batch_size(batch)
         step = runner.step_count
-        bsz = _batch_size(batch)
         examples += bsz
         window_examples += bsz
         if log_every and step % log_every == 0:

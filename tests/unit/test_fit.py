@@ -101,3 +101,37 @@ def test_fit_with_pipeline_runner(tmp_path):
     hist = fit(runner2, source, steps=6, saver=saver, log_every=0)
     assert runner2.step_count == 6
     saver.close()
+
+
+def test_fit_steps_per_loop_matches_per_step():
+    """Fused fit hits the same cadence boundaries and (with a per-step
+    rng stream being the only divergence) the same logged step set; the
+    loss history values match the per-step loop when rngs are immaterial
+    (deterministic loss_fn)."""
+    r1 = AutoDist({}, AllReduce()).build(make_trainable())
+    h1 = fit(r1, source, steps=12, log_every=4,
+             eval_source=source, eval_every=6, eval_batches=2)
+
+    r2 = AutoDist({}, AllReduce()).build(make_trainable())
+    h2 = fit(r2, source, steps=12, log_every=4,
+             eval_source=source, eval_every=6, eval_batches=2,
+             steps_per_loop=5)
+    assert r2.step_count == 12
+    assert [s for s, _ in h2["loss"]] == [s for s, _ in h1["loss"]]
+    assert [s for s, _ in h2["eval"]] == [s for s, _ in h1["eval"]]
+    np.testing.assert_allclose(
+        [v for _, v in h2["loss"]], [v for _, v in h1["loss"]],
+        rtol=1e-5, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        r2.get_params(), r1.get_params())
+
+
+def test_fit_steps_per_loop_saves_on_cadence(tmp_path):
+    runner = AutoDist({}, AllReduce()).build(make_trainable())
+    saver = Saver(str(tmp_path))
+    fit(runner, source, steps=9, saver=saver, save_every=3,
+        log_every=0, steps_per_loop=4)
+    assert saver.latest_step() == 9
+    assert runner.step_count == 9
