@@ -448,8 +448,6 @@ class AutoStrategy(StrategyBuilder):
         :meth:`take_cached_runner`."""
         import time
 
-        import numpy as np
-
         from autodist_tpu.autodist import AutoDist
 
         if getattr(resource_spec, "is_multihost", False):

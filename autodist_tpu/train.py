@@ -78,10 +78,12 @@ def fit(runner, source: Iterable | Callable[[int], Any], *,
 
     fused = steps_per_loop > 1 and hasattr(runner, "run_steps")
     if fused:
+        import jax
+
         from autodist_tpu.runner import stack_steps
 
-        it = ((source(i) for i in range(remaining)) if callable(source)
-              else iter(source))
+        it = iter(_iter_source(source, remaining))
+        pending: list = []   # lookahead for shape-change window breaks
 
         def next_window_size(step: int) -> int:
             """Largest window ending at (not crossing) the next cadence
@@ -95,7 +97,25 @@ def fit(runner, source: Iterable | Callable[[int], Any], *,
                     k = min(k, every - step % every)
             return k
 
-        batch_iter = lambda k: [b for _, b in zip(range(k), it)]  # noqa: E731
+        def shape_sig(b):
+            return tuple(np.shape(l) for l in jax.tree.leaves(b))
+
+        def take_window(k: int) -> list:
+            """Up to ``k`` CONSECUTIVE same-shape batches (stack_steps
+            needs uniform leaves; a ragged final batch — fine on the
+            per-step path — just becomes its own window of 1)."""
+            while len(pending) < k:
+                try:
+                    pending.append(next(it))
+                except StopIteration:
+                    break
+            if not pending:
+                return []
+            sig = shape_sig(pending[0])
+            w = []
+            while pending and len(w) < k and shape_sig(pending[0]) == sig:
+                w.append(pending.pop(0))
+            return w
     loader = None if fused else iter(
         DataLoader(source, runner.mesh, buffer_size=prefetch,
                    num_batches=remaining,
@@ -104,14 +124,18 @@ def fit(runner, source: Iterable | Callable[[int], Any], *,
     t0 = time.perf_counter()
     examples = window_examples = 0
     t_window = t0
-    while runner.step_count < start + remaining:
+    # Host-side step mirror: reading runner.step_count would block on
+    # the in-flight (async) window's device state every iteration.
+    step = start
+    while step < start + remaining:
         if fused:
-            window = batch_iter(next_window_size(runner.step_count))
+            window = take_window(next_window_size(step))
             if not window:
                 break
             stacked_metrics = runner.run_steps(stack_steps(window))
             metrics = {k: v[-1] for k, v in stacked_metrics.items()}
             bsz = _batch_size(window[0]) * len(window)
+            step += len(window)
         else:
             try:
                 batch = next(loader)
@@ -119,7 +143,7 @@ def fit(runner, source: Iterable | Callable[[int], Any], *,
                 break
             metrics = runner.step(batch)
             bsz = _batch_size(batch)
-        step = runner.step_count
+            step += 1
         examples += bsz
         window_examples += bsz
         if log_every and step % log_every == 0:

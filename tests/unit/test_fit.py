@@ -135,3 +135,22 @@ def test_fit_steps_per_loop_saves_on_cadence(tmp_path):
         log_every=0, steps_per_loop=4)
     assert saver.latest_step() == 9
     assert runner.step_count == 9
+
+
+def test_fit_steps_per_loop_ragged_final_batch():
+    """An iterable source whose final batch is partial trains under the
+    fused path (the ragged batch becomes its own window) — parity with
+    the per-step loop, which just recompiles for the new shape."""
+    batches = [source(i) for i in range(5)]
+    batches.append({k: v[:8] for k, v in source(5).items()})  # ragged
+
+    r1 = AutoDist({}, AllReduce()).build(make_trainable())
+    fit(r1, list(batches), steps=6, log_every=0)
+
+    r2 = AutoDist({}, AllReduce()).build(make_trainable())
+    fit(r2, list(batches), steps=6, log_every=0, steps_per_loop=4)
+    assert r2.step_count == 6
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        r2.get_params(), r1.get_params())
