@@ -501,7 +501,16 @@ def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
         synced: dict[str, Any] = {}
         new_sync_state: dict[str, Any] = {}
         for key, names in plan.buckets.items():
-            comp = Compressor.create(plan.bucket_compressor.get(key, "none"))
+            comp_name = plan.bucket_compressor.get(key, "none")
+            if n == 1 and comp_name in ("", "none", None):  # ≙ Compressor.create's no-op aliases
+                # Single replica: the allreduce is an identity and
+                # bucketing exists only to amortize collectives — skip
+                # the flatten/concat/slice round trip (a full extra
+                # pass over every gradient through HBM per step).
+                for nm in names:
+                    synced[nm] = g_by_name[nm]
+                continue
+            comp = Compressor.create(comp_name)
             flats = [g_by_name[nm].reshape(-1).astype(jnp.float32)
                      for nm in names]
             concat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]

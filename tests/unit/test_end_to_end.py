@@ -209,3 +209,20 @@ def test_rerun_bit_identical_determinism():
     a, b = run(), run()
     jax.tree.map(lambda x, y: np.testing.assert_array_equal(
         np.asarray(x), np.asarray(y)), a, b)
+
+
+def test_allreduce_single_replica_matches_reference():
+    """n=1 takes the bucketing bypass (the allreduce is an identity);
+    numerics must still match the plain optax loop exactly."""
+    trainable = make_trainable(optimizer=optax.adam(1e-2))
+    batches = [make_batch(s) for s in range(3)]
+    expected = single_device_reference(
+        make_trainable(optimizer=optax.adam(1e-2)), batches)
+
+    ad = AutoDist({"topology": {"num_devices": 1}}, AllReduce(chunk_size=2))
+    runner = ad.build(trainable)
+    for b in batches:
+        runner.step(b)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-6, atol=2e-7),
+        runner.get_params(), jax.device_get(expected))
