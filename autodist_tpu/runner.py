@@ -179,13 +179,7 @@ class DistributedRunner:
                   for i in range(k)]
             return jax.tree.map(lambda *xs: jnp.stack(xs), *ms)
 
-        # Feed contract per step-slice, shifted right by the steps axis
-        # (which is never sharded: scan consumes it sequentially).
-        specs = self.lowered.batch_spec_tree(
-            jax.tree.map(lambda x: x[0], batches))
-        stacked = jax.tree.map(lambda s: P(None, *s), specs,
-                               is_leaf=lambda s: isinstance(s, P))
-        batches = self._place_batch(batches, specs=stacked)
+        batches = self.place_steps(batches)
         if rngs is None:
             self.rng, sub = jax.random.split(self.rng)
             rngs = jax.random.split(sub, k)
@@ -204,6 +198,28 @@ class DistributedRunner:
         self.state, metrics = self._scanned_fn(self.state, batches, rngs)
         self._host_step += k
         return metrics
+
+    def place_steps(self, batches):
+        """Place a ``run_steps`` window on device (the feed contract
+        with every spec shifted right by the leading steps axis, which
+        is never sharded — scan consumes it sequentially).  Idempotent:
+        already-placed leaves pass through ``device_put`` as no-ops, so
+        a static window (benchmark loops) can be placed once and reused
+        across ``run_steps`` calls without re-transferring."""
+        def slice_struct(x):
+            # Shape-only step slice for the spec tree: a real x[0] on a
+            # device-resident leaf would dispatch a gather per call
+            # (batch_spec_tree implementations read only names + ndim).
+            dtype = getattr(x, "dtype", None)
+            return jax.ShapeDtypeStruct(
+                np.shape(x)[1:], dtype if dtype is not None
+                else np.asarray(x).dtype)
+
+        specs = self.lowered.batch_spec_tree(
+            jax.tree.map(slice_struct, batches))
+        stacked = jax.tree.map(lambda s: P(None, *s), specs,
+                               is_leaf=lambda s: isinstance(s, P))
+        return self._place_batch(batches, specs=stacked)
 
     def run(self, data: Iterable, num_steps: Optional[int] = None,
             log_every: int = 0):

@@ -75,7 +75,7 @@ def main():
         runner, lambda step: data, batch_size=batch,
         train_steps=args.train_steps, warmup_steps=args.warmup_steps,
         log_steps=args.log_steps, logger=logger,
-        steps_per_loop=args.steps_per_loop,
+        steps_per_loop=args.steps_per_loop, static_data=True,
         flops_per_example=flops_per_example, peak_flops=peak)
     mfu = summary.get("mfu")
     print(f"bert-{args.bert_config}/{args.strategy}: "
