@@ -104,6 +104,59 @@ def test_run_steps_pipeline_matches_sequential():
         fused.get_params(), seq.get_params())
 
 
+def test_run_steps_ssp_fallback_honors_rngs():
+    """Under an active SSP gate run_steps falls back to per-step
+    dispatch; caller-supplied rngs must drive each step (an rng-dependent
+    loss detects a fallback that silently substitutes self.rng)."""
+    import os
+
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import PS, Trainable
+    from autodist_tpu.runtime import coordination
+    from autodist_tpu.runtime.coordination import CoordServer
+
+    def make_noisy():
+        params = {"w": jnp.ones((6, 3), jnp.float32)}
+
+        def loss_fn(p, batch, rng):
+            keep = jax.random.bernoulli(
+                rng, 0.8, batch["x"].shape).astype(jnp.float32)
+            pred = (batch["x"] * keep) @ p["w"]
+            return jnp.mean((pred - batch["y"]) ** 2)
+
+        return Trainable.from_loss_fn(loss_fn, params, optax.sgd(0.1),
+                                      with_rng=True)
+
+    rng_np = np.random.RandomState(0)
+    bs = [{"x": rng_np.randn(16, 6).astype(np.float32),
+           "y": rng_np.randn(16, 3).astype(np.float32)} for _ in range(3)]
+    rngs = jax.random.split(jax.random.PRNGKey(9), 3)
+
+    server = CoordServer()
+    os.environ["AUTODIST_TPU_COORD_SERVICE"] = f"127.0.0.1:{server.port}"
+    coordination.reset_service_client()
+    try:
+        ad = AutoDist({}, PS(sync=True, staleness=1))
+        seq = ad.build(make_noisy(), ssp_worker="a", ssp_num_workers=1)
+        assert seq._ssp is not None
+        for b, r in zip(bs, rngs):
+            seq.step(b, rng=r)
+
+        fused = ad.build(make_noisy(), ssp_worker="b", ssp_num_workers=1)
+        m = fused.run_steps(stack_batches(bs), rngs=rngs)
+        assert np.asarray(m["loss"]).shape[0] == 3
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
+            fused.get_params(), seq.get_params())
+    finally:
+        os.environ.pop("AUTODIST_TPU_COORD_SERVICE", None)
+        coordination.reset_service_client()
+        server.stop()
+
+
 def test_run_steps_ragged_leading_dim_raises():
     runner = AutoDist({}, AllReduce()).build(make_trainable())
     bad = {"x": np.zeros((2, 16, 6), np.float32),
