@@ -129,6 +129,39 @@ def test_run_steps_pipeline_matches_sequential():
         fused.get_params(), seq.get_params())
 
 
+def test_run_steps_composes_with_checkpoint(tmp_path):
+    """Save after a fused window, restore into a fresh runner, continue
+    fused — bit-identical to an unbroken fused run (steps-per-loop is an
+    execution detail to the checkpoint contract too)."""
+    import optax
+
+    from autodist_tpu.checkpoint.saver import Saver
+
+    bs = [make_batch(s) for s in range(4)]
+    rngs = jax.random.split(jax.random.PRNGKey(21), 4)
+
+    unbroken = AutoDist({}, PartitionedPS()).build(
+        make_trainable(optimizer=optax.adam(1e-2)))
+    unbroken.run_steps(stack_batches(bs), rngs=rngs)
+
+    first = AutoDist({}, PartitionedPS()).build(
+        make_trainable(optimizer=optax.adam(1e-2)))
+    first.run_steps(stack_batches(bs[:2]), rngs=rngs[:2])
+    saver = Saver(str(tmp_path))
+    saver.save(first)
+
+    resumed = AutoDist({}, PartitionedPS()).build(
+        make_trainable(optimizer=optax.adam(1e-2)))
+    saver.restore(resumed)
+    assert resumed.step_count == 2
+    resumed.run_steps(stack_batches(bs[2:]), rngs=rngs[2:])
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        resumed.get_params(), unbroken.get_params())
+
+
 def test_run_steps_ssp_fallback_honors_rngs():
     """Under an active SSP gate run_steps falls back to per-step
     dispatch; caller-supplied rngs must drive each step (an rng-dependent
