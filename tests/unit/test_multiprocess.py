@@ -91,6 +91,16 @@ for step in range(STEPS):
     batch = make_global_batch(local, runner.mesh)
     metrics = runner.step(batch)
 
+# Two more steps as ONE fused dispatch (steps-per-loop across processes):
+# global stacked batches carry the steps axis ahead of the feed spec.
+from jax.sharding import PartitionSpec as P
+gs = [global_batch(3), global_batch(4)]
+half = 16 // 2
+local_stack = {k: np.stack([g[k][pid * half:(pid + 1) * half] for g in gs])
+               for k in gs[0]}
+stacked = make_global_batch(local_stack, runner.mesh, P(None, "data"))
+runner.run_steps(stacked)
+
 if IS_CHIEF:
     params = runner.get_params()
     np.savez(OUT, **params)
@@ -143,7 +153,7 @@ def test_two_process_training_matches_single_process(tmp_path, dummy):
         pred = batch["x"] @ p["w"] + p["b"]
         return jnp.mean((pred - batch["y"]) ** 2)
 
-    for step in range(3):
+    for step in range(5):   # 3 per-step + 2 fused in the script
         r = np.random.RandomState(100 + step)
         batch = {"x": jnp.asarray(r.randn(16, 6), jnp.float32),
                  "y": jnp.asarray(r.randn(16, 3), jnp.float32)}
