@@ -1,0 +1,60 @@
+"""bench.py watchdog monitor: the driver-facing failure reporter.
+
+The monitor runs as a separate process (an in-process alarm cannot
+preempt a wedged PJRT C call); these tests drive the extracted monitor
+source directly — no jax, no accelerator.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _monitor_src():
+    src = open(os.path.join(REPO, "bench.py")).read()
+    return src.split('_MONITOR_SRC = r"""')[1].split('"""')[0]
+
+
+def drive(partial_content, stage="probe x"):
+    d = tempfile.mkdtemp()
+    stage_path = os.path.join(d, "stage")
+    with open(stage_path, "w") as f:
+        f.write(stage)
+    partial = os.path.join(d, "partial")
+    if partial_content is not None:
+        with open(partial, "w") as f:
+            json.dump(partial_content, f)
+    victim = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(30)"])
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _monitor_src(), str(victim.pid),
+             stage_path, "1.0", partial],
+            capture_output=True, text=True, timeout=30)
+    finally:
+        victim.poll() is None and victim.kill()
+        victim.wait()
+    return json.loads(proc.stdout.strip())
+
+
+def test_scored_snapshot_reported_unflagged():
+    """A record carrying "scored" IS a completed measurement (the bench
+    scores first): the watchdog must report it without a partial flag."""
+    rec = drive({"metric": "bert_base_mlm_mfu", "value": 0.41,
+                 "scored": True})
+    assert "partial" not in rec and rec["value"] == 0.41
+
+
+def test_probe_snapshot_flagged_partial():
+    rec = drive({"metric": "bert_base_mlm_mfu", "value": 0.32})
+    assert "best probe rate" in rec["partial"] and rec["value"] == 0.32
+
+
+def test_no_snapshot_yields_stage_diagnostic():
+    rec = drive(None, stage="scored run (einsum/b16)")
+    assert rec["value"] == 0.0
+    assert "scored run (einsum/b16)" in rec["error"]
