@@ -85,31 +85,46 @@ def main():
     names = ["none", "bf16", "bf16_ef", "int8_ef", "int8_ring",
              "powersgd:4"]
     times = {}
+
+    def write_out():
+        # Incremental, atomic: each compressor's compile can take
+        # minutes on a degraded tunnel and the measurement queue runs
+        # this under a timeout — factors measured so far must survive a
+        # mid-run kill.
+        base = times["none"]
+        factors = {n.partition(":")[0]: round(t / base, 4)
+                   for n, t in times.items() if n != "none"}
+        record = {
+            "compressor_factor": factors,
+            "meta": {
+                "backend": jax.default_backend(),
+                "device_kind": devs.flat[0].device_kind,
+                "num_devices": int(devs.size),
+                "buffer_elements": args.size,
+                "baseline_ms": round(base * 1e3, 3),
+                "note": "wall-clock ratio vs uncompressed allreduce; on "
+                        "one device this is compute overhead only (no "
+                        "wire)",
+            },
+        }
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1)
+        os.replace(tmp, args.out)
+        return factors
+
     for name in names:
         try:
             times[name] = time_compressor(name, mesh, x, args.steps)
-            print(f"{name:12s} {times[name]*1e3:8.3f} ms")
+            print(f"{name:12s} {times[name]*1e3:8.3f} ms", flush=True)
+            if "none" in times and len(times) > 1:
+                factors = write_out()
         except Exception as e:  # a compressor that cannot run gets no entry
-            print(f"{name:12s} FAILED: {e}")
+            print(f"{name:12s} FAILED: {e}", flush=True)
     if "none" not in times:
         raise SystemExit("baseline (none) failed; no calibration written")
-    base = times["none"]
-    factors = {n.partition(":")[0]: round(t / base, 4)
-               for n, t in times.items() if n != "none"}
-    record = {
-        "compressor_factor": factors,
-        "meta": {
-            "backend": jax.default_backend(),
-            "device_kind": devs.flat[0].device_kind,
-            "num_devices": int(devs.size),
-            "buffer_elements": args.size,
-            "baseline_ms": round(base * 1e3, 3),
-            "note": "wall-clock ratio vs uncompressed allreduce; on one "
-                    "device this is compute overhead only (no wire)",
-        },
-    }
-    with open(args.out, "w") as f:
-        json.dump(record, f, indent=1)
+    if len(times) == 1:
+        raise SystemExit("only the baseline ran; no calibration written")
     print(f"wrote {args.out}: {factors}")
 
 
