@@ -25,6 +25,7 @@ if _os.environ.get("JAX_PLATFORMS"):
     except Exception:  # pragma: no cover - backend already initialized
         pass
 
+from autodist_tpu import _jax_compat  # noqa: F401  (installs jax.shard_map shim)
 from autodist_tpu.autodist import AutoDist
 from autodist_tpu.capture import PipelineTrainable, Trainable, VarInfo
 from autodist_tpu.resource import ResourceSpec

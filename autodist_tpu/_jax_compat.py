@@ -1,0 +1,39 @@
+"""Version shims over the jax API surface this package targets.
+
+The codebase is written against the current public API (``jax.shard_map``
+with the ``check_vma`` replication-checking knob).  Older jax releases
+(< 0.6) expose the same functionality as
+``jax.experimental.shard_map.shard_map`` with the knob spelled
+``check_rep``.  Importing this module installs a forwarding wrapper at
+``jax.shard_map`` when the top-level name is missing, so every caller —
+the lowerings, the tests, the examples — uses one spelling.
+
+Kept to exactly the aliases the package needs; anything wider belongs in
+a real dependency bump.
+"""
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+                  **kwargs):
+        """``jax.shard_map`` on releases that predate the top-level name
+        (``check_vma`` forwards to the old ``check_rep`` knob)."""
+        kwargs.setdefault("check_rep", check_vma)
+        return _shard_map(f, mesh, in_specs=in_specs, out_specs=out_specs,
+                          **kwargs)
+
+    jax.shard_map = shard_map
+
+if not hasattr(jax.lax, "axis_size"):
+    from jax import lax as _lax
+
+    def _axis_size(axis_name) -> int:
+        """``lax.axis_size`` via the static psum-of-a-literal fast path
+        (psum of a non-tracer returns ``size * x`` without tracing)."""
+        return _lax.psum(1, axis_name)
+
+    jax.lax.axis_size = _axis_size

@@ -329,6 +329,11 @@ def _gather_full(plan: Plan, data_axis: str, stored):
 def _reduce_metrics(tree, data_axis: str):
     """Cross-replica metric reduction: floats average, integer counts
     sum, bool flags OR (each the correct global semantics)."""
+    if lax.axis_size(data_axis) == 1:
+        # Single replica: every reduction is an identity; skip so the
+        # compiled program carries zero collectives (the same bypass
+        # the gradient path takes — tools/hlo_probe.py pins this).
+        return tree
     def red(x):
         dt = jnp.result_type(x)
         if jnp.issubdtype(dt, jnp.inexact):

@@ -15,7 +15,8 @@ import jax
 import jax.numpy as jnp
 
 from autodist_tpu.models.transformer import (EncoderLayer,
-                                             TransformerConfig)
+                                             TransformerConfig,
+                                             dot_product_attention)
 
 
 def _layer_norm(x, scale, bias):
@@ -23,6 +24,58 @@ def _layer_norm(x, scale, bias):
     mu = x.mean(-1, keepdims=True)
     var = ((x - mu) ** 2).mean(-1, keepdims=True)
     return (x - mu) * jax.lax.rsqrt(var + 1e-6) * scale + bias
+
+
+def _flax_layer_norm(x, p, dtype, eps=1e-6):
+    """``nn.LayerNorm`` numerics (stats in fp32, flax's mean-of-squares
+    variance) on a raw ``{"scale", "bias"}`` param dict — the tensor-
+    parallel stage path can't call the flax module on sharded params."""
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = jnp.maximum(jnp.mean(xf * xf, -1, keepdims=True) - mu * mu, 0.0)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(dtype)
+
+
+def _tp_encoder_layer(cfg: TransformerConfig, chunk, x, mask, model_axis):
+    """One encoder layer on Megatron-sharded chunk params.
+
+    The flax :class:`EncoderLayer` math, open-coded so the two
+    activation all-reduces land exactly at the row-parallel boundaries
+    (attention out-projection, mlp ``wo``): qkv and ``wi`` are
+    column-parallel (heads / mlp features sharded — ``chunk`` holds the
+    local slice), attention runs on the local heads, and
+    :func:`~autodist_tpu.parallel.tensor.row_parallel` psums the
+    partial output products before the replicated bias/residual/norm.
+    With ``model_axis=None`` (the sequential reference, tp=1) the same
+    code runs the unsharded math with zero collectives.
+    """
+    from autodist_tpu.parallel.tensor import column_parallel, row_parallel
+
+    dtype = cfg.dtype
+    att = chunk["attention"]
+    x = x.astype(dtype)
+    qkv = column_parallel(x, att["qkv"]["kernel"].astype(dtype),
+                          att["qkv"]["bias"].astype(dtype),
+                          model_axis=model_axis)
+    q, k, v = jnp.moveaxis(qkv, -3, 0)
+    if cfg.attention_fn is not None:
+        out = cfg.attention_fn(q, k, v, mask, None)
+    else:
+        out = dot_product_attention(q, k, v, mask, dropout_rate=0.0,
+                                    dtype=dtype)
+    a = row_parallel(out, att["out"]["kernel"].astype(dtype),
+                     att["out"]["bias"].astype(dtype),
+                     model_axis=model_axis, axes=2)
+    x = _flax_layer_norm(x + a, chunk["ln_attention"], dtype)
+    h = column_parallel(x, chunk["mlp"]["wi"]["kernel"].astype(dtype),
+                        chunk["mlp"]["wi"]["bias"].astype(dtype),
+                        model_axis=model_axis)
+    h = jax.nn.gelu(h)
+    m = row_parallel(h, chunk["mlp"]["wo"]["kernel"].astype(dtype),
+                     chunk["mlp"]["wo"]["bias"].astype(dtype),
+                     model_axis=model_axis)
+    return _flax_layer_norm(x + m, chunk["ln_mlp"], dtype)
 
 
 def make_pipeline_lm_trainable(cfg: TransformerConfig, optimizer, rng, *,
@@ -64,14 +117,29 @@ def make_pipeline_lm_trainable(cfg: TransformerConfig, optimizer, rng, *,
         x = shared["embedding"][tokens].astype(cfg.dtype)
         return x + shared["pos_embed"][None, :L].astype(cfg.dtype)
 
-    def stage_fn(chunk, x, rng_c=None, rows=None):
+    def stage_fn(chunk, x, rng_c=None, rows=None, model_axis=None):
         """One encoder layer; with dropout configured, masks key on
         (chunk, global sample index) — drawn per row under vmap — so the
         pipelined schedule and the sequential reference produce
         identical masks for any microbatch count / data sharding
-        (pipeline_apply's stage_rng contract)."""
+        (pipeline_apply's stage_rng contract).
+
+        ``model_axis`` (set by the pipeline lowering under
+        ``Pipeline(tensor_parallel>1)``): ``chunk`` holds Megatron
+        shards and the layer runs the explicit-collective path of
+        :func:`_tp_encoder_layer`."""
         L = x.shape[1]
         mask = jnp.tril(jnp.ones((L, L), bool))[None, None]
+        if model_axis is not None:
+            if needs_rng:
+                # Dropout masks over model-sharded intermediates have
+                # per-shard shapes; no keying scheme reproduces the
+                # sequential full-tensor draw, so the parity contract
+                # cannot hold — reject instead of drifting silently.
+                raise NotImplementedError(
+                    "tensor_parallel > 1 requires dropout_rate == "
+                    "attention_dropout_rate == 0 in the pipelined LM")
+            return _tp_encoder_layer(cfg, chunk, x, mask, model_axis)
         if not needs_rng or rng_c is None:
             return layer.apply({"params": chunk}, x, mask, True)
         keys = jax.vmap(lambda r: jax.random.fold_in(rng_c, r))(rows)

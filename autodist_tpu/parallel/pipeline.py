@@ -291,7 +291,8 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                     virtual_stages: int = 1, stage_aux: bool = False,
                     shared_params=None, prologue: Callable = None,
                     policies=None, stage_rng: bool = False,
-                    remat: bool = False):
+                    remat: bool = False, tp_specs=None,
+                    model_axis: str = const.MODEL_AXIS):
     """Shared construction for the direct API and the Strategy-IR entry;
     returns a Lowered-contract container.
 
@@ -328,11 +329,51 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
       shard split, divided by the data-replica count for the mean;
     * a ``compressor`` runs the compressed allreduce over the data axes
       (stage grads differ across pipe; shared grads psum over pipe at
-      full precision first)."""
+      full precision first).
+
+    ``tp_specs`` (tensor parallelism inside stages — the dp×pp×tp
+    composition): per-stage-variable tuples of mesh axes, one entry per
+    *non-stacked* dim, naming which dims shard over ``model_axis``
+    (resolved from the Strategy's ``Pipeline(tensor_parallel=...)``
+    partitioner specs by :func:`lower_pipeline_ir`).  Matched stage
+    leaves are stored sharded ``P(pipe, ..., model, ...)``, so inside
+    the shard_map each device holds only its Megatron slice of each
+    chunk; ``stage_fn`` must be TP-aware — accept a ``model_axis=``
+    keyword and mark its column/row-parallel boundaries with the
+    :mod:`autodist_tpu.parallel.tensor` primitives (identity/psum
+    custom-VJP pairs), which insert exactly one activation all-reduce
+    per Megatron block in forward and one in backward.  Grad sync is
+    unchanged: each (pipe, model) coordinate owns its slice, replicas
+    differ along the data axes only; model-replicated stage variables
+    (layer norms, row-parallel biases) compute bitwise-identical
+    gradients on every model member because every boundary activation
+    and cotangent is model-replicated by the psum placement.  ZeRO-1 on
+    a tp-sharded variable is rejected here (its optimizer state already
+    shards with the parameter; ``lower_pipeline_ir`` degrades such
+    requests with a warning before calling)."""
     n = mesh.shape[pipe_axis]
     V = virtual_stages
     C = n * V
     policies = policies or {}
+    tp_specs = dict(tp_specs or {})
+    tp = mesh.shape.get(model_axis, 1) if tp_specs else 1
+    if tp_specs and model_axis not in mesh.shape:
+        raise ValueError(
+            f"tp_specs given but the mesh has no {model_axis!r} axis: "
+            f"{dict(mesh.shape)}")
+    if tp > 1:
+        import inspect
+        try:
+            params_sig = inspect.signature(stage_fn).parameters
+        except (TypeError, ValueError):  # builtins/partials: trust the caller
+            params_sig = {"model_axis": None}
+        if "model_axis" not in params_sig:
+            raise ValueError(
+                "tensor_parallel > 1 needs a TP-aware stage_fn: it must "
+                "accept model_axis= and psum its row-parallel outputs "
+                "(see autodist_tpu.parallel.tensor)")
+        import functools
+        stage_fn = functools.partial(stage_fn, model_axis=model_axis)
     if remat:
         # Each chunk recomputes its forward in the backward pass: live
         # residuals shrink from every chunk intermediate to the chunk
@@ -355,7 +396,29 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     perm = jnp.asarray(chunk_permutation(n, V))
     perm_inv = jnp.asarray(chunk_permutation_inv(n, V))
 
-    stage_specs = jax.tree.map(lambda _: P(pipe_axis), stacked_params)
+    # --- tensor-parallel storage bookkeeping ------------------------------- #
+    def full_stage_name(rel: str) -> str:
+        return f"stages/{rel}" if has_shared else rel
+
+    stage_leaf_names = {full_stage_name(nm) for nm, _ in
+                        common.flatten_with_names(stacked_params)}
+    unknown = set(tp_specs) - stage_leaf_names
+    if unknown:
+        raise ValueError(
+            f"tp_specs name non-stage variables {sorted(unknown)} "
+            f"(stage variables: {sorted(stage_leaf_names)})")
+
+    def tp_shards(name: str) -> int:
+        """Device count the model axis splits one stage leaf over."""
+        return math.prod(mesh.shape[a] for a in tp_specs.get(name, ())
+                         if a is not None)
+
+    def stage_param_spec(name: str) -> P:
+        tail = tp_specs.get(name)
+        return P(pipe_axis, *tail) if tail else P(pipe_axis)
+
+    stage_specs = common.tree_from_names(
+        stacked_params, lambda nm, _: stage_param_spec(full_stage_name(nm)))
     if has_shared:
         p_specs = {"stages": stage_specs,
                    "shared": jax.tree.map(lambda _: P(), shared_params)}
@@ -383,12 +446,19 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
             raise ValueError(
                 f"{name}: a stage variable is already pipe-sharded; its "
                 f"ZeRO axes must not include {pipe_axis!r}")
+        if pol.zero_axes and name in tp_specs:
+            raise ValueError(
+                f"{name}: a tensor-parallel sharded variable's optimizer "
+                "state already shards with the parameter; ZeRO-1 on it "
+                "is a no-op request (lower_pipeline_ir degrades it)")
 
     leaves_by_name = dict(common.flatten_with_names(full_params))
     # Per-device sizes: stage leaves hold this device's V chunks (1/n of
-    # the stack); shared leaves replicate in full.
+    # the stack, further 1/tp for model-axis-sharded leaves); shared
+    # leaves replicate in full.
     local_sizes = {
-        name: (max(int(np.prod(np.shape(leaf))), 1) // n
+        name: (max(int(np.prod(np.shape(leaf))), 1)
+               // (n * tp_shards(name))
                if is_stage_var(name)
                else max(int(np.prod(np.shape(leaf))), 1))
         for name, leaf in leaves_by_name.items()}
@@ -440,6 +510,10 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                 shape_ok=lambda v: tuple(leaf.shape) == u_by_name[v])
             if var is not None and zero_pol(var) is not None:
                 return u_spec(var)
+            if var is not None and var in tp_specs:
+                # Optimizer state of a tensor-parallel sharded stage
+                # variable shards exactly like the parameter.
+                return stage_param_spec(var)
             in_shared = has_shared and any(
                 isinstance(k, jax.tree_util.DictKey) and k.key == "shared"
                 for k in path)
@@ -733,6 +807,28 @@ def lower_pipeline_ir(trainable, strategy, mesh):
     stacked = (trainable.params["stages"] if trainable.has_shared
                else trainable.params)
 
+    # Tensor parallelism inside stages: a Pipeline(tensor_parallel=t)
+    # strategy records the model-axis dims in each stage variable's
+    # partitioner spec ([pipe, ..., model, ...]); resolve them back into
+    # the lowering's per-variable tp_specs (the spec minus its leading
+    # pipe entry).
+    tp_cfg = max(int(cfg.parallel.get("tensor_parallel", 1)), 1)
+    tp_mesh = mesh.shape.get(const.MODEL_AXIS, 1)
+    if tp_cfg > 1 and tp_mesh != tp_cfg:
+        raise ValueError(
+            f"strategy declares tensor_parallel={tp_cfg}; mesh "
+            f"{const.MODEL_AXIS!r} axis has {tp_mesh} devices")
+    tp_specs = {}
+    for nc in strategy.node_configs:
+        part = nc.partitioner
+        if part is not None and part.spec \
+                and const.MODEL_AXIS in part.spec[1:]:
+            tp_specs[nc.var_name] = tuple(part.spec[1:])
+    if tp_specs and tp_mesh == 1:
+        raise ValueError(
+            "strategy shards stage variables over the model axis but the "
+            f"mesh has none: {dict(mesh.shape)}")
+
     # Per-variable synchronizer configs (PS -> ZeRO-1, compressors)
     # compose with the pipeline: stage variables zero/compress over the
     # data axes (they are pipe-sharded already), shared variables zero
@@ -750,7 +846,8 @@ def lower_pipeline_ir(trainable, strategy, mesh):
         return shared_axes
 
     policies = policies_from_node_configs(
-        strategy, mesh, replicated_axes=shared_axes, axes_for=axes_for)
+        strategy, mesh, replicated_axes=shared_axes, axes_for=axes_for,
+        sharded_vars=tp_specs)
     if not d_axes:
         dropped = sorted(nm for nm, p in policies.items()
                          if p.compressor != "none")
@@ -769,4 +866,5 @@ def lower_pipeline_ir(trainable, strategy, mesh):
         prologue=trainable.prologue,
         virtual_stages=V, stage_aux=trainable.stage_aux,
         policies=policies, stage_rng=trainable.stage_rng,
-        remat=bool(cfg.parallel.get("remat", False)))
+        remat=bool(cfg.parallel.get("remat", False)),
+        tp_specs=tp_specs)

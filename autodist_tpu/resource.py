@@ -69,6 +69,46 @@ CHIP_SPECS = {
 }
 
 
+def factor_3d(num_devices: int, *, pipe: int = 1, model: int = 1,
+              data: Optional[int] = None) -> dict[str, int]:
+    """Factor a device count into the canonical 3D ``(data, pipe, model)``
+    mesh shape — the dp×pp×tp composition.
+
+    ``data`` defaults to whatever is left after the pipeline and tensor
+    degrees (``num_devices // (pipe·model)``); passing it explicitly
+    turns the residual check into a full ``dp·pp·tp == num_devices``
+    validation.  Axis order is data-outermost / model-innermost so the
+    reshape-constructed mesh places each tensor-parallel group on
+    adjacent device ids (the highest-volume collectives — the per-block
+    activation all-reduces — ride the shortest links; pipe's one-hop
+    ppermute and data's per-step grad sync tolerate longer paths).
+
+    Size-1 axes other than ``pipe`` are dropped so downstream code sees
+    the same mesh shapes users write by hand (``{'pipe': 4}``, not
+    ``{'data': 1, 'pipe': 4, 'model': 1}``).
+    """
+    if pipe < 1 or model < 1:
+        raise ValueError(f"pipe ({pipe}) and model ({model}) must be >= 1")
+    if num_devices % (pipe * model):
+        raise ValueError(
+            f"cannot factor {num_devices} devices into pipe={pipe} x "
+            f"model={model} (times an integer data degree)")
+    inferred = num_devices // (pipe * model)
+    if data is None:
+        data = inferred
+    elif data * pipe * model != num_devices:
+        raise ValueError(
+            f"dp x pp x tp = {data} x {pipe} x {model} = "
+            f"{data * pipe * model} != {num_devices} devices")
+    shape: dict[str, int] = {}
+    if data > 1:
+        shape[const.DATA_AXIS] = data
+    shape[const.PIPE_AXIS] = pipe
+    if model > 1:
+        shape[const.MODEL_AXIS] = model
+    return shape
+
+
 class ResourceSpec:
     """Parses and validates a topology spec; factory for the device mesh."""
 
@@ -194,6 +234,27 @@ class ResourceSpec:
             raise ValueError(
                 f"mesh shape {shape} does not match {n} devices")
         return shape
+
+    def three_d(self) -> tuple[int, int, int]:
+        """The resolved ``(dp, pp, tp)`` degrees of this topology.
+
+        ``dp`` folds the cross-slice DCN axis in (both are data
+        parallelism), ``pp`` is the pipe axis, ``tp`` the model axis;
+        a topology whose mesh carries any *other* non-trivial axis
+        (seq/expert) is not a 3D composition and is rejected so callers
+        can't mis-price it as one.
+        """
+        shape = self.resolved_mesh_shape()
+        extra = {a: s for a, s in shape.items()
+                 if s > 1 and a not in (const.DATA_AXIS, const.DCN_AXIS,
+                                        const.PIPE_AXIS, const.MODEL_AXIS)}
+        if extra:
+            raise ValueError(
+                f"not a (data, pipe, model) factorization: mesh also "
+                f"carries {extra}")
+        dp = shape.get(const.DATA_AXIS, 1) * shape.get(const.DCN_AXIS, 1)
+        return dp, shape.get(const.PIPE_AXIS, 1), \
+            shape.get(const.MODEL_AXIS, 1)
 
     def make_mesh(self):
         """Build the named device mesh (the resolution step ≙ reference

@@ -48,6 +48,13 @@ def default_candidates() -> list[StrategyBuilder]:
         # Interleaved variant matches trainables with 2 chunks per pipe
         # device (num_stages == 2 x pipe axis); mismatches are skipped.
         parallel_builders.Pipeline(num_microbatches=4, virtual_stages=2),
+        # dp×pp×tp: Megatron TP inside each pipeline stage.  Scores only
+        # when the topology declares a size-2 model axis AND the stage
+        # variables match the tp rule table (qkv/out/wi/wo naming);
+        # otherwise build() raises ValueError and the candidate is
+        # skipped — the cost model then arbitrates tp=1 vs tp=2 on the
+        # per-stage activation all-reduces it prices.
+        parallel_builders.Pipeline(num_microbatches=4, tensor_parallel=2),
         parallel_builders.ExpertParallel(),
     ]
 

@@ -372,15 +372,23 @@ class CostModel:
                 mem += act_hint * tokens_per_dev  # seq divides activations
         elif kind == "pipeline":
             S = mesh.get(const.PIPE_AXIS, 1)
+            tp = mesh.get(const.MODEL_AXIS, 1)
             M = max(int(strategy.graph_config.parallel.get(
                 "num_microbatches", 1)), 1)
             V = max(int(strategy.graph_config.parallel.get(
                 "virtual_stages", 1)), 1)
+            tokens_local = tokens / max(n_data, 1) if tokens else 0.0
             # V chunks of C = S*V total live per device -> stage
             # params/opt at 1/S, grads sync over the data axis; shared
             # (embedding/unembedding) vars replicate and sync over
             # pipe x data.  PS -> ZeRO-1: stage state at 1/(S*n_data),
             # shared state at 1/(S*n_data) too (pipe x data joint shard).
+            # Tensor parallelism inside stages (dp×pp×tp): model-axis
+            # entries in a stage var's spec further divide its state by
+            # tp; each *row*-parallel var (model on the first per-stage
+            # dim: the attention out-proj, mlp wo) adds the Megatron
+            # activation all-reduce over the tp group per chunk
+            # execution, fwd + bwd.
             for info in infos:
                 node = nodes_by_name.get(info.name)
                 bytes_ = float(info.byte_size)
@@ -392,12 +400,26 @@ class CostModel:
                         and part.mesh_axis == const.PIPE_AXIS
                         and part.num_shards > 1))
                 if is_stage:
-                    per_dev = bytes_ / S
-                    opt_div = n_data if (node_is_ps(node)
-                                         and n_data > 1) else 1
+                    spec_tail = (part.spec[1:] if part.spec else [])
+                    tp_sharded = const.MODEL_AXIS in spec_tail
+                    per_dev = bytes_ / (S * (tp if tp_sharded else 1))
+                    # ZeRO on a tp-sharded var degrades (state shards
+                    # with the parameter — lower_pipeline_ir's warning).
+                    opt_div = n_data if (node_is_ps(node) and n_data > 1
+                                         and not tp_sharded) else 1
                     mem += per_dev * 2.0 + per_dev * opt_mult / opt_div
                     comm += ring(n_data) * per_dev * node_factor(node)
                     colls += 2 if opt_div > 1 else 1
+                    # rank >= 2 gates out the column-parallel biases
+                    # (spec tail ['model']), which shard but never
+                    # all-reduce activations.
+                    row_parallel = (len(spec_tail) >= 2
+                                    and spec_tail[0] == const.MODEL_AXIS)
+                    if row_parallel and tp > 1 and tokens:
+                        width = info.shape[-1]
+                        comm += 2.0 * ring(tp) * V * tokens_local \
+                            * width * _ACT_BYTES
+                        colls += 2 * M * V
                 else:
                     n_pd = S * n_data
                     opt_div = n_pd if node_is_ps(node) else 1

@@ -21,10 +21,28 @@ from typing import Sequence
 
 from autodist_tpu import const
 from autodist_tpu.strategy.base import StrategyBuilder
+from autodist_tpu.strategy.gspmd_builders import TRANSFORMER_TP_RULES
 from autodist_tpu.utils import logging
 from autodist_tpu.strategy.ir import (AllReduceSynchronizer, NodeConfig,
                                       PartitionerConfig, PSSynchronizer,
                                       Strategy)
+
+# Megatron-style model-axis rules for tensor parallelism *inside* pipeline
+# stages, matched against the per-stage variable (the stacked leaf minus
+# its leading chunk dim).  The kernel rules are the shared GSPMD table
+# (gspmd_builders.TRANSFORMER_TP_RULES, minus the embedding rule — a
+# pipelined transformer's embedding is a replicated *shared* variable);
+# the bias rules are the manual-collective lowering's addition: GSPMD
+# re-shards a replicated bias against a sharded activation automatically,
+# but shard_map stage code adds bias shards to activation shards
+# elementwise, so column-parallel biases must shard with their kernels.
+PIPELINE_TP_RULES = tuple(
+    (pat, spec) for pat, spec in TRANSFORMER_TP_RULES
+    if "embed" not in pat
+) + (
+    (r"(^|/)qkv/bias$", [None, const.MODEL_AXIS, None]),
+    (r"(^|/)wi/bias$", [const.MODEL_AXIS]),
+)
 
 
 def _default_sync(zero1: bool, compressor: str,
@@ -110,15 +128,26 @@ class Pipeline(StrategyBuilder):
     ``ppermute`` ring.  ``GraphConfig.accum_steps`` (GradAccumulation)
     composes: each accumulation slice runs the full microbatched
     schedule.
+
+    ``tensor_parallel=t`` adds Megatron TP *inside* each stage (the
+    dp×pp×tp composition): stage variables matching ``tp_rules``
+    (default :data:`PIPELINE_TP_RULES`) additionally shard over the
+    ``model`` mesh axis, recorded per variable in the strategy's
+    partitioner specs; the trainable's ``stage_fn`` must be TP-aware
+    (accept ``model_axis=`` — see :mod:`autodist_tpu.parallel.tensor`).
     """
 
     def __init__(self, num_microbatches: int = 1, virtual_stages: int = 1,
                  *, zero1: bool = False, compressor: str = "none",
-                 zero_min_bytes=None, remat: bool = False):
+                 zero_min_bytes=None, remat: bool = False,
+                 tensor_parallel: int = 1,
+                 tp_rules: Sequence[tuple[str, list]] = None):
         if num_microbatches < 1:
             raise ValueError("num_microbatches must be >= 1")
         if virtual_stages < 1:
             raise ValueError("virtual_stages must be >= 1")
+        if tensor_parallel < 1:
+            raise ValueError("tensor_parallel must be >= 1")
         self.num_microbatches = num_microbatches
         self.virtual_stages = virtual_stages
         # Rematerialize each chunk in the backward (jax.checkpoint around
@@ -126,7 +155,33 @@ class Pipeline(StrategyBuilder):
         # activation, trading recompute FLOPs for the memory that
         # otherwise grows with M x V chunk executions per device.
         self.remat = remat
+        # Megatron TP inside each stage: stage variables matching tp_rules
+        # shard over the 'model' mesh axis in addition to 'pipe'; the
+        # stage_fn must be TP-aware (accept model_axis= and psum at its
+        # row-parallel boundaries — parallel/tensor.py primitives).
+        self.tensor_parallel = tensor_parallel
+        self.tp_rules = [(re.compile(pat), list(spec))
+                         for pat, spec in (tp_rules if tp_rules is not None
+                                           else PIPELINE_TP_RULES)]
         self.make_sync = _default_sync(zero1, compressor, zero_min_bytes)
+
+    def _tp_spec_for(self, name: str, stage_shape: tuple, tp: int):
+        """Per-stage model-axis spec for a stage variable, or None.
+
+        First name-matching rule whose rank fits wins; a matching rule
+        whose sharded dims don't divide by the tp degree is a user error
+        (silent replication would quietly train a different program than
+        the strategy declares)."""
+        for pat, spec in self.tp_rules:
+            if not pat.search(name) or len(spec) != len(stage_shape):
+                continue
+            for dim, axis in zip(stage_shape, spec):
+                if axis == const.MODEL_AXIS and dim % tp:
+                    raise ValueError(
+                        f"{name}: per-stage dim {dim} does not divide by "
+                        f"tensor_parallel={tp} (rule spec {spec})")
+            return list(spec)
+        return None
 
     def build(self, trainable, resource_spec):
         shape = resource_spec.resolved_mesh_shape()
@@ -148,25 +203,51 @@ class Pipeline(StrategyBuilder):
                 f"trainable declares {num_stages} stages; mesh pipe axis "
                 f"has {shape[const.PIPE_AXIS]} devices x "
                 f"{self.virtual_stages} virtual stages")
+        tp = self.tensor_parallel
+        if tp > 1 and shape.get(const.MODEL_AXIS, 1) != tp:
+            raise ValueError(
+                f"Pipeline(tensor_parallel={tp}) needs a "
+                f"{const.MODEL_AXIS!r} mesh axis of that size; spec "
+                f"resolves to {shape} — declare e.g. "
+                "mesh: {data: ..., pipe: ..., model: ...}")
         has_shared = getattr(trainable, "has_shared", False)
         nodes = []
+        tp_matched = []
         for i in trainable.var_infos():
             node = NodeConfig(var_name=i.name,
                               synchronizer=self.make_sync(i),
                               is_sparse=i.is_sparse)
             # shared-group vars (embedding/unembedding of a pipelined
-            # transformer) replicate; stage vars shard on the pipe axis.
+            # transformer) replicate; stage vars shard on the pipe axis
+            # (their leading chunk dim), plus — with tensor_parallel —
+            # the model axis on the dims the tp rules name.
             if not has_shared or i.name.startswith("stages/"):
+                tail = [None] * (max(len(i.shape), 1) - 1)
+                if tp > 1:
+                    tp_tail = self._tp_spec_for(i.name, tuple(i.shape[1:]),
+                                                tp)
+                    if tp_tail is not None:
+                        tail = tp_tail
+                        tp_matched.append(i.name)
                 node.partitioner = PartitionerConfig(
                     mesh_axis=const.PIPE_AXIS,
-                    spec=[const.PIPE_AXIS]
-                    + [None] * (max(len(i.shape), 1) - 1))
+                    spec=[const.PIPE_AXIS] + tail)
             nodes.append(node)
+        if tp > 1 and not tp_matched:
+            # ValueError (not a warning): AutoStrategy's candidate loop
+            # skips the builder, and a direct user gets told their
+            # naming doesn't meet the rule table instead of silently
+            # training plain pipeline parallelism on a model mesh axis.
+            raise ValueError(
+                f"Pipeline(tensor_parallel={tp}): no stage variable "
+                "matched the tp rules; name the projections "
+                "qkv/out/wi/wo (PIPELINE_TP_RULES) or pass tp_rules=...")
         cfg = self._graph_config(resource_spec)
         cfg.lowering = "pipeline"
         cfg.parallel = {"num_microbatches": self.num_microbatches,
                         "virtual_stages": self.virtual_stages,
-                        "remat": self.remat}
+                        "remat": self.remat,
+                        "tensor_parallel": tp}
         return Strategy(node_configs=nodes, graph_config=cfg)
 
 

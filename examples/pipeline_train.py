@@ -2,11 +2,16 @@
 
 Beyond reference parity (the reference declared pipeline parallelism
 future work, ``docs/design/architecture.rst:49-51``): a stage-stacked
-MLP trained over the ``pipe`` mesh axis, GPipe or Megatron-interleaved
-(``--virtual-stages 2``), with gradient accumulation composing on top.
+Megatron MLP trained over the ``pipe`` mesh axis, GPipe or interleaved
+(``--virtual-stages 2``), with gradient accumulation composing on top —
+and tensor parallelism *inside* each stage (``--tensor-parallel 2``):
+the mesh factors as dp×pp×tp and each stage's wi/wo matmuls run
+column/row-parallel over the ``model`` axis with one activation
+all-reduce per stage.
 
     python examples/pipeline_train.py --steps 20
     python examples/pipeline_train.py --virtual-stages 2 --microbatches 4
+    python examples/pipeline_train.py --tensor-parallel 2 --stages 2
 """
 import argparse
 import os
@@ -23,6 +28,9 @@ def main():
     ap.add_argument("--virtual-stages", type=int, default=1)
     ap.add_argument("--microbatches", type=int, default=2)
     ap.add_argument("--accum-steps", type=int, default=1)
+    ap.add_argument("--tensor-parallel", type=int, default=1,
+                    help="model-axis devices per stage (Megatron TP "
+                         "inside the pipeline: dp x pp x tp)")
     ap.add_argument("--zero1", action="store_true",
                     help="ZeRO-1: shard optimizer state over the data "
                          "axes (stage vars) / pipe x data (shared vars)")
@@ -39,21 +47,44 @@ def main():
 
     from autodist_tpu import AutoDist, PipelineTrainable
     from autodist_tpu.parallel.pipeline import bubble_fraction
+    from autodist_tpu.parallel.tensor import column_parallel, row_parallel
+    from autodist_tpu.resource import factor_3d
     from autodist_tpu.strategy.builders import GradAccumulation
     from autodist_tpu.strategy.parallel_builders import Pipeline
 
     n = jax.device_count()
-    pp = min(args.stages, n)
-    dp = n // pp
+    tp = args.tensor_parallel
+    if tp < 1 or n % tp or n // tp < 1:
+        raise SystemExit(
+            f"--tensor-parallel {tp} must divide the {n} visible devices")
+    pp = min(args.stages, n // tp)
+    if (n // tp) % pp:
+        raise SystemExit(
+            f"--stages resolves to pipe={pp}, which must divide the "
+            f"{n // tp} devices left after tp={tp}")
+    dp = n // (pp * tp)
+    mesh = factor_3d(dp * pp * tp, pipe=pp, model=tp, data=dp)
     C = pp * args.virtual_stages
-    HID = args.hidden
+    HID, FF = args.hidden, 2 * args.hidden
     r = np.random.RandomState(0)
-    stacked = {"w": jnp.asarray(r.randn(C, HID, HID) * (2.0 / HID) ** 0.5,
-                                jnp.float32),
-               "b": jnp.zeros((C, HID), jnp.float32)}
+    # Megatron block per stage: wi column-parallel, wo row-parallel —
+    # the same variable naming the Pipeline builder's tp rule table keys
+    # on (qkv/out/wi/wo).
+    stacked = {
+        "wi": {"kernel": jnp.asarray(
+                   r.randn(C, HID, FF) * (2.0 / HID) ** 0.5, jnp.float32),
+               "bias": jnp.zeros((C, FF), jnp.float32)},
+        "wo": {"kernel": jnp.asarray(
+                   r.randn(C, FF, HID) * (2.0 / FF) ** 0.5, jnp.float32),
+               "bias": jnp.zeros((C, HID), jnp.float32)},
+    }
 
-    def stage(p, x):
-        return jax.nn.relu(x @ p["w"] + p["b"])
+    def stage(p, x, model_axis=None):
+        h = jax.nn.relu(column_parallel(x, p["wi"]["kernel"],
+                                        p["wi"]["bias"],
+                                        model_axis=model_axis))
+        return row_parallel(h, p["wo"]["kernel"], p["wo"]["bias"],
+                            model_axis=model_axis)
 
     def head(outputs, batch):
         loss = jnp.mean((outputs - batch["y"]) ** 2)
@@ -63,15 +94,15 @@ def main():
                                   num_stages=C)
     builder = Pipeline(num_microbatches=args.microbatches,
                        virtual_stages=args.virtual_stages,
+                       tensor_parallel=tp,
                        zero1=args.zero1, remat=args.remat)
     if args.accum_steps > 1:
         builder = GradAccumulation(builder, steps=args.accum_steps)
-    mesh = {"data": dp, "pipe": pp} if dp > 1 else {"pipe": pp}
-    runner = AutoDist({"topology": {"num_devices": dp * pp}, "mesh": mesh},
-                      builder).build(trainable)
+    runner = AutoDist({"topology": {"num_devices": dp * pp * tp},
+                       "mesh": mesh}, builder).build(trainable)
 
     print(f"pipe={pp} x virtual={args.virtual_stages} "
-          f"(C={C} chunks), dp={dp}, M={args.microbatches}; "
+          f"(C={C} chunks), dp={dp}, tp={tp}, M={args.microbatches}; "
           f"schedule bubble = "
           f"{bubble_fraction(args.microbatches, pp, args.virtual_stages):.3f}")
     target = r.randn(HID, HID).astype(np.float32) * 0.1

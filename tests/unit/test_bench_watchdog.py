@@ -58,3 +58,47 @@ def test_no_snapshot_yields_stage_diagnostic():
     rec = drive(None, stage="scored run (einsum/b16)")
     assert rec["value"] == 0.0
     assert "scored run (einsum/b16)" in rec["error"]
+
+
+# --------------------------------------------------------------------------- #
+# UNAVAILABLE-backend handling: retry with capped exponential backoff,
+# then a well-formed skipped record with rc=0 — never rc=3 (BENCH_r*.json
+# must not record a missing backend as a crash).
+# --------------------------------------------------------------------------- #
+def _run_py(code, attempt, backoff="0.01"):
+    env = dict(os.environ, AUTODIST_TPU_BENCH_ATTEMPT=str(attempt),
+               AUTODIST_TPU_BENCH_BACKOFF=backoff, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          capture_output=True, text=True, timeout=120,
+                          env=env)
+
+
+def test_backoff_delay_is_capped_exponential():
+    proc = _run_py("import bench; print([bench._backoff_delay(a) "
+                   "for a in (1, 2, 3, 5)])", attempt=1)
+    assert proc.returncode == 0, proc.stderr
+    assert "[5.0, 10.0, 20.0, 60.0]" in proc.stdout
+
+
+def test_unavailable_final_attempt_exits_zero_with_skipped_record():
+    proc = _run_py("import bench; "
+                   "bench._unavailable_exit('boom UNAVAILABLE')",
+                   attempt=3)
+    assert proc.returncode == 0, proc.stderr
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["skipped"] is True
+    assert rec["value"] == 0.0 and "UNAVAILABLE" in rec["error"]
+    assert rec["metric"] == "bert_base_mlm_mfu"  # the greppable shape
+
+
+def test_unavailable_early_attempt_backs_off_and_reexecs():
+    code = ("import os, sys, bench\n"
+            "def fake_execve(path, argv, env):\n"
+            "    print('EXEC attempt', env['AUTODIST_TPU_BENCH_ATTEMPT'])\n"
+            "    sys.exit(7)\n"
+            "os.execve = fake_execve\n"
+            "bench._unavailable_exit('boom UNAVAILABLE')\n")
+    proc = _run_py(code, attempt=1)
+    assert proc.returncode == 7, (proc.stdout, proc.stderr)
+    assert "retrying" in proc.stdout
+    assert "EXEC attempt 2" in proc.stdout

@@ -1,0 +1,46 @@
+"""The committed analytic-default calibration.json: the calibration
+mechanism exists as a *file* (loaded by ``cost_model.load_calibration``),
+not just as the ``tools/calibrate_compressors.py`` writer."""
+import json
+import os
+
+from autodist_tpu.simulator import cost_model as cm
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+CALIB = os.path.join(REPO, "calibration.json")
+
+
+def test_repo_calibration_file_exists_and_is_well_formed():
+    with open(CALIB) as f:
+        data = json.load(f)
+    assert data["meta"]["backend"] == "analytic"
+    factors = data["compressor_factor"]
+    # Every committed factor names a compressor the cost model knows,
+    # and the analytic defaults agree with the in-code table (the file
+    # is the serialization of the defaults until silicon measures them).
+    assert set(factors) == set(cm.COMPRESSOR_FACTOR)
+    for name, value in factors.items():
+        assert 0.0 < value <= 1.0, (name, value)
+
+
+def test_repo_calibration_autoloads(monkeypatch):
+    """With no explicit path and no env override, load_calibration finds
+    the repo-root file (analytic provenance passes the cpu gate)."""
+    monkeypatch.delenv("AUTODIST_TPU_CALIBRATION", raising=False)
+    applied = cm.load_calibration()
+    with open(CALIB) as f:
+        expected = json.load(f)["compressor_factor"]
+    assert applied == expected
+    for name, value in expected.items():
+        assert cm.COMPRESSOR_FACTOR[name] == value
+
+
+def test_explicit_path_beats_default(tmp_path, monkeypatch):
+    other = tmp_path / "measured.json"
+    other.write_text(json.dumps(
+        {"meta": {"backend": "v5e"},
+         "compressor_factor": {"bf16": 0.44}}))
+    monkeypatch.setitem(cm.COMPRESSOR_FACTOR, "bf16", 0.5)
+    assert cm.load_calibration(str(other)) == {"bf16": 0.44}
+    assert cm.COMPRESSOR_FACTOR["bf16"] == 0.44

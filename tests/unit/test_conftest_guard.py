@@ -1,0 +1,37 @@
+"""The conftest TPU-only-import collection guard (CI hygiene): an
+unmarked tier-1 test module must not import TPU-only paths."""
+import textwrap
+
+from conftest import TPU_ONLY_IMPORT_PREFIXES, _tpu_only_imports
+
+
+def test_detects_top_level_tpu_imports(tmp_path):
+    mod = tmp_path / "test_x.py"
+    mod.write_text(textwrap.dedent("""
+        import jax.experimental.pallas.tpu as pltpu
+        from autodist_tpu.ops.flash_attention import flash_attention
+
+        def test_a():
+            pass
+    """))
+    found = _tpu_only_imports(str(mod))
+    assert "jax.experimental.pallas.tpu" in found
+    assert "autodist_tpu.ops.flash_attention" in found
+
+
+def test_function_local_imports_are_not_flagged(tmp_path):
+    mod = tmp_path / "test_y.py"
+    mod.write_text(textwrap.dedent("""
+        import jax
+
+        def test_b():
+            from autodist_tpu.ops.flash_attention import flash_attention
+            assert flash_attention
+    """))
+    # A buried import is a runtime gate the test owns; the guard only
+    # polices top-level imports that break collection.
+    assert _tpu_only_imports(str(mod)) == set()
+
+
+def test_prefix_table_is_nonempty():
+    assert "libtpu" in TPU_ONLY_IMPORT_PREFIXES

@@ -34,8 +34,14 @@ def test_fit_trains_and_reports():
     assert hist["examples_per_sec"] > 0
     logged = dict(hist["loss"])
     assert set(logged) == {4, 8, 12}
-    assert logged[12] < logged[4]
     assert [s for s, _ in hist["eval"]] == [6, 12]
+    # Learning is asserted on the eval history: both entries average the
+    # same fixed eval batches, so the comparison is apples-to-apples;
+    # per-step train losses land on fresh random batches and are not
+    # monotonic.
+    evals = dict(hist["eval"])
+    assert float(np.asarray(evals[12]["loss"])) \
+        < float(np.asarray(evals[6]["loss"]))
 
 
 def test_fit_resumes_from_saver(tmp_path):

@@ -1,0 +1,44 @@
+"""HLO-structural falsifiability (tools/hlo_probe.py): the perf claims
+the VERDICT demanded silicon-free proof for, asserted as collective
+counts/kinds in compiled HLO on the simulated CPU mesh."""
+from tools.hlo_probe import (collective_counts, probe_pipeline_tp,
+                             probe_single_replica, probe_steps_per_loop)
+
+
+def test_collective_counts_parses_hlo_idioms():
+    text = """
+  %all-reduce.1 = f32[8]{0} all-reduce(f32[8]{0} %p), replica_groups={{0,1}}
+  %ag = (f32[4]{0}, f32[4]{0}) all-gather-start(f32[2]{0} %x), dimensions={0}
+  %cp = f32[8]{0} collective-permute(f32[8]{0} %y), source_target_pairs={{0,1}}
+  %fusion.all-reduce-ish = f32[] fusion(f32[] %z), kind=kLoop
+"""
+    counts = collective_counts(text)
+    assert counts["all-reduce"] == 1
+    assert counts["all-gather"] == 1
+    assert counts["collective-permute"] == 1
+    assert counts["reduce-scatter"] == 0 and counts["all-to-all"] == 0
+
+
+def test_steps_per_loop_is_one_fused_dispatch():
+    """k fused steps: one module, a while loop, and the one-step
+    program's collective counts (scan body not unrolled)."""
+    report = probe_steps_per_loop(k=4)
+    assert report["fused_loop"]
+    assert report["collectives_k_steps"] == report["collectives_one_step"]
+    assert report["collectives_one_step"]["all-reduce"] >= 1
+
+
+def test_single_replica_bypass_emits_zero_all_reduce():
+    report = probe_single_replica()
+    assert report["collectives"]["all-reduce"] == 0
+    assert sum(report["collectives"].values()) == 0
+
+
+def test_pipeline_tp_emits_model_axis_collectives():
+    """tensor_parallel=2 adds the per-stage Megatron activation
+    all-reduces (>= 4: out-proj + wo, forward + backward) on top of the
+    tp=1 pipeline program, which itself carries the ppermute ring."""
+    report = probe_pipeline_tp()
+    assert report["collectives_tp1"]["collective-permute"] > 0
+    assert report["collectives_tp2"]["collective-permute"] > 0
+    assert report["model_axis_all_reduces"] >= 4

@@ -254,11 +254,13 @@ def test_pipeline_strategy_serializes():
     assert strategy.graph_config.lowering == "pipeline"
     assert strategy.graph_config.parallel == {"num_microbatches": 2,
                                               "virtual_stages": 1,
-                                              "remat": False}
+                                              "remat": False,
+                                              "tensor_parallel": 1}
     clone = Strategy.from_json(strategy.to_json())
     assert clone.graph_config.parallel == {"num_microbatches": 2,
                                            "virtual_stages": 1,
-                                           "remat": False}
+                                           "remat": False,
+                                           "tensor_parallel": 1}
     # every stage variable is pipe-sharded in the IR
     for n in clone.node_configs:
         assert n.partitioner.spec[0] == "pipe"

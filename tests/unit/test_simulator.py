@@ -395,7 +395,11 @@ def test_cpu_provenance_calibration_skipped_on_autoload(tmp_path, monkeypatch):
          "meta": {"backend": "cpu"}}))
     monkeypatch.setitem(cm.COMPRESSOR_FACTOR, "int8_ring", 0.25)
     monkeypatch.setenv("AUTODIST_TPU_CALIBRATION", str(calib))
-    assert cm.load_calibration() == {}
+    # The cpu-provenance env candidate is skipped; auto-load falls
+    # through to the committed repo-root calibration.json (analytic
+    # provenance), whose int8_ring matches the default 0.25.
+    applied = cm.load_calibration()
+    assert applied.get("int8_ring") == 0.25
     assert cm.COMPRESSOR_FACTOR["int8_ring"] == 0.25
     # explicit path overrides the provenance gate
     assert cm.load_calibration(str(calib)) == {"int8_ring": 37.4}

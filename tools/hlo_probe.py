@@ -1,0 +1,228 @@
+"""HLO-structural proof of the framework's performance claims — on CPU.
+
+Round-5 VERDICT demanded silicon-free falsifiability: every "we emit
+fewer/better collectives" claim must be checkable without the flaky TPU
+tunnel.  This probe lowers real train-step programs with
+``jax.jit(...).lower(...).compile()`` on simulated CPU meshes and
+asserts collective *counts and kinds* in the optimized HLO text:
+
+* ``probe_steps_per_loop`` — ``run_steps``'s k-step program is ONE HLO
+  module whose scan is a ``while`` loop with the *same* collective
+  counts as the single-step program: k optimizer steps fuse into one
+  dispatch instead of unrolling (or worse, k dispatches).
+* ``probe_single_replica`` — the single-replica allreduce bypass
+  (kernel/lowering.py): a 1-device program contains zero ``all-reduce``
+  ops.
+* ``probe_pipeline_tp`` — the dp×pp×tp composition: at
+  ``tensor_parallel=2`` the pipeline step carries the per-stage
+  ``model``-axis activation all-reduces (Megatron's one-per-block,
+  forward and backward) *on top of* the tp=1 program's collectives, and
+  both carry the ``collective-permute`` stage ring.
+
+Run as a script for a JSON report::
+
+    JAX_PLATFORMS=cpu python tools/hlo_probe.py
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import re
+import sys
+
+if __name__ == "__main__":  # simulated mesh before the first jax import
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+# HLO spells ops `%name = type all-reduce(...)`; async TPU lowerings
+# split into -start/-done pairs — count the -start as the op.
+_COLLECTIVE_RE = re.compile(
+    r"=\s*(?:\([^)]*\)|\S+)\s*"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?:-start)?\(")
+
+
+def collective_counts(hlo_text: str) -> dict[str, int]:
+    """Count collective ops by kind in optimized HLO text."""
+    counts = collections.Counter(_COLLECTIVE_RE.findall(hlo_text))
+    return {k: counts.get(k, 0)
+            for k in ("all-reduce", "all-gather", "reduce-scatter",
+                      "collective-permute", "all-to-all")}
+
+
+def compiled_text(jitted, *args) -> str:
+    """Optimized (post-SPMD-partitioning) HLO of one jitted program."""
+    return jitted.lower(*args).compile().as_text()
+
+
+def _tiny_trainable():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import Trainable
+
+    params = {"w": jnp.zeros((16, 4), jnp.float32)}
+
+    def loss_fn(p, batch):
+        return jnp.mean((batch["x"] @ p["w"] - batch["y"]) ** 2)
+
+    return Trainable.from_loss_fn(loss_fn, params, optax.sgd(0.1))
+
+
+def _tiny_batch(n: int = 1):
+    import numpy as np
+
+    r = np.random.RandomState(0)
+    return {"x": r.randn(8, 16).astype(np.float32),
+            "y": r.randn(8, 4).astype(np.float32)}
+
+
+def probe_steps_per_loop(k: int = 4) -> dict:
+    """k-step ``run_steps`` program == one module, one loop, the
+    single-step program's collective counts (not k×: the scan body is
+    not unrolled, so steps-per-loop amortizes dispatch, not compute)."""
+    import jax
+    from jax import lax
+
+    from autodist_tpu import AllReduce, AutoDist, stack_steps
+
+    spec = {"topology": {"platform": "cpu", "num_devices": 2}}
+    runner = AutoDist(spec, AllReduce()).build(_tiny_trainable())
+    try:
+        step_fn = runner.lowered.step_fn
+
+        def scanned(state, batches, rngs):
+            def body(s, xs):
+                b, r = xs
+                return step_fn(s, b, r)
+            return lax.scan(body, state, (batches, rngs))
+
+        stacked = runner.place_steps(stack_steps(
+            [_tiny_batch() for _ in range(k)]))
+        rngs = jax.random.split(jax.random.PRNGKey(0), k)
+        text_k = compiled_text(jax.jit(scanned), runner.state, stacked,
+                               rngs)
+        text_1 = compiled_text(step_fn, runner.state,
+                               runner._place_batch(_tiny_batch()),
+                               jax.random.PRNGKey(0))
+    finally:
+        runner.close()
+    counts_k, counts_1 = collective_counts(text_k), collective_counts(text_1)
+    has_loop = " while(" in text_k or "while (" in text_k
+    assert has_loop, "k-step program lowered without a fused loop"
+    assert counts_k == counts_1, (
+        f"k-step program changed per-kind collective counts: one step "
+        f"{counts_1} vs {k} steps {counts_k} — the scan unrolled")
+    return {"k": k, "fused_loop": has_loop,
+            "collectives_one_step": counts_1,
+            "collectives_k_steps": counts_k}
+
+
+def probe_single_replica() -> dict:
+    """1-device program: the allreduce bypass emits ZERO all-reduce ops
+    (and no other cross-device collective either)."""
+    import jax
+
+    from autodist_tpu import AllReduce, AutoDist
+
+    spec = {"topology": {"platform": "cpu", "num_devices": 1}}
+    runner = AutoDist(spec, AllReduce()).build(_tiny_trainable())
+    try:
+        text = compiled_text(runner.lowered.step_fn, runner.state,
+                             runner._place_batch(_tiny_batch()),
+                             jax.random.PRNGKey(0))
+    finally:
+        runner.close()
+    counts = collective_counts(text)
+    assert counts["all-reduce"] == 0, (
+        f"single-replica step still carries {counts['all-reduce']} "
+        "all-reduce op(s)")
+    assert sum(counts.values()) == 0, (
+        f"single-replica step carries cross-device collectives: {counts}")
+    return {"collectives": counts}
+
+
+def _pipeline_runner(tensor_parallel: int):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=32, hidden_size=16, num_layers=2,
+                            num_heads=2, mlp_dim=32, max_len=8,
+                            dtype=jnp.float32, dropout_rate=0.0,
+                            attention_dropout_rate=0.0)
+    mesh = {"data": 2, "pipe": 2, "model": 2} if tensor_parallel > 1 \
+        else {"data": 4, "pipe": 2}
+    spec = {"topology": {"platform": "cpu", "num_devices": 8},
+            "mesh": mesh}
+    trainable = make_pipeline_lm_trainable(cfg, optax.sgd(0.05),
+                                           jax.random.PRNGKey(0))
+    return AutoDist(spec, "Pipeline", num_microbatches=2,
+                    tensor_parallel=tensor_parallel).build(trainable)
+
+
+def probe_pipeline_tp() -> dict:
+    """tensor_parallel=2 pipeline step: the stage ring's
+    collective-permute is present, and the model-axis activation
+    all-reduces appear on top of the tp=1 program's count — at least 4
+    more (out-proj + wo forward psums, their custom-VJP backward psums),
+    emitted once in the tick-scan body."""
+    import jax
+    import numpy as np
+
+    r = np.random.RandomState(0)
+    batch = {"x": r.randint(0, 32, (8, 8)).astype(np.int32),
+             "y": r.randint(0, 32, (8, 8)).astype(np.int32)}
+    texts = {}
+    for tp in (1, 2):
+        runner = _pipeline_runner(tp)
+        try:
+            texts[tp] = compiled_text(runner.lowered.step_fn, runner.state,
+                                      runner._place_batch(batch),
+                                      jax.random.PRNGKey(0))
+        finally:
+            runner.close()
+    c1, c2 = collective_counts(texts[1]), collective_counts(texts[2])
+    assert c1["collective-permute"] > 0 and c2["collective-permute"] > 0, (
+        f"pipeline ring missing: tp1 {c1} tp2 {c2}")
+    extra = c2["all-reduce"] - c1["all-reduce"]
+    assert extra >= 4, (
+        f"tensor_parallel=2 added only {extra} all-reduce op(s) over "
+        f"tp=1 ({c1['all-reduce']} -> {c2['all-reduce']}); expected the "
+        "per-stage Megatron activation all-reduces (>= 4)")
+    return {"collectives_tp1": c1, "collectives_tp2": c2,
+            "model_axis_all_reduces": extra}
+
+
+PROBES = {
+    "steps_per_loop": probe_steps_per_loop,
+    "single_replica": probe_single_replica,
+    "pipeline_tp": probe_pipeline_tp,
+}
+
+
+def main() -> int:
+    report, failed = {}, []
+    for name, probe in PROBES.items():
+        try:
+            report[name] = {"ok": True, **probe()}
+        except AssertionError as e:
+            report[name] = {"ok": False, "error": str(e)}
+            failed.append(name)
+    print(json.dumps(report, indent=2))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
