@@ -53,6 +53,94 @@ U_REPLICATED = "replicated"   # full copy on every device (pure DP)
 U_FLAT = "flat"               # 1/N flat chunk per device (ZeRO / PS)
 U_AXIS = "axis"               # 1/N chunk along a tensor axis
 
+# XLA's compiler-side half of communication/compute overlap: run
+# collectives asynchronously (-start/-done pairs) and let the
+# latency-hiding scheduler move independent compute between the halves.
+# The collective-matmul decomposition (parallel/tensor.py comm_overlap)
+# restructures the *program* so overlap is possible; these flags let the
+# *compiler* exploit it — and they also overlap collectives this build
+# doesn't decompose (grad allreduces behind backprop).  Gated behind
+# AUTODIST_TPU_ASYNC_COLLECTIVES=1 because they are TPU-backend
+# scheduling flags: harmless but useless on CPU, and on a shared XLA_FLAGS
+# environment silently appending them would surprise whoever set it.
+LATENCY_HIDING_XLA_FLAGS = (
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_enable_async_collective_permute=true",
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+)
+
+
+def _targets_tpu(platform, env) -> bool:
+    """Best-effort 'is this process going to build a TPU backend':
+    explicit spec platform first, then the JAX_PLATFORMS pin, then
+    libtpu presence.  Must not touch jax.devices() — deciding here is
+    only legal because the backend is not up yet."""
+    if platform and platform != "auto":
+        return platform == "tpu"
+    pin = env.get("JAX_PLATFORMS", "")
+    if pin:
+        return "tpu" in pin
+    import importlib.util
+    return importlib.util.find_spec("libtpu") is not None
+
+
+def apply_latency_hiding_flags(env=None, platform=None) -> bool:
+    """Append :data:`LATENCY_HIDING_XLA_FLAGS` to ``XLA_FLAGS`` when the
+    ``AUTODIST_TPU_ASYNC_COLLECTIVES`` knob is set (value ``1``/``True``
+    = the default list; a value starting with ``--`` replaces the list
+    verbatim — flag names drift across jaxlib versions).
+
+    Returns whether the flags are (now) present.  Applied only when the
+    process targets a TPU backend: XLA *aborts* on flags its build
+    doesn't define, so appending TPU scheduling flags under a CPU/GPU
+    client would kill the process at init.  XLA reads the env var once
+    at backend-client init, so this must run before the first device
+    touch — ``ResourceSpec.bootstrap()`` calls it at the right moment
+    for ``AutoDist``-built runners (passing the spec's platform);
+    scripts managing their own backend call it first thing.  If the
+    backend is already up the append still happens (a later subprocess
+    inherits it) but a warning names the miss instead of pretending the
+    running client changed.
+    """
+    import os
+
+    env = os.environ if env is None else env
+    knob = const.ENV.AUTODIST_TPU_ASYNC_COLLECTIVES.val
+    if not knob or knob.lower() in ("0", "false"):
+        return False
+    flags = (tuple(knob.split()) if knob.startswith("--")
+             else LATENCY_HIDING_XLA_FLAGS)
+    if not _targets_tpu(platform, env):
+        logging.warning(
+            "AUTODIST_TPU_ASYNC_COLLECTIVES is set but this process does "
+            "not target a TPU backend; skipping the latency-hiding "
+            "XLA flags (XLA aborts on flags its build doesn't define)")
+        return False
+    current = env.get("XLA_FLAGS", "")
+    missing = [f for f in flags if f not in current]
+    if not missing:
+        return True
+    env["XLA_FLAGS"] = " ".join([current] + missing).strip()
+    already_up = False
+    try:  # backend registry probe; private, so failure = assume not up
+        from jax._src import xla_bridge
+        already_up = bool(getattr(xla_bridge, "_backends", None))
+    except Exception:  # pragma: no cover - jax internals moved
+        pass
+    if already_up:
+        logging.warning(
+            "AUTODIST_TPU_ASYNC_COLLECTIVES set but the XLA backend is "
+            "already initialized; the latency-hiding flags apply only to "
+            "future processes — set the knob before the first device use")
+    else:
+        logging.info("XLA latency-hiding flags enabled: %s",
+                     " ".join(missing))
+    return True
+
 
 @dataclasses.dataclass
 class VarPlan:

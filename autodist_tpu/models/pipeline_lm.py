@@ -37,7 +37,8 @@ def _flax_layer_norm(x, p, dtype, eps=1e-6):
     return (y * p["scale"] + p["bias"]).astype(dtype)
 
 
-def _tp_encoder_layer(cfg: TransformerConfig, chunk, x, mask, model_axis):
+def _tp_encoder_layer(cfg: TransformerConfig, chunk, x, mask, model_axis,
+                      comm_overlap=None):
     """One encoder layer on Megatron-sharded chunk params.
 
     The flax :class:`EncoderLayer` math, open-coded so the two
@@ -49,6 +50,12 @@ def _tp_encoder_layer(cfg: TransformerConfig, chunk, x, mask, model_axis):
     partial output products before the replicated bias/residual/norm.
     With ``model_axis=None`` (the sequential reference, tp=1) the same
     code runs the unsharded math with zero collectives.
+
+    ``comm_overlap`` decomposes those collectives for latency hiding
+    (reduce-scatter/all-gather pairs, or the chunked collective-matmul
+    ring at the row boundaries — see
+    :mod:`autodist_tpu.parallel.tensor`); same math, different
+    summation order.
     """
     from autodist_tpu.parallel.tensor import column_parallel, row_parallel
 
@@ -57,7 +64,7 @@ def _tp_encoder_layer(cfg: TransformerConfig, chunk, x, mask, model_axis):
     x = x.astype(dtype)
     qkv = column_parallel(x, att["qkv"]["kernel"].astype(dtype),
                           att["qkv"]["bias"].astype(dtype),
-                          model_axis=model_axis)
+                          model_axis=model_axis, comm_overlap=comm_overlap)
     q, k, v = jnp.moveaxis(qkv, -3, 0)
     if cfg.attention_fn is not None:
         out = cfg.attention_fn(q, k, v, mask, None)
@@ -66,15 +73,16 @@ def _tp_encoder_layer(cfg: TransformerConfig, chunk, x, mask, model_axis):
                                     dtype=dtype)
     a = row_parallel(out, att["out"]["kernel"].astype(dtype),
                      att["out"]["bias"].astype(dtype),
-                     model_axis=model_axis, axes=2)
+                     model_axis=model_axis, axes=2,
+                     comm_overlap=comm_overlap)
     x = _flax_layer_norm(x + a, chunk["ln_attention"], dtype)
     h = column_parallel(x, chunk["mlp"]["wi"]["kernel"].astype(dtype),
                         chunk["mlp"]["wi"]["bias"].astype(dtype),
-                        model_axis=model_axis)
+                        model_axis=model_axis, comm_overlap=comm_overlap)
     h = jax.nn.gelu(h)
     m = row_parallel(h, chunk["mlp"]["wo"]["kernel"].astype(dtype),
                      chunk["mlp"]["wo"]["bias"].astype(dtype),
-                     model_axis=model_axis)
+                     model_axis=model_axis, comm_overlap=comm_overlap)
     return _flax_layer_norm(x + m, chunk["ln_mlp"], dtype)
 
 
@@ -117,7 +125,8 @@ def make_pipeline_lm_trainable(cfg: TransformerConfig, optimizer, rng, *,
         x = shared["embedding"][tokens].astype(cfg.dtype)
         return x + shared["pos_embed"][None, :L].astype(cfg.dtype)
 
-    def stage_fn(chunk, x, rng_c=None, rows=None, model_axis=None):
+    def stage_fn(chunk, x, rng_c=None, rows=None, model_axis=None,
+                 comm_overlap=None):
         """One encoder layer; with dropout configured, masks key on
         (chunk, global sample index) — drawn per row under vmap — so the
         pipelined schedule and the sequential reference produce
@@ -127,7 +136,8 @@ def make_pipeline_lm_trainable(cfg: TransformerConfig, optimizer, rng, *,
         ``model_axis`` (set by the pipeline lowering under
         ``Pipeline(tensor_parallel>1)``): ``chunk`` holds Megatron
         shards and the layer runs the explicit-collective path of
-        :func:`_tp_encoder_layer`."""
+        :func:`_tp_encoder_layer`; ``comm_overlap`` selects the
+        latency-hiding decomposition of its model-axis collectives."""
         L = x.shape[1]
         mask = jnp.tril(jnp.ones((L, L), bool))[None, None]
         if model_axis is not None:
@@ -139,7 +149,8 @@ def make_pipeline_lm_trainable(cfg: TransformerConfig, optimizer, rng, *,
                 raise NotImplementedError(
                     "tensor_parallel > 1 requires dropout_rate == "
                     "attention_dropout_rate == 0 in the pipelined LM")
-            return _tp_encoder_layer(cfg, chunk, x, mask, model_axis)
+            return _tp_encoder_layer(cfg, chunk, x, mask, model_axis,
+                                     comm_overlap)
         if not needs_rng or rng_c is None:
             return layer.apply({"params": chunk}, x, mask, True)
         keys = jax.vmap(lambda r: jax.random.fold_in(rng_c, r))(rows)

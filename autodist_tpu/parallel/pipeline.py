@@ -292,7 +292,8 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                     shared_params=None, prologue: Callable = None,
                     policies=None, stage_rng: bool = False,
                     remat: bool = False, tp_specs=None,
-                    model_axis: str = const.MODEL_AXIS):
+                    model_axis: str = const.MODEL_AXIS,
+                    comm_overlap=None):
     """Shared construction for the direct API and the Strategy-IR entry;
     returns a Lowered-contract container.
 
@@ -350,12 +351,22 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     and cotangent is model-replicated by the psum placement.  ZeRO-1 on
     a tp-sharded variable is rejected here (its optimizer state already
     shards with the parameter; ``lower_pipeline_ir`` degrades such
-    requests with a warning before calling)."""
+    requests with a warning before calling).
+
+    ``comm_overlap`` (with tensor parallelism): how the model-axis
+    activation collectives lower — ``None`` blocking psum, ``"rsag"``
+    reduce-scatter + all-gather, ``"matmul"`` the chunked
+    collective-matmul ring (see :mod:`autodist_tpu.parallel.tensor`).
+    The stage_fn must additionally accept a ``comm_overlap=`` keyword;
+    with ``tp == 1`` the knob is a no-op (no collectives either way)."""
+    from autodist_tpu.parallel.tensor import normalize_comm_overlap
+
     n = mesh.shape[pipe_axis]
     V = virtual_stages
     C = n * V
     policies = policies or {}
     tp_specs = dict(tp_specs or {})
+    comm_overlap = normalize_comm_overlap(comm_overlap)
     tp = mesh.shape.get(model_axis, 1) if tp_specs else 1
     if tp_specs and model_axis not in mesh.shape:
         raise ValueError(
@@ -366,14 +377,23 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         try:
             params_sig = inspect.signature(stage_fn).parameters
         except (TypeError, ValueError):  # builtins/partials: trust the caller
-            params_sig = {"model_axis": None}
+            params_sig = {"model_axis": None, "comm_overlap": None}
         if "model_axis" not in params_sig:
             raise ValueError(
                 "tensor_parallel > 1 needs a TP-aware stage_fn: it must "
                 "accept model_axis= and psum its row-parallel outputs "
                 "(see autodist_tpu.parallel.tensor)")
         import functools
-        stage_fn = functools.partial(stage_fn, model_axis=model_axis)
+        tp_kwargs = {"model_axis": model_axis}
+        if comm_overlap is not None:
+            if "comm_overlap" not in params_sig:
+                raise ValueError(
+                    f"comm_overlap={comm_overlap!r} needs an overlap-aware "
+                    "stage_fn: it must accept comm_overlap= and route it to "
+                    "its row/column-parallel boundaries "
+                    "(autodist_tpu.parallel.tensor primitives)")
+            tp_kwargs["comm_overlap"] = comm_overlap
+        stage_fn = functools.partial(stage_fn, **tp_kwargs)
     if remat:
         # Each chunk recomputes its forward in the backward pass: live
         # residuals shrink from every chunk intermediate to the chunk
@@ -828,6 +848,24 @@ def lower_pipeline_ir(trainable, strategy, mesh):
         raise ValueError(
             "strategy shards stage variables over the model axis but the "
             f"mesh has none: {dict(mesh.shape)}")
+    # Latency-hiding collectives: the graph-level knob drives the stage_fn
+    # (one mode for the whole stage body); the per-variable partitioner
+    # field is the IR record the cost model prices from.  A hand-edited
+    # strategy that sets per-variable overlap without the graph knob gets
+    # the mode from the variables (all set modes must agree — the stage
+    # body is one function).
+    overlap = cfg.parallel.get("comm_overlap") or None
+    var_overlaps = {nc.partitioner.comm_overlap
+                    for nc in strategy.node_configs
+                    if nc.partitioner is not None
+                    and getattr(nc.partitioner, "comm_overlap", None)}
+    if overlap is None and var_overlaps:
+        if len(var_overlaps) > 1:
+            raise ValueError(
+                "per-variable comm_overlap modes disagree "
+                f"({sorted(var_overlaps)}); the stage body lowers with one "
+                "mode — set graph_config.parallel['comm_overlap']")
+        overlap = var_overlaps.pop()
 
     # Per-variable synchronizer configs (PS -> ZeRO-1, compressors)
     # compose with the pipeline: stage variables zero/compress over the
@@ -867,4 +905,4 @@ def lower_pipeline_ir(trainable, strategy, mesh):
         virtual_stages=V, stage_aux=trainable.stage_aux,
         policies=policies, stage_rng=trainable.stage_rng,
         remat=bool(cfg.parallel.get("remat", False)),
-        tp_specs=tp_specs)
+        tp_specs=tp_specs, comm_overlap=overlap)

@@ -24,12 +24,41 @@ identities (Megatron's ``f``/``g`` operators):
 serves the sequential single-device reference (full parameters, no
 collectives) and the tp>1 lowering (local shards) — the property the
 bit-parity goldens rely on.
+
+Latency-hiding variants (``comm_overlap``)
+------------------------------------------
+
+A monolithic ``psum`` serializes the model-axis transfer behind the
+matmul that feeds it.  Both classic decompositions (GSPMD, arxiv
+2105.04663; portable redistribution, arxiv 2112.01075) are available
+per boundary via ``comm_overlap``:
+
+* ``"rsag"`` — the all-reduce splits into a ``psum_scatter`` +
+  ``all_gather`` pair (ring-equivalent volume, two launches).  XLA's
+  async-collective passes can then start the gather while unrelated
+  compute proceeds (enable them with the runner knob
+  ``AUTODIST_TPU_ASYNC_COLLECTIVES=1``); an ``optimization_barrier``
+  between the halves keeps the combiner pass from re-fusing them back
+  into the monolithic all-reduce.
+* ``"matmul"`` (alias ``True``) — the chunked *collective matmul*: the
+  row-parallel matmul splits into ``tp`` output chunks driven around a
+  ``lax.ppermute`` ring, so hop *k*'s transfer overlaps chunk *k+1*'s
+  matmul (:func:`collective_matmul_row`).  The column-parallel
+  *backward* cotangent reduction has no matmul of its own to hide
+  behind and takes the ``"rsag"`` form.
+
+Every variant carries the same custom-VJP contract as the blocking
+pair, so cotangents stay exact under ``check_vma=False``; numerics
+differ from the ``psum`` path only by float summation order
+(``tools/hlo_probe.py`` pins the structure, the pipeline-TP goldens pin
+parity within tolerance).
 """
 from __future__ import annotations
 
 import functools
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 
@@ -67,37 +96,205 @@ def _sum_partials_bwd(model_axis, _, ct):
 sum_partials.defvjp(_sum_partials_fwd, _sum_partials_bwd)
 
 
-def column_parallel(x, kernel, bias=None, *, model_axis=None, axes: int = 1):
+# --------------------------------------------------------------------------- #
+# Latency-hiding decompositions
+# --------------------------------------------------------------------------- #
+def normalize_comm_overlap(mode):
+    """Canonicalize a ``comm_overlap`` request: ``None``/``False``/"" →
+    ``None`` (blocking psum), ``True`` → ``"matmul"``; otherwise one of
+    ``"rsag"`` / ``"matmul"``."""
+    if mode in (None, False, ""):
+        return None
+    if mode is True:
+        return "matmul"
+    if mode in ("rsag", "matmul"):
+        return mode
+    raise ValueError(
+        f"comm_overlap must be one of None/False, True, 'rsag', 'matmul'; "
+        f"got {mode!r}")
+
+
+def psum_decomposed(x, axis_name):
+    """All-reduce as an explicit reduce-scatter + all-gather pair.
+
+    Mathematically ``lax.psum(x, axis_name)`` at ring-equivalent wire
+    volume, but emitted as two ops so XLA's latency-hiding scheduler can
+    start each half asynchronously.  The ``optimization_barrier``
+    between the halves pins the decomposition: without it the
+    all-reduce-reassociation pass is free to fuse the pair back into
+    the monolithic collective this exists to avoid (the HLO probe
+    asserts it stays split).  Shapes need not divide the axis size —
+    the flattened payload is zero-padded to divisibility.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    flat = x.reshape(-1)
+    size = flat.shape[0]
+    pad = (-size) % n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard = lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                             tiled=True)
+    shard = lax.optimization_barrier(shard)
+    full = lax.all_gather(shard, axis_name, axis=0, tiled=True)
+    if pad:
+        full = lax.slice_in_dim(full, 0, size)
+    return full.reshape(x.shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_grads_decomposed(x, model_axis):
+    """Identity forward / decomposed (rs+ag) psum backward — the
+    ``comm_overlap`` form of :func:`gather_grads` for column-parallel
+    inputs: the backward cotangent reduction stops being a monolithic
+    all-reduce."""
+    return x
+
+
+def _gather_grads_dec_fwd(x, model_axis):
+    return x, None
+
+
+def _gather_grads_dec_bwd(model_axis, _, ct):
+    return (psum_decomposed(ct, model_axis),)
+
+
+gather_grads_decomposed.defvjp(_gather_grads_dec_fwd, _gather_grads_dec_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def sum_partials_decomposed(x, model_axis):
+    """Decomposed (rs+ag) psum forward / identity backward — the
+    ``comm_overlap="rsag"`` form of :func:`sum_partials` for
+    row-parallel outputs."""
+    return psum_decomposed(x, model_axis)
+
+
+def _sum_partials_dec_fwd(x, model_axis):
+    return psum_decomposed(x, model_axis), None
+
+
+def _sum_partials_dec_bwd(model_axis, _, ct):
+    return (ct,)
+
+
+sum_partials_decomposed.defvjp(_sum_partials_dec_fwd,
+                               _sum_partials_dec_bwd)
+
+
+def _ring_matmul_fwd_impl(x, kernel, model_axis, axes):
+    """``psum(tensordot(x, kernel, axes))`` as a chunked ppermute ring.
+
+    The kernel's last (output) dim splits into ``tp`` chunks; a partial
+    chunk sum travels the ring for ``tp - 1`` hops, and each device adds
+    its local contribution to whatever chunk just arrived — so hop *k*'s
+    transfer overlaps chunk *k+1*'s matmul (the "collective matmul" of
+    GSPMD/Wang et al.).  Chunk assignment: the carry a device starts
+    with is chunk ``me - 1``; after ``tp - 1`` hops it owns the full sum
+    of chunk ``me``, so the closing tiled ``all_gather`` concatenates
+    chunks already in position order.  Output widths that don't divide
+    ``tp`` are zero-padded (zero columns compute nothing real and are
+    sliced off).
+    """
+    tp = lax.axis_size(model_axis)
+    me = lax.axis_index(model_axis)
+    width = kernel.shape[-1]
+    pad = (-width) % tp
+    if pad:
+        kernel = jnp.pad(
+            kernel, [(0, 0)] * (kernel.ndim - 1) + [(0, pad)])
+    chunk_w = (width + pad) // tp
+    perm = [(i, (i + 1) % tp) for i in range(tp)]
+
+    def part(c):
+        kc = lax.dynamic_slice_in_dim(kernel, c * chunk_w, chunk_w,
+                                      axis=kernel.ndim - 1)
+        return jnp.tensordot(x, kc, axes=axes)
+
+    def hop(carry, h):
+        carry = lax.ppermute(carry, model_axis, perm)
+        return carry + part((me - h - 1) % tp), None
+
+    owned, _ = lax.scan(hop, part((me - 1) % tp), jnp.arange(1, tp))
+    y = lax.all_gather(owned, model_axis, axis=owned.ndim - 1, tiled=True)
+    if pad:
+        y = lax.slice_in_dim(y, 0, width, axis=y.ndim - 1)
+    return y
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def collective_matmul_row(x, kernel, model_axis, axes: int = 1):
+    """Row-parallel matmul with the output all-reduce decomposed into a
+    chunked ``ppermute`` ring (``comm_overlap="matmul"``).
+
+    Equals ``sum_partials(tensordot(x, kernel, axes), model_axis)`` up
+    to float summation order.  The backward is the *local* tensordot
+    transpose — identical math to the blocking pair (``sum_partials``'s
+    backward is the identity), with zero model-axis collectives in the
+    row layer's own backward.
+    """
+    return _ring_matmul_fwd_impl(x, kernel, model_axis, axes)
+
+
+def _collective_matmul_fwd(x, kernel, model_axis, axes):
+    return _ring_matmul_fwd_impl(x, kernel, model_axis, axes), (x, kernel)
+
+
+def _collective_matmul_bwd(model_axis, axes, res, ct):
+    x, kernel = res
+    _, pullback = jax.vjp(
+        lambda a, b: jnp.tensordot(a, b, axes=axes), x, kernel)
+    return pullback(ct)
+
+
+collective_matmul_row.defvjp(_collective_matmul_fwd, _collective_matmul_bwd)
+
+
+def column_parallel(x, kernel, bias=None, *, model_axis=None, axes: int = 1,
+                    comm_overlap=None):
     """``x @ kernel (+ bias)`` with the kernel's *output* dims sharded.
 
     ``axes`` contraction dims are taken from the end of ``x`` and the
     front of ``kernel`` (``jax.lax.dot_general`` semantics via
     tensordot).  With ``model_axis`` set, ``kernel``/``bias`` are the
     local output-shard; the result is the sharded activation slice.
+    ``comm_overlap`` (any non-None mode) decomposes the *backward*
+    cotangent all-reduce into the rs+ag pair.
     """
-    import jax.numpy as jnp
-
+    overlap = normalize_comm_overlap(comm_overlap)
     if model_axis is not None:
-        x = gather_grads(x, model_axis)
+        x = (gather_grads_decomposed(x, model_axis) if overlap
+             else gather_grads(x, model_axis))
     y = jnp.tensordot(x, kernel, axes=axes)
     if bias is not None:
         y = y + bias
     return y
 
 
-def row_parallel(x, kernel, bias=None, *, model_axis=None, axes: int = 1):
+def row_parallel(x, kernel, bias=None, *, model_axis=None, axes: int = 1,
+                 comm_overlap=None):
     """``x @ kernel (+ bias)`` with the kernel's *input* dims sharded.
 
     With ``model_axis`` set, ``x``/``kernel`` are local input-shards; the
-    partial products are psummed over the model group (one activation
+    partial products are summed over the model group (one activation
     all-reduce — THE Megatron block boundary) and the replicated ``bias``
     is added after the sum, matching the unsharded math exactly.
-    """
-    import jax.numpy as jnp
 
-    y = jnp.tensordot(x, kernel, axes=axes)
-    if model_axis is not None:
-        y = sum_partials(y, model_axis)
+    ``comm_overlap`` selects how that sum lowers: ``None`` — the
+    blocking monolithic ``psum``; ``"rsag"`` — reduce-scatter +
+    all-gather; ``"matmul"``/``True`` — the chunked collective-matmul
+    ring (:func:`collective_matmul_row`), whose per-hop transfers hide
+    behind per-chunk compute.
+    """
+    overlap = normalize_comm_overlap(comm_overlap)
+    if model_axis is not None and overlap == "matmul":
+        y = collective_matmul_row(x, kernel, model_axis, axes)
+    else:
+        y = jnp.tensordot(x, kernel, axes=axes)
+        if model_axis is not None:
+            y = (sum_partials_decomposed(y, model_axis) if overlap
+                 else sum_partials(y, model_axis))
     if bias is not None:
         y = y + bias
     return y

@@ -291,6 +291,11 @@ class ResourceSpec:
         ``jax.distributed`` job."""
         if getattr(self, "_bootstrapped", False):
             return
+        # Opt-in XLA async-collective/latency-hiding flags must land in
+        # XLA_FLAGS before the first backend touch (the client reads them
+        # once); bootstrap is the last frame that runs before it.
+        from autodist_tpu.kernel.lowering import apply_latency_hiding_flags
+        apply_latency_hiding_flags(platform=self.platform)
         if self.is_multihost:
             import jax
             logging.info(

@@ -55,6 +55,14 @@ def default_candidates() -> list[StrategyBuilder]:
         # skipped — the cost model then arbitrates tp=1 vs tp=2 on the
         # per-stage activation all-reduces it prices.
         parallel_builders.Pipeline(num_microbatches=4, tensor_parallel=2),
+        # Latency-hiding variant: the same dp×pp×tp composition with the
+        # model-axis activation collectives decomposed into the chunked
+        # collective-matmul ring; the cost model prices its Megatron
+        # boundaries as max(comm, compute) instead of comm + compute,
+        # so it ranks at or above the blocking variant on every link
+        # profile and wins whenever chunk compute can hide hop latency.
+        parallel_builders.Pipeline(num_microbatches=4, tensor_parallel=2,
+                                   comm_overlap=True),
         parallel_builders.ExpertParallel(),
     ]
 

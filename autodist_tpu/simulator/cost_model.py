@@ -50,6 +50,21 @@ COMPRESSOR_FACTOR = {
 # Activation bytes per element on the wire/in HBM (bf16 activations).
 _ACT_BYTES = 2.0
 
+# Link-pricing constants for the overlap-aware model (the pipeline/TP
+# path): effective per-link bandwidth, per-hop launch latency, and the
+# matmul efficiency that converts chunk FLOPs into the compute time a
+# hop can hide behind.  Analytic defaults come from the chip table
+# (resource.ChipSpec) / COLLECTIVE_ALPHA; a ``"link"`` section in
+# calibration.json (or an explicit ``CostModel(link_profile=...)``)
+# replaces them with measured values.  Keys: ``ici_gbps``,
+# ``hop_alpha_s``, ``mxu_efficiency``.
+LINK_PROFILE: dict = {}
+
+# Fraction of peak matmul throughput a pipeline-stage chunk sustains —
+# only the *ratio* of chunk-compute to hop-transfer time matters for
+# ranking overlapped vs blocking plans.
+_DEFAULT_MXU_EFFICIENCY = 0.4
+
 
 def load_calibration(path: Optional[str] = None) -> dict:
     """Merge measured compressor factors into :data:`COMPRESSOR_FACTOR`.
@@ -58,9 +73,12 @@ def load_calibration(path: Optional[str] = None) -> dict:
     against the uncompressed one on the real chip and writes
     ``{"compressor_factor": {name: measured_ratio}, ...}``; loading it
     turns the cost model's byte-count guesses into wall-clock ratios.
-    Default path: ``calibration.json`` at the repo root, then the
-    ``AUTODIST_TPU_CALIBRATION`` env var.  Returns the factors applied
-    (empty when no file exists).
+    An optional ``"link"`` section (``ici_gbps`` / ``hop_alpha_s`` /
+    ``mxu_efficiency``) merges into :data:`LINK_PROFILE` the same way —
+    the constants the overlap-aware pipeline pricing uses in place of
+    the chip-table defaults.  Default path: ``calibration.json`` at the
+    repo root, then the ``AUTODIST_TPU_CALIBRATION`` env var.  Returns
+    the compressor factors applied (empty when no file exists).
     """
     import json
     import os
@@ -88,6 +106,7 @@ def load_calibration(path: Optional[str] = None) -> dict:
                 continue
             factors = dict(data.get("compressor_factor", {}))
             COMPRESSOR_FACTOR.update(factors)
+            LINK_PROFILE.update(dict(data.get("link", {})))
             return factors
     return {}
 
@@ -136,7 +155,8 @@ class CostModel:
                  opt_state_multiplier: float = 2.0,
                  hbm_headroom: float = 0.6,
                  tokens_per_step: Optional[int] = None,
-                 act_bytes_per_token: Optional[float] = None):
+                 act_bytes_per_token: Optional[float] = None,
+                 link_profile: Optional[dict] = None):
         """``sparsity_fraction``: expected fraction of embedding rows
         touched per step (drives the sparse gather/scatter volume).
         ``opt_state_multiplier``: optimizer slots per parameter byte
@@ -145,7 +165,11 @@ class CostModel:
         ``tokens_per_step`` / ``act_bytes_per_token``: activation-shape
         hints (override the trainable's own) enabling activation-
         collective and activation-memory pricing — see
-        :class:`~autodist_tpu.capture.Trainable`."""
+        :class:`~autodist_tpu.capture.Trainable`.
+        ``link_profile``: per-link constants for the overlap-aware
+        pricing (keys ``ici_gbps``/``hop_alpha_s``/``mxu_efficiency``);
+        overrides the calibration-file :data:`LINK_PROFILE`, which
+        overrides the chip-table defaults."""
         _ensure_calibration()
         self.spec = resource_spec
         self.chip = resource_spec.chip
@@ -154,6 +178,9 @@ class CostModel:
         self.hbm_headroom = hbm_headroom
         self.tokens_per_step = tokens_per_step
         self.act_bytes_per_token = act_bytes_per_token
+        self.link_profile = dict(LINK_PROFILE)
+        if link_profile:
+            self.link_profile.update(link_profile)
 
     # ------------------------------------------------------------------ #
     def _hints(self, trainable) -> tuple[Optional[int], Optional[float]]:
@@ -324,6 +351,24 @@ class CostModel:
         colls = 0
         mem = 0.0
         tokens_per_dev = (tokens / total_devices) if tokens else 0.0
+        # Link constants for the overlap-aware pricing (and this branch's
+        # final bytes→time conversion, so overlapped and blocking
+        # variants are ranked against ONE set of constants): calibrated
+        # values beat the chip table.
+        bw_link = float(self.link_profile.get(
+            "ici_gbps", self.chip.ici_gbps)) * 1e9
+        hop_alpha = float(self.link_profile.get(
+            "hop_alpha_s", COLLECTIVE_ALPHA))
+        mxu_eff = float(self.link_profile.get(
+            "mxu_efficiency", _DEFAULT_MXU_EFFICIENCY))
+        flops_rate = self.chip.peak_bf16_tflops * 1e12 * mxu_eff
+        # Overlapped collectives are priced in *seconds* directly (their
+        # per-hop alphas included), with their wire bytes and launch
+        # counts reported but not re-charged through the bytes/bw + alpha
+        # terms below.
+        overlap_s = 0.0
+        hidden_bytes = 0.0
+        extra_colls = 0
 
         def ring(k: int) -> float:
             return 2.0 * (k - 1) / k if k > 1 else 0.0
@@ -377,6 +422,13 @@ class CostModel:
                 "num_microbatches", 1)), 1)
             V = max(int(strategy.graph_config.parallel.get(
                 "virtual_stages", 1)), 1)
+            # Mode resolution mirrors lower_pipeline_ir exactly (graph
+            # knob wins, per-variable fields fill in when it's unset,
+            # aliases canonicalized) — the price must describe the
+            # program that would actually be built.
+            from autodist_tpu.parallel.tensor import normalize_comm_overlap
+            overlap_cfg = normalize_comm_overlap(
+                strategy.graph_config.parallel.get("comm_overlap"))
             tokens_local = tokens / max(n_data, 1) if tokens else 0.0
             # V chunks of C = S*V total live per device -> stage
             # params/opt at 1/S, grads sync over the data axis; shared
@@ -417,9 +469,62 @@ class CostModel:
                                     and spec_tail[0] == const.MODEL_AXIS)
                     if row_parallel and tp > 1 and tokens:
                         width = info.shape[-1]
-                        comm += 2.0 * ring(tp) * V * tokens_local \
+                        act_bytes = 2.0 * ring(tp) * V * tokens_local \
                             * width * _ACT_BYTES
-                        colls += 2 * M * V
+                        mode = overlap_cfg or normalize_comm_overlap(
+                            getattr(part, "comm_overlap", None))
+                        if mode is None:
+                            comm += act_bytes
+                            colls += 2 * M * V
+                        else:
+                            # Latency-hiding decomposition: price the
+                            # Megatron boundary as max(comm, compute)
+                            # instead of comm + compute.  Per chunk
+                            # execution and direction, the blocking
+                            # envelope is the ring all-reduce
+                            #   t_blk = 2(tp-1)·t_wire + α
+                            # (t_wire = one chunk's hop transfer).  The
+                            # collective matmul exposes only what chunk
+                            # compute cannot hide:
+                            #   t_mm = (tp-1)·(max(0, t_hop − t_chunk)
+                            #           + t_hop)
+                            # (rs-phase hops hidden behind per-chunk
+                            # matmuls; the closing ag-phase is bare),
+                            # and the rs+ag pair exposes
+                            #   t_rsag = max(α, 2(tp-1)·t_hop
+                            #            − tp·t_chunk)
+                            # (whole-layer overlap via XLA's async
+                            # scheduler).  Each is capped at t_blk —
+                            # the lowering can always fall back to the
+                            # fused all-reduce, so a decomposed plan
+                            # never prices above the blocking one.
+                            execs = M * V
+                            tok_e = tokens_local / max(M, 1)
+                            contract = float(math.prod(
+                                info.shape[1:-1])) or 1.0
+                            t_chunk = 2.0 * tok_e * (contract / tp) \
+                                * (width / tp) / flops_rate
+                            t_wire = tok_e * (width / tp) * _ACT_BYTES \
+                                / bw_link
+                            t_hop = t_wire + hop_alpha
+                            t_blk = 2.0 * (tp - 1) * t_wire + hop_alpha
+                            t_rsag = max(hop_alpha,
+                                         2.0 * (tp - 1) * t_hop
+                                         - tp * t_chunk)
+                            t_mm = (tp - 1) * (max(0.0, t_hop - t_chunk)
+                                               + t_hop)
+                            fwd_t = min(t_mm if mode == "matmul"
+                                        else t_rsag, t_blk)
+                            # The column partner's backward cotangent
+                            # reduction decomposes as rs+ag in either
+                            # mode (no matmul of its own to hide
+                            # behind); charged here like the blocking
+                            # model charges its 2x on the row var.
+                            bwd_t = min(t_rsag, t_blk)
+                            overlap_s += execs * (fwd_t + bwd_t)
+                            hidden_bytes += act_bytes
+                            extra_colls += execs * (
+                                (tp + 1 if mode == "matmul" else 2) + 2)
                 else:
                     n_pd = S * n_data
                     opt_div = n_pd if node_is_ps(node) else 1
@@ -486,12 +591,12 @@ class CostModel:
                 colls += 4
             if tokens and act_hint:
                 mem += act_hint * tokens_per_dev
-        bw = self.chip.ici_gbps * 1e9
-        comm_time = (comm / bw if total_devices > 1 else 0.0) \
-            + COLLECTIVE_ALPHA * colls * (1 if total_devices > 1 else 0)
+        comm_time = ((comm / bw_link + hop_alpha * colls + overlap_s)
+                     if total_devices > 1 else 0.0)
         hbm = self.chip.hbm_gb * 1e9 * self.hbm_headroom
-        return StrategyCost(comm_bytes=comm, comm_time_s=comm_time,
-                            num_collectives=colls,
+        return StrategyCost(comm_bytes=comm + hidden_bytes,
+                            comm_time_s=comm_time,
+                            num_collectives=colls + extra_colls,
                             mem_bytes_per_device=mem,
                             feasible=mem <= hbm)
 

@@ -117,6 +117,15 @@ class PartitionerConfig:
     # dimensions — the strategy.proto:40-42 extensibility the reference
     # anticipated.
     spec: Optional[list] = None
+    # Latency-hiding lowering of this variable's model-axis activation
+    # collective (tensor-parallel layers only): None — blocking psum;
+    # "rsag" — reduce-scatter + all-gather pair; "matmul" — chunked
+    # collective-matmul ppermute ring (per-hop transfer hides behind
+    # per-chunk compute).  Recorded per variable so the cost model can
+    # price overlapped layers as max(comm, compute) instead of
+    # comm + compute, and so a hand-edited strategy can convert layers
+    # selectively.
+    comm_overlap: Optional[str] = None
 
     @property
     def partition_list(self) -> list[int]:
@@ -296,6 +305,8 @@ class Strategy:
             if n.partitioner:
                 part = (str(n.partitioner.spec) if n.partitioner.spec
                         else n.partitioner.partition_str)
+                if n.partitioner.comm_overlap:
+                    part += f" overlap={n.partitioner.comm_overlap}"
             lines.append(
                 f"  {n.var_name}: sync={n.synchronizer.kind}"
                 f"({getattr(n.synchronizer, 'compressor', '')}) part={part}"

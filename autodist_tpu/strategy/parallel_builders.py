@@ -135,13 +135,26 @@ class Pipeline(StrategyBuilder):
     ``model`` mesh axis, recorded per variable in the strategy's
     partitioner specs; the trainable's ``stage_fn`` must be TP-aware
     (accept ``model_axis=`` — see :mod:`autodist_tpu.parallel.tensor`).
+
+    ``comm_overlap`` (with ``tensor_parallel > 1``) decomposes the
+    model-axis activation collectives for latency hiding: ``"rsag"`` —
+    reduce-scatter + all-gather pairs; ``"matmul"``/``True`` — the
+    chunked collective-matmul ``ppermute`` ring at the row-parallel
+    boundaries (hop *k*'s transfer overlaps chunk *k+1*'s matmul).
+    Recorded per tp-sharded variable in the partitioner configs *and*
+    as the graph-level ``parallel.comm_overlap`` knob; the stage_fn
+    must accept a ``comm_overlap=`` keyword (the bundled pipelined LM
+    does).  With ``tensor_parallel == 1`` the knob is recorded but the
+    lowering is collective-free either way (the tp∈{1,2} parity
+    goldens rely on that no-op).
     """
 
     def __init__(self, num_microbatches: int = 1, virtual_stages: int = 1,
                  *, zero1: bool = False, compressor: str = "none",
                  zero_min_bytes=None, remat: bool = False,
                  tensor_parallel: int = 1,
-                 tp_rules: Sequence[tuple[str, list]] = None):
+                 tp_rules: Sequence[tuple[str, list]] = None,
+                 comm_overlap=None):
         if num_microbatches < 1:
             raise ValueError("num_microbatches must be >= 1")
         if virtual_stages < 1:
@@ -163,6 +176,8 @@ class Pipeline(StrategyBuilder):
         self.tp_rules = [(re.compile(pat), list(spec))
                          for pat, spec in (tp_rules if tp_rules is not None
                                            else PIPELINE_TP_RULES)]
+        from autodist_tpu.parallel.tensor import normalize_comm_overlap
+        self.comm_overlap = normalize_comm_overlap(comm_overlap)
         self.make_sync = _default_sync(zero1, compressor, zero_min_bytes)
 
     def _tp_spec_for(self, name: str, stage_shape: tuple, tp: int):
@@ -210,6 +225,23 @@ class Pipeline(StrategyBuilder):
                 f"{const.MODEL_AXIS!r} mesh axis of that size; spec "
                 f"resolves to {shape} — declare e.g. "
                 "mesh: {data: ..., pipe: ..., model: ...}")
+        if tp > 1 and self.comm_overlap:
+            # Validate at build time (not lowering) so AutoStrategy's
+            # candidate loop SKIPS this builder for trainables whose
+            # stage_fn cannot honor the decomposition, instead of
+            # electing it on cost and failing the job at compile.
+            import inspect
+            try:
+                sig = inspect.signature(
+                    getattr(trainable, "stage_fn", None)).parameters
+            except (TypeError, ValueError):  # partials/builtins: trust it
+                sig = {"comm_overlap": None}
+            if "comm_overlap" not in sig:
+                raise ValueError(
+                    f"comm_overlap={self.comm_overlap!r} needs an "
+                    "overlap-aware stage_fn: it must accept comm_overlap= "
+                    "and route it to its row/column-parallel boundaries "
+                    "(autodist_tpu.parallel.tensor primitives)")
         has_shared = getattr(trainable, "has_shared", False)
         nodes = []
         tp_matched = []
@@ -223,15 +255,22 @@ class Pipeline(StrategyBuilder):
             # the model axis on the dims the tp rules name.
             if not has_shared or i.name.startswith("stages/"):
                 tail = [None] * (max(len(i.shape), 1) - 1)
+                overlap = None
                 if tp > 1:
                     tp_tail = self._tp_spec_for(i.name, tuple(i.shape[1:]),
                                                 tp)
                     if tp_tail is not None:
                         tail = tp_tail
                         tp_matched.append(i.name)
+                        # The overlap choice rides every tp-sharded
+                        # variable: row-parallel ones decompose their
+                        # forward output reduction, column-parallel ones
+                        # their backward cotangent reduction.
+                        overlap = self.comm_overlap
                 node.partitioner = PartitionerConfig(
                     mesh_axis=const.PIPE_AXIS,
-                    spec=[const.PIPE_AXIS] + tail)
+                    spec=[const.PIPE_AXIS] + tail,
+                    comm_overlap=overlap)
             nodes.append(node)
         if tp > 1 and not tp_matched:
             # ValueError (not a warning): AutoStrategy's candidate loop
@@ -247,7 +286,8 @@ class Pipeline(StrategyBuilder):
         cfg.parallel = {"num_microbatches": self.num_microbatches,
                         "virtual_stages": self.virtual_stages,
                         "remat": self.remat,
-                        "tensor_parallel": tp}
+                        "tensor_parallel": tp,
+                        "comm_overlap": self.comm_overlap}
         return Strategy(node_configs=nodes, graph_config=cfg)
 
 

@@ -125,12 +125,85 @@ class _Watchdog:
                 pass
 
 
+def _provenance() -> dict:
+    """Identity stamp for every emitted record: the exact code (git SHA)
+    and jax/jaxlib versions the number was measured with — a hardware
+    window's results must stay interpretable months later, and a
+    regression hunt needs to know which commit produced which MFU."""
+    rec = {}
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10).stdout.strip()
+        rec["git_sha"] = sha or None
+    except (OSError, subprocess.SubprocessError):
+        rec["git_sha"] = None
+    rec["jax"] = getattr(jax, "__version__", None)
+    try:
+        import jaxlib
+        rec["jaxlib"] = getattr(jaxlib, "__version__", None)
+    except ImportError:  # pragma: no cover
+        rec["jaxlib"] = None
+    return rec
+
+
+def _probe_summary(timeout_s: float) -> dict:
+    """Structural provenance: per-probe pass/fail of ``tools/hlo_probe.py``
+    (collective counts proven on a simulated CPU mesh), run in a fresh
+    CPU-pinned subprocess — the bench process owns the accelerator
+    backend and cannot host the probe's 8-device CPU mesh.  Skips (with
+    the reason recorded) rather than risking the measurement budget."""
+    if os.environ.get("AUTODIST_TPU_BENCH_PROBE", "1") in ("0", "false"):
+        return {"skipped": "AUTODIST_TPU_BENCH_PROBE=0"}
+    if timeout_s < 120:
+        return {"skipped": f"no budget ({int(timeout_s)}s left)"}
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "hlo_probe.py")
+    fd, out = tempfile.mkstemp(prefix="bench_probe_", suffix=".json")
+    os.close(fd)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # A TPU bench environment may carry TPU-only XLA flags (the
+    # AUTODIST_TPU_ASYNC_COLLECTIVES knob appends some): XLA *aborts* on
+    # flags a CPU build doesn't define, so the probe subprocess gets
+    # them stripped.
+    env.pop("AUTODIST_TPU_ASYNC_COLLECTIVES", None)
+    from autodist_tpu.kernel.lowering import LATENCY_HIDING_XLA_FLAGS
+    if env.get("XLA_FLAGS"):
+        env["XLA_FLAGS"] = " ".join(
+            f for f in env["XLA_FLAGS"].split()
+            if not f.startswith("--xla_tpu")
+            and f not in LATENCY_HIDING_XLA_FLAGS)
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, "--json", out],
+            env=env, capture_output=True, text=True, timeout=timeout_s)
+        with open(out) as f:
+            report = json.load(f)
+        summary = {"ok": proc.returncode == 0,
+                   "probes": {name: bool(r.get("ok"))
+                              for name, r in report.items()}}
+        failed = [n for n, r in report.items() if not r.get("ok")]
+        if failed:
+            summary["failed"] = failed
+        return summary
+    except subprocess.TimeoutExpired:
+        return {"skipped": f"probe subprocess exceeded {int(timeout_s)}s"}
+    except (OSError, ValueError) as e:
+        return {"skipped": f"probe run failed: {e}"}
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+
+
 def _fail_record(msg: str, skipped: bool = False) -> str:
     """The one failure-record shape: hw_session.sh greps these exact keys
     (``"error"``/``"value"``) to gate the measurement queue, so every
     in-process failure path must emit the same dict."""
     rec = {"metric": "bert_base_mlm_mfu", "value": 0.0, "unit": "mfu",
-           "vs_baseline": 0.0, "error": msg}
+           "vs_baseline": 0.0, "error": msg, "provenance": _provenance()}
     if skipped:
         rec["skipped"] = True
     return json.dumps(rec)
@@ -307,13 +380,15 @@ def _bench(dog):
     flops_per_example = mlm_model_flops_per_example(cfg, seq_len, num_masked)
     peak = rs.chip.peak_bf16_tflops * 1e12 * n
 
+    provenance = _provenance()
+
     def make_record(name, b, rate, dt_step=None):
         m = profiling.mfu(rate, flops_per_example, peak)
         rec = {"metric": "bert_base_mlm_mfu", "value": round(m, 4),
                "unit": "mfu", "vs_baseline": round(m / 0.45, 4),
                "examples_per_sec": round(rate, 2), "devices": n,
                "chip": rs.chip.name, "attention": name,
-               "batch_per_chip": b}
+               "batch_per_chip": b, "provenance": provenance}
         if dt_step is not None:
             rec["step_ms"] = round(dt_step * 1e3, 2)
             rec["scored"] = True    # a completed scored window, not a probe
@@ -420,6 +495,14 @@ def _bench(dog):
                     break
                 retried = True
                 print(f"# retrying attempt {name}/b{b} once", flush=True)
+
+    # HLO-probe provenance AFTER the scored runs (it must never eat the
+    # measurement budget) but BEFORE the record prints (it must be IN
+    # the record): the structural claims the number rests on, verified
+    # in the same session the number was measured.
+    dog.stage = "hlo probe provenance (cpu subprocess)"
+    best["hlo_probe"] = _probe_summary(min(480.0, time_left() - 120.0))
+    save_snapshot(best)
 
     dog.stage = "memory stats + report"
     mfu = best["value"]

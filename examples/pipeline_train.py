@@ -12,6 +12,8 @@ all-reduce per stage.
     python examples/pipeline_train.py --steps 20
     python examples/pipeline_train.py --virtual-stages 2 --microbatches 4
     python examples/pipeline_train.py --tensor-parallel 2 --stages 2
+    python examples/pipeline_train.py --tensor-parallel 2 --stages 2 \
+        --comm-overlap matmul --profile-dir /tmp/pp_trace
 """
 import argparse
 import os
@@ -31,6 +33,12 @@ def main():
     ap.add_argument("--tensor-parallel", type=int, default=1,
                     help="model-axis devices per stage (Megatron TP "
                          "inside the pipeline: dp x pp x tp)")
+    ap.add_argument("--comm-overlap", choices=["off", "rsag", "matmul"],
+                    default="off",
+                    help="latency-hiding decomposition of the model-axis "
+                         "activation collectives (with --tensor-parallel "
+                         "> 1): rsag = reduce-scatter + all-gather pairs, "
+                         "matmul = chunked collective-matmul ppermute ring")
     ap.add_argument("--zero1", action="store_true",
                     help="ZeRO-1: shard optimizer state over the data "
                          "axes (stage vars) / pipe x data (shared vars)")
@@ -38,6 +46,11 @@ def main():
                     help="jax.checkpoint each chunk (memory for compute)")
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--profile-dir", default=None,
+                    help="capture an xplane trace of the step loop here "
+                         "plus step_times.json (StepTimer percentiles) — "
+                         "a hardware window yields both with zero extra "
+                         "typing")
     args = ap.parse_args()
 
     import jax
@@ -79,12 +92,14 @@ def main():
                "bias": jnp.zeros((C, HID), jnp.float32)},
     }
 
-    def stage(p, x, model_axis=None):
+    def stage(p, x, model_axis=None, comm_overlap=None):
         h = jax.nn.relu(column_parallel(x, p["wi"]["kernel"],
                                         p["wi"]["bias"],
-                                        model_axis=model_axis))
+                                        model_axis=model_axis,
+                                        comm_overlap=comm_overlap))
         return row_parallel(h, p["wo"]["kernel"], p["wo"]["bias"],
-                            model_axis=model_axis)
+                            model_axis=model_axis,
+                            comm_overlap=comm_overlap)
 
     def head(outputs, batch):
         loss = jnp.mean((outputs - batch["y"]) ** 2)
@@ -92,9 +107,10 @@ def main():
 
     trainable = PipelineTrainable(stage, stacked, head, optax.adam(1e-3),
                                   num_stages=C)
+    overlap = None if args.comm_overlap == "off" else args.comm_overlap
     builder = Pipeline(num_microbatches=args.microbatches,
                        virtual_stages=args.virtual_stages,
-                       tensor_parallel=tp,
+                       tensor_parallel=tp, comm_overlap=overlap,
                        zero1=args.zero1, remat=args.remat)
     if args.accum_steps > 1:
         builder = GradAccumulation(builder, steps=args.accum_steps)
@@ -102,16 +118,47 @@ def main():
                        "mesh": mesh}, builder).build(trainable)
 
     print(f"pipe={pp} x virtual={args.virtual_stages} "
-          f"(C={C} chunks), dp={dp}, tp={tp}, M={args.microbatches}; "
-          f"schedule bubble = "
+          f"(C={C} chunks), dp={dp}, tp={tp}, M={args.microbatches}, "
+          f"comm_overlap={overlap}; schedule bubble = "
           f"{bubble_fraction(args.microbatches, pp, args.virtual_stages):.3f}")
     target = r.randn(HID, HID).astype(np.float32) * 0.1
-    for step in range(args.steps):
-        x = r.randn(args.batch, HID).astype(np.float32)
-        batch = {"x": x, "y": x @ target}
-        metrics = runner.step(batch)
-        if step % 5 == 0 or step == args.steps - 1:
-            print(f"step {step}: loss={float(np.asarray(metrics['loss'])):.5f}")
+
+    from contextlib import nullcontext
+
+    from autodist_tpu.utils import profiling
+
+    # warmup must leave at least one recorded step or the summary is all
+    # None (short smoke runs with --profile-dir).
+    timer = profiling.StepTimer(args.batch,
+                                warmup=min(2, max(args.steps - 1, 0)))
+    trace_cm = (profiling.trace(args.profile_dir) if args.profile_dir
+                else nullcontext())
+    with trace_cm:
+        for step in range(args.steps):
+            x = r.randn(args.batch, HID).astype(np.float32)
+            batch = {"x": x, "y": x @ target}
+            with timer:
+                metrics = runner.step(batch)
+                if args.profile_dir:
+                    # Honest per-step timing needs the device work done;
+                    # without profiling, keep the dispatch async.
+                    jax.block_until_ready(metrics)
+            if step % 5 == 0 or step == args.steps - 1:
+                print(f"step {step}: "
+                      f"loss={float(np.asarray(metrics['loss'])):.5f}")
+    if args.profile_dir:
+        import json
+
+        summary = dict(timer.summary(),
+                       mesh=mesh, microbatches=args.microbatches,
+                       virtual_stages=args.virtual_stages,
+                       comm_overlap=overlap, batch=args.batch)
+        path = os.path.join(args.profile_dir, "step_times.json")
+        with open(path, "w") as f:
+            json.dump(summary, f, indent=1)
+        mean = summary["mean_ms"]
+        print(f"xplane trace + step-time record in {args.profile_dir}"
+              + (f" (mean {mean:.2f} ms/step)" if mean is not None else ""))
 
 
 if __name__ == "__main__":

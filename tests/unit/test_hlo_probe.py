@@ -1,8 +1,17 @@
 """HLO-structural falsifiability (tools/hlo_probe.py): the perf claims
 the VERDICT demanded silicon-free proof for, asserted as collective
-counts/kinds in compiled HLO on the simulated CPU mesh."""
-from tools.hlo_probe import (collective_counts, probe_pipeline_tp,
-                             probe_single_replica, probe_steps_per_loop)
+counts/kinds in compiled HLO on the simulated CPU mesh.
+
+Tier-1 by design: a reintroduced single-replica all-reduce, a silently
+re-fused monolithic TP all-reduce (the collective-matmul decomposition
+undone by an XLA combiner pass or a code regression), or an unrolled
+steps-per-loop scan each fail CI here, on CPU, before any hardware
+window."""
+import json
+
+from tools.hlo_probe import (collective_counts, main, probe_collective_matmul,
+                             probe_pipeline_tp, probe_single_replica,
+                             probe_steps_per_loop)
 
 
 def test_collective_counts_parses_hlo_idioms():
@@ -42,3 +51,35 @@ def test_pipeline_tp_emits_model_axis_collectives():
     assert report["collectives_tp1"]["collective-permute"] > 0
     assert report["collectives_tp2"]["collective-permute"] > 0
     assert report["model_axis_all_reduces"] >= 4
+
+
+def test_collective_matmul_removes_monolithic_all_reduce():
+    """The latency-hiding decomposition, structurally: the converted
+    tp=2 program's all-reduce count EQUALS the tp=1 baseline's (zero
+    monolithic model-axis all-reduce survives — and zero re-fuses: the
+    count is exact, not an upper bound), the 'matmul' mode adds the
+    >= tp-1 chunk-ring collective-permutes, and both modes emit the
+    reduce-scatter/all-gather pairs the monolithic op decomposed into."""
+    report = probe_collective_matmul()
+    c1 = report["collectives_tp1"]
+    for mode in ("rsag", "matmul"):
+        c = report[f"collectives_tp2_{mode}"]
+        assert c["all-reduce"] == c1["all-reduce"], (mode, c, c1)
+        assert c["reduce-scatter"] >= 1 and c["all-gather"] >= 1, (mode, c)
+    assert report["ring_collective_permutes"] >= 1
+    assert report["model_axis_all_reduces_removed"] >= 4
+
+
+def test_probe_cli_json_output(tmp_path, capsys):
+    """--json writes the machine-readable report (bench.py embeds it as
+    provenance); --probe selects a subset so the CLI contract is
+    testable without recompiling every program."""
+    out = tmp_path / "probe.json"
+    rc = main(["--probe", "single_replica", "--json", str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert set(report) == {"single_replica"}
+    assert report["single_replica"]["ok"] is True
+    assert report["single_replica"]["collectives"]["all-reduce"] == 0
+    printed = json.loads(capsys.readouterr().out)
+    assert printed == report

@@ -160,6 +160,40 @@ def test_tp2_zero1_matches_plain_and_shards_state():
     assert emb.ndim == 1 and emb.sharding.spec == P(("pipe", "data"))
 
 
+def test_tp2_int8_quantized_allreduce_composes():
+    """int8 quantized gradient allreduce (EQuARX-style,
+    kernel/compressor.py) composed with tp=2 — the compressor matrix
+    beyond bf16_ef: the shared-scale ``int8_ef`` psum and the true
+    int8-wire ``int8_ring`` ppermute ring both run over the data axis
+    while activations all-reduce over the model axis, stay close to the
+    uncompressed run, and size their EF residuals from the
+    (pipe × model)-local shard."""
+    r0 = AutoDist(SPEC_3D, "Pipeline", num_microbatches=2,
+                  tensor_parallel=2).build(make_lm())
+    l0, p0 = [], None
+    for b in lm_batches(2):
+        l0.append(float(np.asarray(
+            r0.step(b, rng=jax.random.PRNGKey(0))["loss"])))
+    p0 = r0.get_params()
+    for comp in ("int8_ef", "int8_ring"):
+        r1 = AutoDist(SPEC_3D, "Pipeline", num_microbatches=2,
+                      tensor_parallel=2, compressor=comp).build(make_lm())
+        l1 = [float(np.asarray(r1.step(b, rng=jax.random.PRNGKey(0))
+                               ["loss"])) for b in lm_batches(2)]
+        # int8 has ~2 decimal digits of mantissa; error feedback keeps
+        # the *trajectory* close, not the per-step bits.
+        np.testing.assert_allclose(l1, l0, rtol=5e-2, atol=5e-2,
+                                   err_msg=comp)
+        assert_trees_close(r1.get_params(), p0, rtol=5e-2, atol=5e-3)
+        sync = r1.state["sync_state"]
+        # qkv kernel global C*3*nh*hd*H = 2*3*2*8*16 = 1536 over
+        # pipe(2) x model(2) shards -> 384-length local residual rows,
+        # one per device.
+        assert sync["stages/attention/qkv/kernel"].shape == (8, 384), comp
+        r1.close()
+    r0.close()
+
+
 @pytest.mark.slow
 def test_tp2_compressor_runs_close_and_sizes_ef_locally():
     """bf16_ef over the data axis composes with tp; EF residual rows are

@@ -18,13 +18,22 @@ asserts collective *counts and kinds* in the optimized HLO text:
   ``model``-axis activation all-reduces (Megatron's one-per-block,
   forward and backward) *on top of* the tp=1 program's collectives, and
   both carry the ``collective-permute`` stage ring.
+* ``probe_collective_matmul`` — the latency-hiding decomposition
+  (``Pipeline(comm_overlap=...)``): the converted program carries ZERO
+  monolithic model-axis all-reduce (its all-reduce count equals the
+  tp=1 program's — nothing re-fused) while emitting the decomposed
+  forms instead: ≥ tp−1 extra ``collective-permute`` (the chunked
+  collective-matmul ring) plus ``reduce-scatter``/``all-gather`` pairs.
 
 Run as a script for a JSON report::
 
-    JAX_PLATFORMS=cpu python tools/hlo_probe.py
+    JAX_PLATFORMS=cpu python tools/hlo_probe.py            # all probes
+    JAX_PLATFORMS=cpu python tools/hlo_probe.py --json out.json
+    JAX_PLATFORMS=cpu python tools/hlo_probe.py --probe single_replica
 """
 from __future__ import annotations
 
+import argparse
 import collections
 import json
 import os
@@ -149,7 +158,7 @@ def probe_single_replica() -> dict:
     return {"collectives": counts}
 
 
-def _pipeline_runner(tensor_parallel: int):
+def _pipeline_runner(tensor_parallel: int, comm_overlap=None):
     import jax
     import jax.numpy as jnp
     import optax
@@ -169,7 +178,32 @@ def _pipeline_runner(tensor_parallel: int):
     trainable = make_pipeline_lm_trainable(cfg, optax.sgd(0.05),
                                            jax.random.PRNGKey(0))
     return AutoDist(spec, "Pipeline", num_microbatches=2,
-                    tensor_parallel=tensor_parallel).build(trainable)
+                    tensor_parallel=tensor_parallel,
+                    comm_overlap=comm_overlap).build(trainable)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _pipeline_step_text(tensor_parallel: int, comm_overlap=None) -> str:
+    """Optimized HLO of one pipeline train step (memoized: the tp=1 and
+    blocking tp=2 programs serve both probe_pipeline_tp and
+    probe_collective_matmul — each 8-device compile costs tens of
+    seconds, and the bench embeds an all-probes run under a budget)."""
+    import jax
+    import numpy as np
+
+    r = np.random.RandomState(0)
+    batch = {"x": r.randint(0, 32, (8, 8)).astype(np.int32),
+             "y": r.randint(0, 32, (8, 8)).astype(np.int32)}
+    runner = _pipeline_runner(tensor_parallel, comm_overlap)
+    try:
+        return compiled_text(runner.lowered.step_fn, runner.state,
+                             runner._place_batch(batch),
+                             jax.random.PRNGKey(0))
+    finally:
+        runner.close()
 
 
 def probe_pipeline_tp() -> dict:
@@ -178,22 +212,8 @@ def probe_pipeline_tp() -> dict:
     all-reduces appear on top of the tp=1 program's count — at least 4
     more (out-proj + wo forward psums, their custom-VJP backward psums),
     emitted once in the tick-scan body."""
-    import jax
-    import numpy as np
-
-    r = np.random.RandomState(0)
-    batch = {"x": r.randint(0, 32, (8, 8)).astype(np.int32),
-             "y": r.randint(0, 32, (8, 8)).astype(np.int32)}
-    texts = {}
-    for tp in (1, 2):
-        runner = _pipeline_runner(tp)
-        try:
-            texts[tp] = compiled_text(runner.lowered.step_fn, runner.state,
-                                      runner._place_batch(batch),
-                                      jax.random.PRNGKey(0))
-        finally:
-            runner.close()
-    c1, c2 = collective_counts(texts[1]), collective_counts(texts[2])
+    c1 = collective_counts(_pipeline_step_text(1))
+    c2 = collective_counts(_pipeline_step_text(2))
     assert c1["collective-permute"] > 0 and c2["collective-permute"] > 0, (
         f"pipeline ring missing: tp1 {c1} tp2 {c2}")
     extra = c2["all-reduce"] - c1["all-reduce"]
@@ -205,22 +225,78 @@ def probe_pipeline_tp() -> dict:
             "model_axis_all_reduces": extra}
 
 
+def probe_collective_matmul() -> dict:
+    """The latency-hiding decomposition (``Pipeline(comm_overlap=...)``)
+    at tp=2, against two baselines: the blocking tp=2 program (whose
+    model-axis all-reduces must vanish) and the tp=1 program (whose
+    all-reduce count the converted program must *equal* — any excess is
+    a monolithic model-axis all-reduce that survived or re-fused, any
+    shortfall means data/pipe sync went missing).  The ``"matmul"``
+    mode must add ≥ tp−1 collective-permute over blocking tp=2 (the
+    chunked ring); both modes must emit reduce-scatter + all-gather
+    (the decomposed boundary reductions)."""
+    tp = 2
+    c1 = collective_counts(_pipeline_step_text(1))
+    c_blk = collective_counts(_pipeline_step_text(tp))
+    report = {"collectives_tp1": c1, "collectives_tp2_blocking": c_blk}
+    for mode in ("rsag", "matmul"):
+        c = collective_counts(_pipeline_step_text(tp, comm_overlap=mode))
+        report[f"collectives_tp2_{mode}"] = c
+        assert c["all-reduce"] == c1["all-reduce"], (
+            f"comm_overlap={mode!r}: converted tp={tp} program carries "
+            f"{c['all-reduce']} all-reduce op(s) vs the tp=1 baseline's "
+            f"{c1['all-reduce']} — a monolithic model-axis all-reduce "
+            "survived the decomposition (or XLA re-fused the rs+ag pair)")
+        assert c["reduce-scatter"] >= 1 and c["all-gather"] >= 1, (
+            f"comm_overlap={mode!r}: expected decomposed reduce-scatter/"
+            f"all-gather pairs in the converted program, got {c}")
+        if mode == "matmul":
+            ring_extra = c["collective-permute"] - c_blk["collective-permute"]
+            assert ring_extra >= tp - 1, (
+                f"collective-matmul ring missing: only {ring_extra} "
+                f"collective-permute op(s) over the blocking tp={tp} "
+                f"program (expected >= {tp - 1})")
+            report["ring_collective_permutes"] = ring_extra
+    report["model_axis_all_reduces_removed"] = (
+        c_blk["all-reduce"] - c1["all-reduce"])
+    return report
+
+
 PROBES = {
     "steps_per_loop": probe_steps_per_loop,
     "single_replica": probe_single_replica,
     "pipeline_tp": probe_pipeline_tp,
+    "collective_matmul": probe_collective_matmul,
 }
 
 
-def main() -> int:
+def run_probes(names=None) -> tuple[dict, list]:
+    """Run the named probes (default all); returns (report, failed)."""
     report, failed = {}, []
-    for name, probe in PROBES.items():
+    for name in (names or list(PROBES)):
         try:
-            report[name] = {"ok": True, **probe()}
+            report[name] = {"ok": True, **PROBES[name]()}
         except AssertionError as e:
             report[name] = {"ok": False, "error": str(e)}
             failed.append(name)
-    print(json.dumps(report, indent=2))
+    return report, failed
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="HLO-structural proof of collective claims (CPU mesh)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write the report to this file (machine-"
+                         "readable provenance — bench.py embeds it)")
+    ap.add_argument("--probe", action="append", choices=sorted(PROBES),
+                    help="run only these probes (repeatable; default all)")
+    args = ap.parse_args(argv)
+    report, failed = run_probes(args.probe)
+    text = json.dumps(report, indent=2)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(text + "\n")
+    print(text)
     return 1 if failed else 0
 
 
