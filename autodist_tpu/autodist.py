@@ -12,7 +12,7 @@ from __future__ import annotations
 import os
 from typing import Any, Optional, Union
 
-from autodist_tpu import const
+from autodist_tpu import const, telemetry
 from autodist_tpu.capture import Trainable
 from autodist_tpu.kernel.lowering import Lowered, lower
 from autodist_tpu.resource import ResourceSpec
@@ -61,6 +61,13 @@ class AutoDist:
         rides the native coordination service when one is configured
         (blocking KV get ≙ the reference's SFTP strategy drop,
         ``coordinator.py:66-90``); otherwise the shared strategy dir."""
+        with telemetry.span("autodist/build_or_load_strategy") as sp:
+            strategy = self._build_or_load_strategy(trainable)
+            sp.set(strategy_id=strategy.id,
+                   lowering=strategy.graph_config.lowering)
+            return strategy
+
+    def _build_or_load_strategy(self, trainable: Trainable) -> Strategy:
         from autodist_tpu.runtime import coordination
 
         strategy_id = const.ENV.AUTODIST_TPU_STRATEGY_ID.val
@@ -129,6 +136,11 @@ class AutoDist:
     def lower(self, trainable: Trainable,
               strategy: Optional[Strategy] = None) -> Lowered:
         strategy = strategy or self.build_or_load_strategy(trainable)
+        with telemetry.span("autodist/lower",
+                            lowering=strategy.graph_config.lowering):
+            return self._lower(trainable, strategy)
+
+    def _lower(self, trainable: Trainable, strategy: Strategy) -> Lowered:
         kind = strategy.graph_config.lowering
         if kind == "collective":
             return lower(trainable, strategy, self.mesh)
@@ -167,6 +179,12 @@ class AutoDist:
         asynchrony cannot live inside one SPMD program); everything else
         gets the SPMD :class:`~autodist_tpu.runner.DistributedRunner`."""
         strategy = strategy or self.build_or_load_strategy(trainable)
+        with telemetry.span("autodist/build",
+                            lowering=strategy.graph_config.lowering):
+            return self._build(trainable, strategy, rng=rng, **runner_kwargs)
+
+    def _build(self, trainable: Trainable, strategy: Strategy, *,
+               rng: Any = None, **runner_kwargs):
         # A measuring builder (AutoStrategy measure_top_k) may already
         # hold the winning strategy's compiled runner — reuse it instead
         # of recompiling the identical program.

@@ -25,7 +25,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from autodist_tpu import const
+from autodist_tpu import const, telemetry
 from autodist_tpu.kernel.lowering import Lowered
 from autodist_tpu.utils import logging
 
@@ -51,6 +51,9 @@ class DistributedRunner:
         self.state = lowered.init_state(trainable=trainable)
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._step_times: list[float] = []
+        self._run_examples = 0
+        self._run_steps_seen = 0
+        self._run_seconds = 0.0
         self._host_step = 0
         self._scanned_fn = None   # built lazily by run_steps
         self._ssp = self._make_ssp_gate(ssp_worker, ssp_num_workers)
@@ -133,6 +136,7 @@ class DistributedRunner:
             jax.block_until_ready(metrics)
             self._ssp.finish_step(self._host_step)
         self._host_step += 1
+        telemetry.counter("runner/steps").inc()
         return metrics
 
     def run_steps(self, batches, *, rngs=None):
@@ -195,8 +199,10 @@ class DistributedRunner:
             # Shape-generic: jit specializes per (k, batch shapes); state
             # donation keeps params/opt buffers in place across the call.
             self._scanned_fn = jax.jit(scanned, donate_argnums=(0,))
-        self.state, metrics = self._scanned_fn(self.state, batches, rngs)
+        with telemetry.span("runner/run_steps", k=k):
+            self.state, metrics = self._scanned_fn(self.state, batches, rngs)
         self._host_step += k
+        telemetry.counter("runner/steps").inc(k)
         return metrics
 
     def place_steps(self, batches):
@@ -221,9 +227,23 @@ class DistributedRunner:
                                is_leaf=lambda s: isinstance(s, P))
         return self._place_batch(batches, specs=stacked)
 
+    # Retained per-step timings are capped (summary percentiles come
+    # from this sample; the count keeps climbing) so a long run cannot
+    # grow the host with timing data — mirrors telemetry's own
+    # MAX_STEP_RECORDS bound.
+    MAX_STEP_TIMES = 100000
+
     def run(self, data: Iterable, num_steps: Optional[int] = None,
             log_every: int = 0):
-        """Drive ``num_steps`` steps from an iterable of host batches."""
+        """Drive ``num_steps`` steps from an iterable of host batches.
+
+        Every step blocks on its metrics and its wall time is recorded
+        (see :meth:`summary`) and fed to telemetry as a per-step record
+        — this loop measures true device latency, at the price of
+        host/device overlap.  Throughput-critical loops should use
+        :meth:`run_steps` / ``fit(steps_per_loop=k)``, which keep
+        dispatch fused and async.
+        """
         metrics = {}
         it = iter(data)
         i = 0
@@ -234,15 +254,49 @@ class DistributedRunner:
                 break
             t0 = time.perf_counter()
             metrics = self.step(batch)
-            if log_every and (i + 1) % log_every == 0:
-                jax.block_until_ready(metrics)
-                dt = time.perf_counter() - t0
+            jax.block_until_ready(metrics)
+            dt = time.perf_counter() - t0
+            if len(self._step_times) < self.MAX_STEP_TIMES:
                 self._step_times.append(dt)
+            self._run_steps_seen += 1
+            self._run_seconds += dt
+            bsz = next((int(np.shape(l)[0]) for l in jax.tree.leaves(batch)
+                        if np.ndim(l) > 0), 0)
+            self._run_examples += bsz
+            telemetry.record_step(step=self._host_step - 1, duration_s=dt,
+                                  examples=bsz or None)
+            if log_every and (i + 1) % log_every == 0:
                 logging.info("step %d %s (%.1f ms/step)",
                              int(self.state["step"]),
                              {k: float(v) for k, v in metrics.items()}, dt * 1e3)
             i += 1
         return metrics
+
+    def summary(self) -> dict:
+        """Step-time percentiles over every :meth:`run` step so far —
+        the same shape (and, since :meth:`run` blocks per step, the same
+        semantics) as :meth:`StepTimer.summary()
+        <autodist_tpu.utils.profiling.StepTimer.summary>`, so downstream
+        consumers (telemetry drift report, ``tools/telemetry_report.py``)
+        accept either.  Percentiles come from the retained sample
+        (capped at :data:`MAX_STEP_TIMES`); ``steps`` and the rate cover
+        every step."""
+        ts = np.asarray(self._step_times)
+        n = len(ts)
+        out = {
+            "steps": self._run_steps_seen,
+            "mean_ms": (self._run_seconds / self._run_steps_seen * 1e3
+                        if self._run_steps_seen else None),
+            "p50_ms": float(np.percentile(ts, 50) * 1e3) if n else None,
+            "p99_ms": float(np.percentile(ts, 99) * 1e3) if n else None,
+            "examples_per_sec": (self._run_examples / self._run_seconds
+                                 if self._run_seconds > 0
+                                 and self._run_examples else None),
+        }
+        if out["examples_per_sec"] is not None:
+            telemetry.gauge("runner/examples_per_sec").set(
+                out["examples_per_sec"])
+        return out
 
     def eval_step(self, batch, *, rng=None):
         """Metrics without updating state (fetch-only contract — the
@@ -478,6 +532,7 @@ class AsyncPSRunner:
                 published = version
                 last_pub = time.time()
                 self.ps_publish_count += 1
+                telemetry.counter("asyncps/publish").inc()
                 return True
 
             alive = True
@@ -503,6 +558,7 @@ class AsyncPSRunner:
                                                      ps_params)
                     ps_params = optax.apply_updates(ps_params, updates)
                     version += 1
+                    telemetry.counter("asyncps/apply").inc()
                     if (version - published >= lag
                             or time.time() - last_pub > interval):
                         if not publish():
@@ -530,9 +586,13 @@ class AsyncPSRunner:
         if ver_raw is None:
             return
         if not force and struct.unpack("<q", ver_raw)[0] == self._params_version:
-            return  # nothing new: skip moving the blob
+            # nothing new: skip moving the blob (a "dropped" pull — the
+            # publish-gating elides host serialization under bursts)
+            telemetry.counter("asyncps/pull_skip").inc()
+            return
         data = self._client.get(self.PARAMS_KEY, timeout_ms=-1)
         self._params_version, self.params = _unpack_tree(data, self.params)
+        telemetry.counter("asyncps/pull").inc()
 
     # ------------------------------------------------------------------ #
     def step(self, batch, *, rng=None):
@@ -556,6 +616,7 @@ class AsyncPSRunner:
         self._client.queue_put(self.GRADS_QUEUE,
                                _pack_tree(self._host_step,
                                           jax.device_get(grads)))
+        telemetry.counter("asyncps/push").inc()
         if self._ssp is not None:
             self._ssp.finish_step(self._host_step)
         self._host_step += 1

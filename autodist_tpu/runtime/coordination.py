@@ -360,7 +360,22 @@ class SSPController:
                     f"within {register_timeout_ms}ms")
 
     def start_step(self, step: int) -> bool:
-        return self.client.ssp_wait(step, self.staleness)
+        from autodist_tpu import telemetry
+
+        if not telemetry.enabled():
+            return self.client.ssp_wait(step, self.staleness)
+        import time
+
+        t0 = time.perf_counter()
+        ok = self.client.ssp_wait(step, self.staleness)
+        # The gate wait IS the price of the staleness bound: how long
+        # this worker blocked for its slowest peer.  Lockstep jobs show
+        # ~0; a fat tail here means a straggler, not a slow chip.
+        telemetry.histogram("ssp/gate_wait_s").observe(
+            time.perf_counter() - t0)
+        if not ok:
+            telemetry.counter("ssp/gate_timeouts").inc()
+        return ok
 
     def finish_step(self, step: int):
         self.client.ssp_report(self.worker, step)

@@ -140,6 +140,10 @@ class StrategyCost:
     num_collectives: int
     mem_bytes_per_device: float
     feasible: bool             # fits in HBM (with headroom)
+    # Exposed (un-hidden) time of latency-hiding decompositions, already
+    # included in comm_time_s; broken out so the telemetry drift report
+    # can show comm vs exposed-overlap per term.
+    overlap_time_s: float = 0.0
 
     @property
     def score(self) -> float:
@@ -598,7 +602,9 @@ class CostModel:
                             comm_time_s=comm_time,
                             num_collectives=colls + extra_colls,
                             mem_bytes_per_device=mem,
-                            feasible=mem <= hbm)
+                            feasible=mem <= hbm,
+                            overlap_time_s=(overlap_s
+                                            if total_devices > 1 else 0.0))
 
     def strategy_cost(self, trainable: Trainable,
                       strategy: Strategy) -> StrategyCost:

@@ -1,0 +1,242 @@
+"""Predicted-vs-measured drift report: the cost-model calibration loop.
+
+The analytic cost model (:mod:`autodist_tpu.simulator.cost_model`) ranks
+strategies from chip-table constants; GSPMD-style auto-sharding and
+placement synthesis both live or die by keeping such models honest
+against silicon.  :func:`drift_report` joins a strategy's *predicted*
+step-time terms (comm vs compute vs exposed-overlap) and per-device
+memory against *measured* step percentiles (``StepTimer``/runner
+summaries) and HBM (``profiling.memory_summary``), emits per-term
+ratios, and proposes updated ``calibration.json`` ``"link"`` constants —
+so a hardware window produces calibration data mechanically instead of
+by hand.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+# Propose a link-constant update only when prediction and measurement
+# disagree by more than this factor — below it the analytic default is
+# within measurement noise.
+_PROPOSAL_THRESHOLD = 0.10
+
+
+def _measured_step_seconds(step: Optional[dict]) -> tuple[Optional[float],
+                                                          dict]:
+    """(p50 step seconds, echo dict) from a ``StepTimer.summary()`` /
+    ``DistributedRunner.summary()``-shaped dict."""
+    if not step:
+        return None, {}
+    echo = {k: step[k] for k in ("steps", "mean_ms", "p50_ms", "p99_ms",
+                                 "examples_per_sec") if step.get(k)
+            is not None}
+    for key in ("p50_ms", "mean_ms"):
+        if step.get(key) is not None:
+            return float(step[key]) / 1e3, echo
+    return None, echo
+
+
+def _measured_memory_bytes(memory: Optional[dict]) -> tuple[Optional[float],
+                                                            Optional[str]]:
+    """(bytes, source) — HBM ``bytes_in_use`` where the backend exposes
+    it; host peak-RSS fallback otherwise (CPU meshes report no device
+    memory, but the calibration join must still cover the memory axis —
+    flagged so nobody mistakes RSS for HBM)."""
+    if memory and memory.get("bytes_in_use"):
+        return float(memory["bytes_in_use"]), "device_bytes_in_use"
+    try:
+        import resource as _resource
+
+        rss_kb = _resource.getrusage(_resource.RUSAGE_SELF).ru_maxrss
+        if rss_kb:
+            return float(rss_kb) * 1024.0, "host_rss_peak"
+    except (ImportError, OSError, ValueError):  # pragma: no cover
+        pass
+    return None, None
+
+
+def drift_report(strategy=None, cost_model=None,
+                 measured: Optional[dict] = None, *,
+                 trainable=None, predicted=None,
+                 flops_per_step: Optional[float] = None,
+                 out_dir: Optional[str] = None) -> dict:
+    """Join a strategy's predicted cost against a measured run.
+
+    Args:
+      strategy: the :class:`~autodist_tpu.strategy.ir.Strategy` that ran.
+      cost_model: a :class:`~autodist_tpu.simulator.cost_model.CostModel`
+        (supplies the prediction via ``strategy_cost`` and the link
+        constants the proposal updates).
+      measured: ``{"step": StepTimer.summary()-shaped dict,
+        "memory": profiling.memory_summary() dict}`` plus optional
+        ``"examples_per_sec"`` / ``"flops_per_example"`` for MFU.
+      trainable: needed with ``cost_model`` to price the strategy
+        (ignored when ``predicted`` is given).
+      predicted: a precomputed
+        :class:`~autodist_tpu.simulator.cost_model.StrategyCost` (or
+        dict with its fields) — bypasses ``cost_model.strategy_cost``.
+      flops_per_step: model FLOPs per optimizer step; enables the
+        compute term (and its ratio) — without it the predicted step
+        time is the communication envelope only, flagged ``comm_only``.
+      out_dir: write ``drift.json`` here (defaults to the telemetry
+        flush directory when one is configured).
+
+    Returns the report dict (always; file output is best-effort).
+    """
+    from autodist_tpu import telemetry
+
+    measured = measured or {}
+    if predicted is None:
+        if cost_model is None or strategy is None or trainable is None:
+            raise ValueError(
+                "drift_report needs either predicted= or all of "
+                "(strategy, cost_model, trainable)")
+        predicted = cost_model.strategy_cost(trainable, strategy)
+    if not isinstance(predicted, dict):
+        predicted = {
+            "comm_bytes": predicted.comm_bytes,
+            "comm_time_s": predicted.comm_time_s,
+            "overlap_time_s": getattr(predicted, "overlap_time_s", 0.0),
+            "num_collectives": predicted.num_collectives,
+            "mem_bytes_per_device": predicted.mem_bytes_per_device,
+            "feasible": predicted.feasible,
+        }
+
+    comm_s = float(predicted.get("comm_time_s") or 0.0)
+    overlap_s = float(predicted.get("overlap_time_s") or 0.0)
+    pred_mem = float(predicted.get("mem_bytes_per_device") or 0.0)
+
+    compute_s = None
+    wire_s = None
+    if cost_model is not None:
+        bw_link = float(cost_model.link_profile.get(
+            "ici_gbps", cost_model.chip.ici_gbps)) * 1e9
+        wire_s = float(predicted.get("comm_bytes") or 0.0) / bw_link
+        if flops_per_step:
+            from autodist_tpu.simulator import cost_model as _cm
+
+            mxu_eff = float(cost_model.link_profile.get(
+                "mxu_efficiency", _cm._DEFAULT_MXU_EFFICIENCY))
+            n = cost_model.spec.num_devices()
+            peak = cost_model.chip.peak_bf16_tflops * 1e12 * n
+            compute_s = float(flops_per_step) / (peak * mxu_eff)
+
+    pred_step_s = comm_s + (compute_s or 0.0)
+    pred_terms = {
+        "step_time_s": pred_step_s,
+        "comm_time_s": comm_s - overlap_s,   # blocking wire + launch term
+        "exposed_overlap_s": overlap_s,
+        "compute_time_s": compute_s,
+        "comm_only": compute_s is None,
+        "mem_bytes_per_device": pred_mem,
+        "comm_bytes": predicted.get("comm_bytes"),
+        "num_collectives": predicted.get("num_collectives"),
+        "feasible": predicted.get("feasible"),
+    }
+
+    meas_step_s, step_echo = _measured_step_seconds(measured.get("step"))
+    meas_mem, mem_source = _measured_memory_bytes(measured.get("memory"))
+    meas_terms: dict[str, Any] = dict(step_echo)
+    if meas_step_s is not None:
+        meas_terms["step_time_s"] = meas_step_s
+    if meas_mem is not None:
+        meas_terms["mem_bytes_per_device"] = meas_mem
+        meas_terms["memory_source"] = mem_source
+    if measured.get("examples_per_sec") is not None:
+        meas_terms["examples_per_sec"] = float(measured["examples_per_sec"])
+
+    ratios: dict[str, Optional[float]] = {}
+    if meas_step_s is not None and pred_step_s > 0:
+        ratios["step_time"] = meas_step_s / pred_step_s
+    if meas_mem is not None and pred_mem > 0:
+        ratios["memory"] = meas_mem / pred_mem
+    residual_comm = None
+    if meas_step_s is not None:
+        residual_comm = max(meas_step_s - (compute_s or 0.0), 0.0)
+        if comm_s > 0:
+            ratios["comm_time"] = residual_comm / comm_s
+        if compute_s:
+            # comm_s may be 0 (single-device mesh): the compute ratio is
+            # then the whole measured step against the compute term —
+            # exactly the quantity the mxu_efficiency proposal fits.
+            measured_compute = max(meas_step_s - comm_s, 0.0)
+            if measured_compute > 0:
+                ratios["compute_time"] = measured_compute / compute_s
+
+    mfu = None
+    if (measured.get("examples_per_sec") and measured.get("flops_per_example")
+            and cost_model is not None):
+        from autodist_tpu.utils import profiling
+
+        peak = (cost_model.chip.peak_bf16_tflops * 1e12
+                * cost_model.spec.num_devices())
+        mfu = profiling.mfu(float(measured["examples_per_sec"]),
+                            float(measured["flops_per_example"]), peak)
+        meas_terms["mfu"] = mfu
+
+    # ---- calibration proposal ---------------------------------------- #
+    proposal: dict[str, Any] = {}
+    if (cost_model is not None and wire_s and residual_comm
+            and residual_comm > 0):
+        # First-order bandwidth fit: attribute the whole comm residual to
+        # the wire term.  measured_wire ≈ residual - launch overhead;
+        # bytes/bw_new = residual ⇒ bw_new = bw_old · wire_s/residual.
+        old_ici = float(cost_model.link_profile.get(
+            "ici_gbps", cost_model.chip.ici_gbps))
+        new_ici = old_ici * wire_s / residual_comm
+        if abs(new_ici - old_ici) / old_ici > _PROPOSAL_THRESHOLD:
+            # significant digits, not decimal places: a CPU-mesh fit can
+            # land orders of magnitude below 1 Gbps and must not round
+            # to an (unusable) 0.0
+            proposal.setdefault("link", {})["ici_gbps"] = \
+                float(f"{new_ici:.4g}")
+    if (cost_model is not None and compute_s and meas_step_s is not None):
+        measured_compute = meas_step_s - comm_s
+        if measured_compute > 0:
+            from autodist_tpu.simulator import cost_model as _cm
+
+            old_eff = float(cost_model.link_profile.get(
+                "mxu_efficiency", _cm._DEFAULT_MXU_EFFICIENCY))
+            new_eff = min(old_eff * compute_s / measured_compute, 1.0)
+            if abs(new_eff - old_eff) / old_eff > _PROPOSAL_THRESHOLD:
+                proposal.setdefault("link", {})["mxu_efficiency"] = \
+                    float(f"{new_eff:.4g}")
+    if proposal:
+        proposal["note"] = (
+            "first-order fit from ONE measured config; merge into "
+            "calibration.json's \"link\" section only after a second "
+            "config reproduces it (hop_alpha_s needs two payload sizes "
+            "to separate from bandwidth, and is left untouched)")
+
+    report = {
+        "kind": "drift",
+        "strategy": {
+            "id": getattr(strategy, "id", None),
+            "lowering": getattr(
+                getattr(strategy, "graph_config", None), "lowering", None),
+        } if strategy is not None else None,
+        "predicted": pred_terms,
+        "measured": meas_terms,
+        "ratios": ratios,
+        "proposal": proposal or None,
+    }
+
+    tel = telemetry.get()
+    for name, value in ratios.items():
+        tel.gauge(f"drift/{name}_ratio").set(value)
+    if mfu is not None:
+        tel.gauge("drift/mfu").set(mfu)
+
+    out_dir = out_dir or tel.out_dir
+    if out_dir and tel.enabled:
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            path = os.path.join(out_dir, "drift.json")
+            with open(path, "w") as f:
+                json.dump(report, f, indent=1)
+            report["path"] = path
+        except OSError:  # report still returned; file is best-effort
+            pass
+    return report

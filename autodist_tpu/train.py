@@ -14,6 +14,7 @@ from typing import Any, Callable, Iterable, Optional
 
 import numpy as np
 
+from autodist_tpu import telemetry
 from autodist_tpu.utils import logging
 
 
@@ -127,51 +128,69 @@ def fit(runner, source: Iterable | Callable[[int], Any], *,
     # Host-side step mirror: reading runner.step_count would block on
     # the in-flight (async) window's device state every iteration.
     step = start
-    while step < start + remaining:
-        if fused:
-            window = take_window(next_window_size(step))
-            if not window:
-                break
-            stacked_metrics = runner.run_steps(stack_steps(window))
-            metrics = {k: v[-1] for k, v in stacked_metrics.items()}
-            bsz = _batch_size(window[0]) * len(window)
-            step += len(window)
-        else:
-            try:
-                batch = next(loader)
-            except StopIteration:
-                break
-            metrics = runner.step(batch)
-            bsz = _batch_size(batch)
-            step += 1
-        examples += bsz
-        window_examples += bsz
-        if log_every and step % log_every == 0:
-            loss = float(np.asarray(metrics.get("loss", np.nan)))
-            dt = time.perf_counter() - t_window
-            rate = window_examples / dt if dt > 0 else float("nan")
-            history["loss"].append((step, loss))
-            logging.info("fit: step %d loss %.4f (%.1f examples/s)",
-                         step, loss, rate)
-            window_examples, t_window = 0, time.perf_counter()
-        if eval_every and eval_source is not None and step % eval_every == 0:
-            ev = runner.evaluate(_iter_source(eval_source, eval_batches),
-                                 num_batches=eval_batches)
-            if not ev:
-                logging.warning(
-                    "fit: eval at step %d saw no batches — a one-shot "
-                    "iterator eval_source is exhausted; pass a callable "
-                    "or a re-iterable (list)", step)
-            history["eval"].append((step, ev))
-            logging.info("fit: step %d eval %s", step,
-                         {k: round(float(v), 4) for k, v in ev.items()})
-        if saver is not None and save_every and step % save_every == 0:
-            saver.save(runner)
+    fit_span = telemetry.span("train/fit", steps=steps, start=start,
+                              fused=bool(fused))
+    with fit_span:
+        while step < start + remaining:
+            t_step = time.perf_counter()
+            if fused:
+                window = take_window(next_window_size(step))
+                if not window:
+                    break
+                stacked_metrics = runner.run_steps(stack_steps(window))
+                metrics = {k: v[-1] for k, v in stacked_metrics.items()}
+                bsz = _batch_size(window[0]) * len(window)
+                step += len(window)
+                telemetry.record_step(
+                    step=step - 1, duration_s=time.perf_counter() - t_step,
+                    examples=bsz, steps=len(window))
+            else:
+                try:
+                    batch = next(loader)
+                except StopIteration:
+                    break
+                metrics = runner.step(batch)
+                bsz = _batch_size(batch)
+                step += 1
+                telemetry.record_step(
+                    step=step - 1, duration_s=time.perf_counter() - t_step,
+                    examples=bsz)
+            examples += bsz
+            window_examples += bsz
+            if log_every and step % log_every == 0:
+                loss = float(np.asarray(metrics.get("loss", np.nan)))
+                dt = time.perf_counter() - t_window
+                rate = window_examples / dt if dt > 0 else float("nan")
+                history["loss"].append((step, loss))
+                logging.info("fit: step %d loss %.4f (%.1f examples/s)",
+                             step, loss, rate)
+                window_examples, t_window = 0, time.perf_counter()
+            if eval_every and eval_source is not None \
+                    and step % eval_every == 0:
+                with telemetry.span("train/eval", step=step):
+                    ev = runner.evaluate(
+                        _iter_source(eval_source, eval_batches),
+                        num_batches=eval_batches)
+                if not ev:
+                    logging.warning(
+                        "fit: eval at step %d saw no batches — a one-shot "
+                        "iterator eval_source is exhausted; pass a callable "
+                        "or a re-iterable (list)", step)
+                history["eval"].append((step, ev))
+                logging.info("fit: step %d eval %s", step,
+                             {k: round(float(v), 4) for k, v in ev.items()})
+            if saver is not None and save_every and step % save_every == 0:
+                with telemetry.span("train/checkpoint", step=step):
+                    saver.save(runner)
 
-    if saver is not None and saver.latest_step() != runner.step_count:
-        saver.save(runner, force=True)
+        if saver is not None and saver.latest_step() != runner.step_count:
+            with telemetry.span("train/checkpoint", step=runner.step_count):
+                saver.save(runner, force=True)
     total = time.perf_counter() - t0
     history["examples_per_sec"] = examples / total if total > 0 else 0.0
+    if history["examples_per_sec"]:
+        telemetry.gauge("fit/examples_per_sec").set(
+            history["examples_per_sec"])
     return history
 
 

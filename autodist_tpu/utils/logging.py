@@ -31,8 +31,13 @@ def get_logger():
     logger.addHandler(sh)
     try:
         os.makedirs(const.DEFAULT_LOG_DIR, exist_ok=True)
+        # Per-run name: pid + timestamp.  Concurrent workers on one host
+        # (multi-process launches, AutoStrategy measurement subprocesses)
+        # used to collide on the same epoch-second filename and interleave
+        # into one file.
         fh = _logging.FileHandler(
-            os.path.join(const.DEFAULT_LOG_DIR, f"{int(time.time())}.log")
+            os.path.join(const.DEFAULT_LOG_DIR,
+                         f"{os.getpid()}-{int(time.time())}.log")
         )
         fh.setFormatter(fmt)
         logger.addHandler(fh)
@@ -43,7 +48,13 @@ def get_logger():
 
 
 def set_verbosity(level):
-    get_logger().setLevel(level)
+    """Set the level on the logger AND its handlers: a handler carrying
+    its own (stricter) level would otherwise keep filtering records the
+    logger now admits."""
+    logger = get_logger()
+    logger.setLevel(level)
+    for handler in logger.handlers:
+        handler.setLevel(level)
 
 
 def debug(msg, *a):

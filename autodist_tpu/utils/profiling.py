@@ -95,6 +95,11 @@ class StepTimer:
         self._count += 1
         if self._count > self.warmup:
             self._times.append(dt)
+            # Mirror into the process-wide registry so a flushed run
+            # carries the meter's distribution without a second wiring.
+            from autodist_tpu import telemetry
+
+            telemetry.histogram("steptimer/step_s").observe(dt)
 
     @property
     def steps_recorded(self) -> int:

@@ -126,26 +126,13 @@ class _Watchdog:
 
 
 def _provenance() -> dict:
-    """Identity stamp for every emitted record: the exact code (git SHA)
-    and jax/jaxlib versions the number was measured with — a hardware
-    window's results must stay interpretable months later, and a
-    regression hunt needs to know which commit produced which MFU."""
-    rec = {}
-    try:
-        sha = subprocess.run(
-            ["git", "rev-parse", "HEAD"],
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-            capture_output=True, text=True, timeout=10).stdout.strip()
-        rec["git_sha"] = sha or None
-    except (OSError, subprocess.SubprocessError):
-        rec["git_sha"] = None
-    rec["jax"] = getattr(jax, "__version__", None)
-    try:
-        import jaxlib
-        rec["jaxlib"] = getattr(jaxlib, "__version__", None)
-    except ImportError:  # pragma: no cover
-        rec["jaxlib"] = None
-    return rec
+    """Identity stamp for every emitted record (git SHA + jax/jaxlib
+    versions) — one schema with every other run artifact: the telemetry
+    run manifest owns it (``telemetry/records.py``)."""
+    from autodist_tpu.telemetry import records
+
+    return records.provenance(
+        repo_root=os.path.dirname(os.path.abspath(__file__)))
 
 
 def _probe_summary(timeout_s: float) -> dict:
@@ -381,6 +368,14 @@ def _bench(dog):
     peak = rs.chip.peak_bf16_tflops * 1e12 * n
 
     provenance = _provenance()
+    from autodist_tpu import telemetry
+    telemetry.annotate(bench="bert_base_mlm_mfu", devices=n,
+                       chip=rs.chip.name)
+    # Fresh-process retries thread the attempt number through the env
+    # (_unavailable_exit): surface it so a flushed run records how many
+    # backend bring-ups this number cost.
+    telemetry.gauge("bench/attempt").set(
+        int(os.environ.get("AUTODIST_TPU_BENCH_ATTEMPT", "1")))
 
     def make_record(name, b, rate, dt_step=None):
         m = profiling.mfu(rate, flops_per_example, peak)
@@ -494,6 +489,7 @@ def _bench(dog):
                                 or "UNAVAILABLE" in str(e))):
                     break
                 retried = True
+                telemetry.counter("bench/retries").inc()
                 print(f"# retrying attempt {name}/b{b} once", flush=True)
 
     # HLO-probe provenance AFTER the scored runs (it must never eat the
@@ -521,6 +517,11 @@ def _bench(dog):
         record["hbm_gb_in_use"] = round(mem["bytes_in_use"] / 1e9, 2)
     dog.disarm()
     print(json.dumps(record), flush=True)
+    # Spans (build/compile/dispatch), step counters, retry counts, and
+    # the run manifest — written only when AUTODIST_TPU_TELEMETRY_DIR is
+    # set; never on the measurement path.
+    telemetry.gauge("bench/mfu").set(mfu)
+    telemetry.flush()
 
     # Optional trace capture AFTER the record is emitted (a timeout mid-
     # capture must never discard an already-completed measurement) and

@@ -47,10 +47,18 @@ def main():
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--profile-dir", default=None,
-                    help="capture an xplane trace of the step loop here "
-                         "plus step_times.json (StepTimer percentiles) — "
-                         "a hardware window yields both with zero extra "
+                    help="capture an xplane trace of the step loop here; "
+                         "also implies --telemetry-dir here, so a "
+                         "hardware window yields the trace plus step "
+                         "records/manifest/drift report with zero extra "
                          "typing")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="flush telemetry here: trace.json (chrome "
+                         "trace of build/compile/step spans), "
+                         "metrics.jsonl (per-step records + counters), "
+                         "manifest.json (git SHA/jax versions/run "
+                         "config), drift.json (cost-model predicted vs "
+                         "measured step time + memory)")
     args = ap.parse_args()
 
     import jax
@@ -114,8 +122,19 @@ def main():
                        zero1=args.zero1, remat=args.remat)
     if args.accum_steps > 1:
         builder = GradAccumulation(builder, steps=args.accum_steps)
-    runner = AutoDist({"topology": {"num_devices": dp * pp * tp},
-                       "mesh": mesh}, builder).build(trainable)
+
+    from autodist_tpu import telemetry
+
+    tel_dir = args.telemetry_dir or args.profile_dir
+    if tel_dir:
+        telemetry.configure(out_dir=tel_dir)
+    ad = AutoDist({"topology": {"num_devices": dp * pp * tp},
+                   "mesh": mesh}, builder)
+    # The strategy is kept in hand (instead of letting build() resolve it
+    # internally) so the drift report below can join the cost model's
+    # prediction for exactly the program that ran.
+    strategy = ad.build_or_load_strategy(trainable)
+    runner = ad.build(trainable, strategy)
 
     print(f"pipe={pp} x virtual={args.virtual_stages} "
           f"(C={C} chunks), dp={dp}, tp={tp}, M={args.microbatches}, "
@@ -133,32 +152,51 @@ def main():
                                 warmup=min(2, max(args.steps - 1, 0)))
     trace_cm = (profiling.trace(args.profile_dir) if args.profile_dir
                 else nullcontext())
+    import time
+
     with trace_cm:
         for step in range(args.steps):
             x = r.randn(args.batch, HID).astype(np.float32)
             batch = {"x": x, "y": x @ target}
+            t_step = time.perf_counter()
             with timer:
                 metrics = runner.step(batch)
-                if args.profile_dir:
+                if tel_dir:
                     # Honest per-step timing needs the device work done;
-                    # without profiling, keep the dispatch async.
+                    # without a telemetry/profile sink, keep the
+                    # dispatch async.
                     jax.block_until_ready(metrics)
+            telemetry.record_step(step=step,
+                                  duration_s=time.perf_counter() - t_step,
+                                  examples=args.batch)
             if step % 5 == 0 or step == args.steps - 1:
                 print(f"step {step}: "
                       f"loss={float(np.asarray(metrics['loss'])):.5f}")
-    if args.profile_dir:
-        import json
 
-        summary = dict(timer.summary(),
-                       mesh=mesh, microbatches=args.microbatches,
-                       virtual_stages=args.virtual_stages,
-                       comm_overlap=overlap, batch=args.batch)
-        path = os.path.join(args.profile_dir, "step_times.json")
-        with open(path, "w") as f:
-            json.dump(summary, f, indent=1)
-        mean = summary["mean_ms"]
-        print(f"xplane trace + step-time record in {args.profile_dir}"
-              + (f" (mean {mean:.2f} ms/step)" if mean is not None else ""))
+    summary = timer.summary()
+    if tel_dir:
+        from autodist_tpu.simulator.cost_model import CostModel
+        from autodist_tpu.utils.profiling import memory_summary
+
+        telemetry.annotate(mesh=mesh, microbatches=args.microbatches,
+                           virtual_stages=args.virtual_stages,
+                           comm_overlap=overlap, batch=args.batch,
+                           tensor_parallel=tp, zero1=args.zero1,
+                           remat=args.remat, step_summary=summary)
+        report = telemetry.drift_report(
+            strategy, CostModel(ad.resource_spec),
+            {"step": summary, "memory": memory_summary(),
+             "examples_per_sec": summary.get("examples_per_sec")},
+            trainable=trainable)
+        paths = telemetry.flush()
+        print(f"telemetry artifacts in {tel_dir}: "
+              f"{sorted(os.path.basename(p) for p in paths.values())}")
+        ratios = {k: round(v, 3) for k, v in report["ratios"].items()}
+        print(f"drift (measured/predicted): {ratios}")
+    mean = summary["mean_ms"]
+    if args.profile_dir and mean is not None:
+        print(f"xplane trace in {args.profile_dir} "
+              f"(mean {mean:.2f} ms/step)")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,370 @@
+"""Unified telemetry subsystem (telemetry/): spans, metrics registry,
+per-step records, run manifest, drift report, and the report tool.
+
+Tier-1 by design: the acceptance contract is that one CPU-mesh run of
+``examples/pipeline_train.py --telemetry-dir`` yields a valid chrome
+trace, a metrics JSONL with per-step records, a run manifest, and a
+predicted-vs-measured drift report — asserted here, so a schema break
+fails CI without hardware.
+"""
+import json
+import logging as py_logging
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import AllReduce, AutoDist, ResourceSpec, Trainable, fit
+from autodist_tpu import telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def make_trainable(seed=0):
+    k = jax.random.PRNGKey(seed)
+    params = {"w": jax.random.normal(k, (32, 8)) * 0.1}
+
+    def loss_fn(p, batch):
+        pred = batch["x"] @ p["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    return Trainable.from_loss_fn(loss_fn, params, optax.adamw(1e-2))
+
+
+def source(step):
+    r = np.random.RandomState(step)
+    return {"x": r.randn(16, 32).astype(np.float32),
+            "y": r.randn(16, 8).astype(np.float32)}
+
+
+# --------------------------------------------------------------------- #
+# spans + chrome trace
+# --------------------------------------------------------------------- #
+def test_span_nesting_and_chrome_trace(tmp_path):
+    with telemetry.span("outer", phase="x"):
+        with telemetry.span("inner"):
+            time.sleep(0.002)
+    paths = telemetry.flush(str(tmp_path))
+    with open(paths["trace"]) as f:
+        trace = json.load(f)
+    assert "traceEvents" in trace
+    events = {e["name"]: e for e in trace["traceEvents"]}
+    assert {"outer", "inner"} <= set(events)
+    for e in trace["traceEvents"]:
+        # chrome-trace complete events: ph "X", microsecond ts + dur
+        assert e["ph"] == "X"
+        assert isinstance(e["ts"], (int, float)) and e["dur"] >= 0
+        assert e["pid"] == os.getpid()
+    outer, inner = events["outer"], events["inner"]
+    # nesting: the inner interval lies inside the outer one (1 µs slack
+    # for float rounding)
+    assert outer["ts"] <= inner["ts"] + 1.0
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1.0
+    assert inner["dur"] >= 2000  # the 2 ms sleep, in µs
+    assert outer["args"]["phase"] == "x"
+    assert inner["args"]["depth"] == 1
+
+
+def test_span_set_attributes():
+    with telemetry.span("s") as sp:
+        sp.set(lowering="pipeline")
+    [event] = telemetry.get().chrome_trace()["traceEvents"]
+    assert event["args"]["lowering"] == "pipeline"
+
+
+# --------------------------------------------------------------------- #
+# metrics registry
+# --------------------------------------------------------------------- #
+def test_metrics_registry_flush(tmp_path):
+    telemetry.counter("a/count").inc()
+    telemetry.counter("a/count").inc(2)
+    telemetry.gauge("a/gauge").set(2.5)
+    for v in (1.0, 2.0, 3.0, 4.0, 5.0):
+        telemetry.histogram("a/hist").observe(v)
+    paths = telemetry.flush(str(tmp_path))
+    with open(paths["metrics"]) as f:
+        recs = [json.loads(line) for line in f]
+    by_name = {r["name"]: r for r in recs if "name" in r}
+    assert by_name["a/count"]["kind"] == "counter"
+    assert by_name["a/count"]["value"] == 3
+    assert by_name["a/gauge"]["value"] == 2.5
+    hist = by_name["a/hist"]
+    assert hist["count"] == 5 and hist["p50"] == 3.0 and hist["mean"] == 3.0
+
+
+def test_metric_kind_conflict_rejected():
+    telemetry.counter("x")
+    with pytest.raises(TypeError):
+        telemetry.gauge("x")
+
+
+# --------------------------------------------------------------------- #
+# per-step records + sampling + manifest
+# --------------------------------------------------------------------- #
+def test_step_records_and_sampling(tmp_path):
+    telemetry.configure(sample=2)
+    for i in range(10):
+        telemetry.record_step(step=i, duration_s=0.001 * (i + 1),
+                              examples=32)
+    recs = telemetry.get().step_records()
+    assert len(recs) == 5  # every 2nd kept
+    assert [r["step"] for r in recs] == [0, 2, 4, 6, 8]
+    assert all(r["kind"] == "step" and r["examples"] == 32 for r in recs)
+    paths = telemetry.flush(str(tmp_path))
+    with open(paths["manifest"]) as f:
+        manifest = json.load(f)
+    assert manifest["kind"] == "manifest"
+    assert manifest["telemetry"]["steps_seen"] == 10
+    assert manifest["telemetry"]["step_records"] == 5
+    # provenance rides every manifest: this repo's HEAD sha
+    assert len(manifest["provenance"]["git_sha"]) == 40
+    assert manifest["provenance"]["jax"] == jax.__version__
+
+
+# --------------------------------------------------------------------- #
+# disabled path: no files, no wrapper objects
+# --------------------------------------------------------------------- #
+def test_disabled_no_files_no_wrappers(tmp_path):
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv("AUTODIST_TPU_TELEMETRY", "0")
+        telemetry.reset()
+        assert not telemetry.enabled()
+        # span() and the instruments return the SAME shared no-op
+        # singletons — the disabled path allocates nothing per call
+        assert telemetry.span("a") is telemetry.span("b")
+        assert telemetry.span("a") is telemetry.NULL_SPAN
+        assert telemetry.counter("c") is telemetry.NULL_INSTRUMENT
+        assert telemetry.histogram("h") is telemetry.NULL_INSTRUMENT
+        with telemetry.span("region"):
+            telemetry.counter("c").inc()
+        assert telemetry.record_step(step=0, duration_s=0.1) is False
+        # flush writes nothing, even with an explicit directory
+        assert telemetry.flush(str(tmp_path)) == {}
+        assert os.listdir(tmp_path) == []
+    telemetry.reset()
+    assert telemetry.enabled()
+
+
+@pytest.mark.parametrize("val", ["0", "false", "FALSE", "no", "off"])
+def test_disabled_env_spellings(val):
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv("AUTODIST_TPU_TELEMETRY", val)
+        assert not telemetry.reset().enabled
+    telemetry.reset()
+
+
+# --------------------------------------------------------------------- #
+# instrumented real paths
+# --------------------------------------------------------------------- #
+def test_runner_run_summary_and_records():
+    runner = AutoDist({}, AllReduce()).build(make_trainable())
+    runner.run([source(i) for i in range(4)], num_steps=4)
+    s = runner.summary()
+    assert s["steps"] == 4
+    assert s["mean_ms"] > 0 and s["p50_ms"] > 0 and s["p99_ms"] >= s["p50_ms"]
+    assert s["examples_per_sec"] > 0
+    recs = telemetry.get().step_records()
+    assert len(recs) == 4
+    assert all(r["examples"] == 16 for r in recs)
+    assert telemetry.counter("runner/steps").value == 4
+
+
+def test_fit_step_records_match_steps_run():
+    runner = AutoDist({}, AllReduce()).build(make_trainable())
+    fit(runner, source, steps=5, log_every=0)
+    assert runner.step_count == 5
+    recs = telemetry.get().step_records()
+    assert sum(r.get("steps", 1) for r in recs) == 5
+    # the build path and fit both left spans
+    names = {e["name"]
+             for e in telemetry.get().chrome_trace()["traceEvents"]}
+    assert {"autodist/build", "autodist/lower", "train/fit"} <= names
+
+
+def test_fit_fused_records_cover_every_step():
+    runner = AutoDist({}, AllReduce()).build(make_trainable())
+    fit(runner, source, steps=6, log_every=0, steps_per_loop=4)
+    assert runner.step_count == 6
+    recs = telemetry.get().step_records()
+    assert sum(r.get("steps", 1) for r in recs) == 6
+
+
+# --------------------------------------------------------------------- #
+# drift report
+# --------------------------------------------------------------------- #
+def test_drift_report_synthetic_pair(tmp_path):
+    from autodist_tpu.simulator.cost_model import StrategyCost
+
+    predicted = StrategyCost(comm_bytes=1e6, comm_time_s=0.002,
+                             num_collectives=4, mem_bytes_per_device=1e9,
+                             feasible=True, overlap_time_s=0.0005)
+    measured = {"step": {"p50_ms": 4.0, "p99_ms": 5.0, "steps": 10},
+                "memory": {"bytes_in_use": 2_000_000_000}}
+    report = telemetry.drift_report(predicted=predicted, measured=measured,
+                                    out_dir=str(tmp_path))
+    assert report["ratios"]["step_time"] == pytest.approx(2.0)
+    assert report["ratios"]["memory"] == pytest.approx(2.0)
+    # per-term split: blocking comm vs exposed overlap
+    assert report["predicted"]["comm_time_s"] == pytest.approx(0.0015)
+    assert report["predicted"]["exposed_overlap_s"] == pytest.approx(0.0005)
+    assert report["predicted"]["comm_only"] is True
+    assert report["measured"]["mem_bytes_per_device"] == 2_000_000_000
+    assert report["measured"]["memory_source"] == "device_bytes_in_use"
+    with open(os.path.join(tmp_path, "drift.json")) as f:
+        assert json.load(f)["kind"] == "drift"
+
+
+def test_drift_report_real_strategy_proposes_link_constants():
+    trainable = make_trainable()
+    rs = ResourceSpec({})
+    strategy = AllReduce().build(trainable, rs)
+    from autodist_tpu.simulator.cost_model import CostModel
+
+    cm = CostModel(rs)
+    # measured far slower than the analytic prediction -> the report
+    # proposes a lower effective ici_gbps for calibration.json
+    report = telemetry.drift_report(
+        strategy, cm, {"step": {"p50_ms": 10.0, "steps": 8}},
+        trainable=trainable)
+    assert report["strategy"]["id"] == strategy.id
+    assert report["ratios"]["step_time"] > 1.0
+    proposal = report["proposal"]
+    assert proposal and "link" in proposal
+    assert 0 < proposal["link"]["ici_gbps"] < cm.chip.ici_gbps
+    # memory join falls back to host RSS on a CPU mesh, flagged as such
+    assert report["measured"]["memory_source"] == "host_rss_peak"
+    # ratio gauges land in the registry for the JSONL sink
+    assert telemetry.gauge("drift/step_time_ratio").value \
+        == pytest.approx(report["ratios"]["step_time"])
+
+
+def test_drift_report_requires_inputs():
+    with pytest.raises(ValueError):
+        telemetry.drift_report(measured={"step": {"p50_ms": 1.0}})
+
+
+# --------------------------------------------------------------------- #
+# logging satellites
+# --------------------------------------------------------------------- #
+def test_set_verbosity_reaches_handlers():
+    from autodist_tpu.utils import logging as adlog
+
+    logger = adlog.get_logger()
+    try:
+        for h in logger.handlers:
+            h.setLevel(py_logging.ERROR)
+        adlog.set_verbosity(py_logging.DEBUG)
+        assert logger.level == py_logging.DEBUG
+        assert all(h.level == py_logging.DEBUG for h in logger.handlers)
+    finally:
+        adlog.set_verbosity(py_logging.INFO)
+
+
+def test_log_file_name_is_per_run():
+    from autodist_tpu.utils import logging as adlog
+
+    logger = adlog.get_logger()
+    file_handlers = [h for h in logger.handlers
+                     if isinstance(h, py_logging.FileHandler)]
+    if not file_handlers:  # read-only fs: console-only logging
+        pytest.skip("no file handler on this fs")
+    base = os.path.basename(file_handlers[0].baseFilename)
+    # <pid>-<timestamp>.log: concurrent workers cannot collide on the
+    # same epoch-second name
+    assert base.startswith(f"{os.getpid()}-")
+
+
+# --------------------------------------------------------------------- #
+# acceptance: pipeline_train --telemetry-dir + report tool (CI smoke)
+# --------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def pipeline_run(tmp_path_factory):
+    out = tmp_path_factory.mktemp("pp_telemetry")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "PYTHONPATH": REPO,
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples/pipeline_train.py"),
+         "--steps", "6", "--stages", "2", "--hidden", "16", "--batch", "8",
+         "--microbatches", "2", "--telemetry-dir", str(out)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    return out
+
+
+def test_pipeline_train_telemetry_acceptance(pipeline_run):
+    out = pipeline_run
+    # chrome trace with the build-path spans
+    with open(out / "trace.json") as f:
+        trace = json.load(f)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"autodist/build_or_load_strategy", "autodist/build",
+            "autodist/lower"} <= names
+    assert all(e["ph"] == "X" for e in trace["traceEvents"])
+    # metrics JSONL with one record per step
+    with open(out / "metrics.jsonl") as f:
+        recs = [json.loads(line) for line in f]
+    steps = [r for r in recs if r["kind"] == "step"]
+    assert len(steps) == 6
+    assert all(r["duration_ms"] > 0 and r["examples"] == 8 for r in steps)
+    counters = {r["name"]: r["value"] for r in recs
+                if r["kind"] == "counter"}
+    assert counters.get("runner/steps") == 6
+    # run manifest: provenance + the run's parallelism config
+    with open(out / "manifest.json") as f:
+        manifest = json.load(f)
+    assert manifest["provenance"]["git_sha"]
+    assert manifest["run"]["microbatches"] == 2
+    assert manifest["run"]["step_summary"]["p50_ms"] > 0
+    # drift report: the predicted-vs-measured join covers step time AND
+    # memory
+    with open(out / "drift.json") as f:
+        drift = json.load(f)
+    assert drift["kind"] == "drift"
+    assert drift["strategy"]["lowering"] == "pipeline"
+    assert "step_time" in drift["ratios"] and "memory" in drift["ratios"]
+    assert drift["predicted"]["mem_bytes_per_device"] > 0
+    assert drift["measured"]["step_time_s"] > 0
+
+
+def test_telemetry_report_tool_renders_and_checks(pipeline_run):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+    # schema smoke (the CI gate): a valid run passes --check
+    assert telemetry_report.main([str(pipeline_run), "--check"]) == 0
+    md = telemetry_report.render(str(pipeline_run))
+    assert "## steps" in md and "p50" in md
+    assert "## drift (measured / predicted)" in md
+    assert "git:" in md
+
+
+def test_telemetry_report_tool_fails_on_schema_break(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+    with open(tmp_path / "metrics.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "step"}) + "\n")       # missing fields
+        f.write(json.dumps({"kind": "wat", "x": 1}) + "\n")  # unknown kind
+    assert telemetry_report.main([str(tmp_path), "--check"]) == 2

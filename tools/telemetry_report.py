@@ -1,0 +1,218 @@
+"""Render a telemetry run directory as a markdown report.
+
+The consumer side of :mod:`autodist_tpu.telemetry`: given the directory
+a run flushed (``metrics.jsonl`` + ``manifest.json`` + ``trace.json`` +
+optional ``drift.json``), print a markdown summary — step-time p50/p99,
+examples/sec, MFU when recorded, counter/gauge values, and the
+predicted-vs-measured drift ratios.  ``--check`` validates the artifact
+schema and exits non-zero on a break, so a tier-1 smoke invocation turns
+a silent schema drift into a CI failure::
+
+    python tools/telemetry_report.py /tmp/run1
+    python tools/telemetry_report.py /tmp/run1 --check
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_STEP_KEYS = {"kind", "step", "duration_ms"}
+_KINDS = ("step", "counter", "gauge", "histogram")
+
+
+def load_jsonl(path: str) -> list[dict]:
+    records = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise ValueError(f"{path}:{i + 1}: not JSON ({e})")
+            if not isinstance(rec, dict):
+                raise ValueError(f"{path}:{i + 1}: not an object")
+            records.append(rec)
+    return records
+
+
+def check_schema(run_dir: str) -> list[str]:
+    """Schema violations across the run's artifacts ([] = clean)."""
+    problems = []
+    jsonl = os.path.join(run_dir, "metrics.jsonl")
+    if not os.path.exists(jsonl):
+        return [f"missing {jsonl}"]
+    try:
+        records = load_jsonl(jsonl)
+    except ValueError as e:
+        return [str(e)]
+    for i, rec in enumerate(records):
+        kind = rec.get("kind")
+        if kind not in _KINDS:
+            problems.append(f"metrics.jsonl:{i + 1}: unknown kind {kind!r}")
+        elif kind == "step":
+            missing = _STEP_KEYS - set(rec)
+            if missing:
+                problems.append(
+                    f"metrics.jsonl:{i + 1}: step record missing "
+                    f"{sorted(missing)}")
+        elif "name" not in rec:
+            problems.append(f"metrics.jsonl:{i + 1}: {kind} without name")
+        elif kind == "histogram" and "count" not in rec:
+            problems.append(f"metrics.jsonl:{i + 1}: histogram without count")
+
+    trace = os.path.join(run_dir, "trace.json")
+    if os.path.exists(trace):
+        try:
+            with open(trace) as f:
+                data = json.load(f)
+            events = data["traceEvents"]
+            for j, ev in enumerate(events):
+                if not {"name", "ph", "ts"} <= set(ev):
+                    problems.append(f"trace.json: event {j} malformed")
+                    break
+        except (ValueError, KeyError, TypeError) as e:
+            problems.append(f"trace.json: invalid chrome trace ({e})")
+
+    manifest = os.path.join(run_dir, "manifest.json")
+    if os.path.exists(manifest):
+        try:
+            with open(manifest) as f:
+                m = json.load(f)
+            if m.get("kind") != "manifest" or "provenance" not in m:
+                problems.append("manifest.json: kind/provenance missing")
+        except ValueError as e:
+            problems.append(f"manifest.json: invalid ({e})")
+
+    drift = os.path.join(run_dir, "drift.json")
+    if os.path.exists(drift):
+        try:
+            with open(drift) as f:
+                d = json.load(f)
+            if d.get("kind") != "drift" or not isinstance(
+                    d.get("ratios"), dict):
+                problems.append("drift.json: kind/ratios missing")
+        except ValueError as e:
+            problems.append(f"drift.json: invalid ({e})")
+    return problems
+
+
+def _fmt(v, nd=3) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:.{nd}g}" if abs(v) < 1e4 else f"{v:.3e}"
+    return str(v)
+
+
+def render(run_dir: str) -> str:
+    """The markdown report for one flushed run directory."""
+    records = load_jsonl(os.path.join(run_dir, "metrics.jsonl"))
+    steps = [r for r in records if r.get("kind") == "step"]
+    counters = [r for r in records if r.get("kind") == "counter"]
+    gauges = [r for r in records if r.get("kind") == "gauge"]
+    hists = [r for r in records if r.get("kind") == "histogram"]
+
+    lines = [f"# telemetry report — {run_dir}", ""]
+
+    manifest_path = os.path.join(run_dir, "manifest.json")
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        prov = manifest.get("provenance", {})
+        lines += ["## run", "",
+                  f"- git: `{prov.get('git_sha')}`",
+                  f"- jax {prov.get('jax')} / jaxlib {prov.get('jaxlib')}"
+                  f" / python {prov.get('python')}"]
+        run_ann = manifest.get("run", {})
+        for k in sorted(run_ann):
+            lines.append(f"- {k}: `{_fmt(run_ann[k])}`")
+        lines.append("")
+
+    lines += ["## steps", ""]
+    if steps:
+        # A fused-window record covers `steps` optimizer steps; its
+        # per-step latency is duration/steps.
+        per_step_ms = np.asarray([r["duration_ms"] / max(r.get("steps", 1), 1)
+                                  for r in steps])
+        n_steps = sum(r.get("steps", 1) for r in steps)
+        # rate over FULL window durations (a fused record's examples
+        # span its whole duration, not the per-step share)
+        total_s = sum(r["duration_ms"] for r in steps) / 1e3
+        examples = sum(r.get("examples", 0) for r in steps)
+        rate = examples / total_s if total_s > 0 and examples else None
+        lines += ["| records | steps | mean ms | p50 ms | p99 ms | "
+                  "examples/sec |",
+                  "|---|---|---|---|---|---|",
+                  f"| {len(steps)} | {n_steps} "
+                  f"| {_fmt(float(per_step_ms.mean()))} "
+                  f"| {_fmt(float(np.percentile(per_step_ms, 50)))} "
+                  f"| {_fmt(float(np.percentile(per_step_ms, 99)))} "
+                  f"| {_fmt(rate)} |", ""]
+    else:
+        lines += ["(no per-step records)", ""]
+
+    if counters or gauges:
+        lines += ["## counters / gauges", "", "| name | value |", "|---|---|"]
+        for r in counters + gauges:
+            lines.append(f"| {r['name']} | {_fmt(r['value'])} |")
+        lines.append("")
+    if hists:
+        lines += ["## histograms", "",
+                  "| name | n | mean | p50 | p99 |", "|---|---|---|---|---|"]
+        for r in hists:
+            lines.append(f"| {r['name']} | {r['count']} | {_fmt(r['mean'])} "
+                         f"| {_fmt(r['p50'])} | {_fmt(r['p99'])} |")
+        lines.append("")
+
+    drift_path = os.path.join(run_dir, "drift.json")
+    if os.path.exists(drift_path):
+        with open(drift_path) as f:
+            drift = json.load(f)
+        lines += ["## drift (measured / predicted)", "",
+                  "| term | ratio |", "|---|---|"]
+        for k, v in sorted(drift.get("ratios", {}).items()):
+            lines.append(f"| {k} | {_fmt(v)} |")
+        mfu = drift.get("measured", {}).get("mfu")
+        if mfu is not None:
+            lines.append(f"| mfu (measured) | {_fmt(mfu)} |")
+        lines.append("")
+        proposal = drift.get("proposal")
+        if proposal:
+            link = {k: v for k, v in proposal.items() if k != "note"}
+            lines += [f"calibration proposal: `{json.dumps(link)}`",
+                      f"({proposal.get('note')})", ""]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("run_dir", help="directory a telemetry run flushed "
+                                    "(contains metrics.jsonl)")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the artifact schema; non-zero exit on "
+                         "a break (CI smoke)")
+    args = ap.parse_args(argv)
+    if args.check:
+        problems = check_schema(args.run_dir)
+        if problems:
+            for p in problems:
+                print(f"SCHEMA: {p}", file=sys.stderr)
+            return 2
+        print(f"schema OK: {args.run_dir}")
+        return 0
+    try:
+        print(render(args.run_dir))
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
