@@ -55,8 +55,12 @@ def sampled_softmax_loss(rng, weights, biases, hidden, labels,
     neg_logit = jnp.where(hit, jnp.finfo(jnp.float32).min, neg_logit)
 
     logits = jnp.concatenate([true_logit[:, None], neg_logit], axis=1)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    return -logp[:, 0].mean()
+    # The true label sits in column 0 of the sampled-logit matrix; the
+    # nll math is the shared replicated loss head (models/losses.py).
+    from autodist_tpu.models.losses import cross_entropy_from_logits
+
+    labels0 = jnp.zeros(logits.shape[0], jnp.int32)
+    return cross_entropy_from_logits(logits, labels0).mean()
 
 
 class LSTMWordLM(nn.Module):

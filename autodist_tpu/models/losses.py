@@ -1,0 +1,27 @@
+"""Shared loss-head math for the LM families.
+
+``models/pipeline_lm.py`` and ``models/lm1b.py`` each hand-rolled the
+same ``log_softmax`` → gather → mean cross-entropy; this module is the
+single replicated-path implementation both call — and the reference the
+vocab-parallel streaming epilogue
+(:func:`autodist_tpu.parallel.tensor.vocab_parallel_cross_entropy`)
+goldens against: same math, the sharded variant differs only by float
+summation order.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_from_logits(logits, targets):
+    """Per-position negative log-likelihood of ``targets`` under
+    ``logits``.
+
+    ``logits``: ``[..., V]`` (promoted to fp32 for the softmax —
+    full-vocab log-softmax in bf16 loses the tail); ``targets``:
+    integer ids shaped like ``logits[..., 0]``.  Returns fp32 nll of
+    ``targets.shape``; reduce (mean/sum/mask) at the call site.
+    """
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]

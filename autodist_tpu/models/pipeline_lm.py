@@ -119,10 +119,21 @@ def make_pipeline_lm_trainable(cfg: TransformerConfig, optimizer, rng, *,
         "ln_final_bias": jnp.zeros((H,), jnp.float32),
     }
 
-    def prologue(shared, batch):
+    def prologue(shared, batch, model_axis=None, comm_overlap=None):
+        """Token + position embedding.  Under ``Pipeline(vocab_parallel=
+        True)`` the lowering passes ``model_axis`` and ``shared
+        ["embedding"]`` is the local vocab shard: the lookup becomes the
+        masked shard gather + model-axis psum of
+        :func:`~autodist_tpu.parallel.tensor.vocab_parallel_embedding`
+        (exactly equal to the replicated lookup — one shard contributes
+        the row, the rest zeros)."""
+        from autodist_tpu.parallel.tensor import vocab_parallel_embedding
+
         tokens = batch["x"]
         L = tokens.shape[1]
-        x = shared["embedding"][tokens].astype(cfg.dtype)
+        x = vocab_parallel_embedding(
+            tokens, shared["embedding"], model_axis=model_axis,
+            comm_overlap=comm_overlap).astype(cfg.dtype)
         return x + shared["pos_embed"][None, :L].astype(cfg.dtype)
 
     def stage_fn(chunk, x, rng_c=None, rows=None, model_axis=None,
@@ -161,15 +172,31 @@ def make_pipeline_lm_trainable(cfg: TransformerConfig, optimizer, rng, *,
 
         return jax.vmap(one_row)(x, keys)
 
-    def loss_head(outputs, batch, shared):
+    def loss_head(outputs, batch, shared, model_axis=None,
+                  comm_overlap=None):
+        """Tied-unembedding softmax cross-entropy.  Replicated path: the
+        shared :func:`~autodist_tpu.models.losses.cross_entropy_from_logits`
+        on full ``[B, L, V]`` logits.  Under ``Pipeline(vocab_parallel=
+        True)`` (``model_axis`` set, ``shared["embedding"]`` the local
+        vocab shard): the streaming fused epilogue — never materializes
+        the full-vocab logits in forward or backward."""
+        from autodist_tpu.models.losses import cross_entropy_from_logits
+        from autodist_tpu.parallel.tensor import vocab_parallel_cross_entropy
+
         x = _layer_norm(outputs, shared["ln_final_scale"],
                         shared["ln_final_bias"])
-        logits = x @ shared["embedding"].T.astype(jnp.float32)
         targets = batch["y"]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        loss = -jnp.mean(ll)
-        acc = jnp.mean(logits.argmax(-1) == targets)
+        if model_axis is None:
+            logits = x @ shared["embedding"].T.astype(jnp.float32)
+            nll = cross_entropy_from_logits(logits, targets)
+            pred = logits.argmax(-1)
+        else:
+            nll, pred = vocab_parallel_cross_entropy(
+                x, shared["embedding"], targets,
+                vocab_size=cfg.vocab_size, model_axis=model_axis,
+                comm_overlap=comm_overlap)
+        loss = jnp.mean(nll)
+        acc = jnp.mean(pred == targets)
         return loss, {"accuracy": acc}
 
     return PipelineTrainable(stage_fn, stacked, loss_head, optimizer,

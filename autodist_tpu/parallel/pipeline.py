@@ -261,6 +261,10 @@ class _PipelineLowered(SimpleLowered):
 
     perm_inv: Any = None
     has_shared: bool = False
+    # Original (pre-padding) shapes of model-sharded shared leaves
+    # (vocab parallelism zero-pads non-divisible vocab dims in storage);
+    # fetch paths slice the padding back off.
+    shared_orig_shapes: Any = None
 
     def unpad_params(self, params):
         if self.perm_inv is None:
@@ -275,8 +279,18 @@ class _PipelineLowered(SimpleLowered):
                 lambda p: np.asarray(jax.device_get(p))[inv], tree)
 
         if self.has_shared:
+            orig = self.shared_orig_shapes or {}
+
+            def unpad_shared(nm, p):
+                arr = np.asarray(jax.device_get(p))
+                shape = orig.get(nm)
+                if shape is not None and tuple(arr.shape) != tuple(shape):
+                    arr = arr[tuple(slice(0, s) for s in shape)]
+                return arr
+
             return {"stages": unperm(params["stages"]),
-                    "shared": jax.device_get(params["shared"])}
+                    "shared": common.tree_from_names(params["shared"],
+                                                     unpad_shared)}
         return unperm(params)
 
 
@@ -293,7 +307,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                     policies=None, stage_rng: bool = False,
                     remat: bool = False, tp_specs=None,
                     model_axis: str = const.MODEL_AXIS,
-                    comm_overlap=None):
+                    comm_overlap=None, shared_specs=None):
     """Shared construction for the direct API and the Strategy-IR entry;
     returns a Lowered-contract container.
 
@@ -358,7 +372,25 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     reduce-scatter + all-gather, ``"matmul"`` the chunked
     collective-matmul ring (see :mod:`autodist_tpu.parallel.tensor`).
     The stage_fn must additionally accept a ``comm_overlap=`` keyword;
-    with ``tp == 1`` the knob is a no-op (no collectives either way)."""
+    with ``tp == 1`` the knob is a no-op (no collectives either way).
+
+    ``shared_specs`` (vocab parallelism — ``Pipeline(vocab_parallel=
+    True)``): per-*shared*-variable tuples of mesh axes, one entry per
+    dim, naming which dims shard over ``model_axis`` (resolved from the
+    shared variables' partitioner specs by :func:`lower_pipeline_ir`).
+    Matched shared leaves are stored sharded (e.g. the tied embedding
+    ``P(model, None)``) with non-divisible dims zero-padded; replicated
+    ``P()`` remains the default for every other shared leaf.  The
+    ``prologue`` and ``loss_head`` then receive local shards and must be
+    vocab-parallel aware — accept ``model_axis=`` and use the
+    :mod:`autodist_tpu.parallel.tensor` vocab primitives (masked-lookup
+    psum; streaming fused cross-entropy).  Shared-grad sync is
+    unchanged: the psum over ``pipe`` composes with model-axis sharding
+    because each (pipe, model) coordinate owns its vocab slice's
+    contribution and the sum runs per model coordinate.  ZeRO-1 on a
+    model-sharded shared variable is rejected here (state already
+    shards with the parameter; ``lower_pipeline_ir`` degrades such
+    requests with a warning before calling)."""
     from autodist_tpu.parallel.tensor import normalize_comm_overlap
 
     n = mesh.shape[pipe_axis]
@@ -366,12 +398,44 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     C = n * V
     policies = policies or {}
     tp_specs = dict(tp_specs or {})
+    shared_specs = dict(shared_specs or {})
     comm_overlap = normalize_comm_overlap(comm_overlap)
     tp = mesh.shape.get(model_axis, 1) if tp_specs else 1
-    if tp_specs and model_axis not in mesh.shape:
+    if (tp_specs or shared_specs) and model_axis not in mesh.shape:
         raise ValueError(
-            f"tp_specs given but the mesh has no {model_axis!r} axis: "
-            f"{dict(mesh.shape)}")
+            f"tp_specs/shared_specs given but the mesh has no "
+            f"{model_axis!r} axis: {dict(mesh.shape)}")
+    if shared_specs and shared_params is None:
+        raise ValueError(
+            "shared_specs shard shared variables but this pipeline has "
+            "no shared_params")
+    vp = mesh.shape.get(model_axis, 1) if shared_specs else 1
+    if vp > 1:
+        import inspect
+        for role, fn in (("prologue", prologue), ("loss_head", loss_head)):
+            if fn is None:
+                continue
+            try:
+                role_sig = inspect.signature(fn).parameters
+            except (TypeError, ValueError):  # partials: trust the caller
+                role_sig = {"model_axis": None, "comm_overlap": None}
+            if "model_axis" not in role_sig:
+                raise ValueError(
+                    f"vocab parallelism needs a vocab-parallel-aware "
+                    f"{role}: it must accept model_axis= and use the "
+                    "autodist_tpu.parallel.tensor vocab primitives")
+            if comm_overlap is not None and "comm_overlap" not in role_sig:
+                raise ValueError(
+                    f"comm_overlap={comm_overlap!r} with vocab "
+                    f"parallelism needs the {role} to accept "
+                    "comm_overlap= and route it to the epilogue psums")
+        import functools
+        vp_kwargs = {"model_axis": model_axis}
+        if comm_overlap is not None:
+            vp_kwargs["comm_overlap"] = comm_overlap
+        if prologue is not None:
+            prologue = functools.partial(prologue, **vp_kwargs)
+        loss_head = functools.partial(loss_head, **vp_kwargs)
     if tp > 1:
         import inspect
         try:
@@ -427,6 +491,14 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         raise ValueError(
             f"tp_specs name non-stage variables {sorted(unknown)} "
             f"(stage variables: {sorted(stage_leaf_names)})")
+    if shared_specs:
+        shared_leaf_names = {f"shared/{nm}" for nm, _ in
+                             common.flatten_with_names(shared_params)}
+        unknown = set(shared_specs) - shared_leaf_names
+        if unknown:
+            raise ValueError(
+                f"shared_specs name non-shared variables {sorted(unknown)} "
+                f"(shared variables: {sorted(shared_leaf_names)})")
 
     def tp_shards(name: str) -> int:
         """Device count the model axis splits one stage leaf over."""
@@ -437,11 +509,35 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         tail = tp_specs.get(name)
         return P(pipe_axis, *tail) if tail else P(pipe_axis)
 
+    def shared_shards(name: str) -> int:
+        """Device count a shared leaf's spec shards it over."""
+        return math.prod(mesh.shape[a] for a in shared_specs.get(name, ())
+                         if a is not None)
+
+    def shared_param_spec(name: str) -> P:
+        spec = shared_specs.get(name)
+        return P(*spec) if spec else P()
+
+    def shared_padded_shape(name: str, shape: tuple) -> tuple:
+        """Stored shape of a shared leaf: each model-sharded dim
+        zero-padded to divide its axis size (vocab % tp != 0)."""
+        spec = shared_specs.get(name)
+        if not spec:
+            return tuple(shape)
+        return tuple(
+            common.padded_flat_size(d, mesh.shape[a]) if a is not None
+            else d for d, a in zip(shape, spec))
+
     stage_specs = common.tree_from_names(
         stacked_params, lambda nm, _: stage_param_spec(full_stage_name(nm)))
     if has_shared:
+        # Per-leaf shared specs from the Strategy IR (vocab parallelism
+        # shards the tied embedding P(model, None)); replicated P()
+        # remains the default.
         p_specs = {"stages": stage_specs,
-                   "shared": jax.tree.map(lambda _: P(), shared_params)}
+                   "shared": common.tree_from_names(
+                       shared_params,
+                       lambda nm, _: shared_param_spec(f"shared/{nm}"))}
         full_params = {"stages": stacked_params, "shared": shared_params}
     else:
         p_specs = stage_specs
@@ -471,22 +567,36 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                 f"{name}: a tensor-parallel sharded variable's optimizer "
                 "state already shards with the parameter; ZeRO-1 on it "
                 "is a no-op request (lower_pipeline_ir degrades it)")
+        if pol.zero_axes and name in shared_specs:
+            raise ValueError(
+                f"{name}: a vocab-sharded shared variable's optimizer "
+                "state already shards with the parameter; ZeRO-1 on it "
+                "is a no-op request (lower_pipeline_ir degrades it)")
 
     leaves_by_name = dict(common.flatten_with_names(full_params))
     # Per-device sizes: stage leaves hold this device's V chunks (1/n of
     # the stack, further 1/tp for model-axis-sharded leaves); shared
-    # leaves replicate in full.
+    # leaves replicate in full — except vocab-sharded ones, which hold
+    # their (padded) 1/tp slice.
     local_sizes = {
         name: (max(int(np.prod(np.shape(leaf))), 1)
                // (n * tp_shards(name))
                if is_stage_var(name)
+               else max(int(np.prod(shared_padded_shape(
+                   name, np.shape(leaf)))), 1) // shared_shards(name)
+               if name in shared_specs
                else max(int(np.prod(np.shape(leaf))), 1))
         for name, leaf in leaves_by_name.items()}
 
     def u_shape(name) -> tuple:
         pol = zero_pol(name)
         if pol is None:
-            return tuple(np.shape(leaves_by_name[name]))
+            shape = tuple(np.shape(leaves_by_name[name]))
+            if name in shared_specs:
+                # opt state is initialized from (and shards like) the
+                # padded stored leaf
+                shape = shared_padded_shape(name, shape)
+            return shape
         padded = common.padded_flat_size(local_sizes[name], zero_count(pol))
         return (n * padded,) if is_stage_var(name) else (padded,)
 
@@ -534,6 +644,9 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                 # Optimizer state of a tensor-parallel sharded stage
                 # variable shards exactly like the parameter.
                 return stage_param_spec(var)
+            if var is not None and var in shared_specs:
+                # Same rule for a vocab-sharded shared variable.
+                return shared_param_spec(var)
             in_shared = has_shared and any(
                 isinstance(k, jax.tree_util.DictKey) and k.key == "shared"
                 for k in path)
@@ -569,11 +682,24 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                                    state_specs,
                                    is_leaf=lambda x: isinstance(x, P))
 
+    def _pad_shared(name: str, leaf):
+        """Storage form of one shared leaf: model-sharded dims zero-
+        padded to divisibility (padded rows carry zero params and zero
+        grads, so the optimizer keeps them at zero; ``unpad_params``
+        slices them back off)."""
+        arr = jnp.asarray(leaf)
+        target = shared_padded_shape(name, arr.shape)
+        for dim, t in enumerate(target):
+            arr = common.pad_axis_to(arr, dim, t)
+        return arr
+
     def _permute(params):
         if has_shared:
             return {"stages": jax.tree.map(
                 lambda p: jnp.asarray(p)[perm], params["stages"]),
-                "shared": jax.tree.map(jnp.asarray, params["shared"])}
+                "shared": common.tree_from_names(
+                    params["shared"],
+                    lambda nm, p: _pad_shared(f"shared/{nm}", p))}
         return jax.tree.map(lambda p: jnp.asarray(p)[perm], params)
 
     def _init(params, extra=None):
@@ -777,11 +903,18 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
 
     eval_fn = jax.jit(_eval)
 
+    shared_orig_shapes = None
+    if has_shared and shared_specs:
+        shared_orig_shapes = {
+            nm: tuple(np.shape(leaf)) for nm, leaf in
+            common.flatten_with_names(shared_params)
+            if f"shared/{nm}" in shared_specs}
     return _PipelineLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
                             state_specs=state_specs,
                             state_shardings=state_shardings,
                             batch_spec=batch_spec, eval_fn=eval_fn,
-                            perm_inv=perm_inv, has_shared=has_shared)
+                            perm_inv=perm_inv, has_shared=has_shared,
+                            shared_orig_shapes=shared_orig_shapes)
 
 
 def lower_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
@@ -839,14 +972,22 @@ def lower_pipeline_ir(trainable, strategy, mesh):
             f"strategy declares tensor_parallel={tp_cfg}; mesh "
             f"{const.MODEL_AXIS!r} axis has {tp_mesh} devices")
     tp_specs = {}
+    shared_specs = {}
     for nc in strategy.node_configs:
         part = nc.partitioner
-        if part is not None and part.spec \
+        is_stage = not trainable.has_shared \
+            or nc.var_name.startswith("stages/")
+        if is_stage and part is not None and part.spec \
                 and const.MODEL_AXIS in part.spec[1:]:
             tp_specs[nc.var_name] = tuple(part.spec[1:])
-    if tp_specs and tp_mesh == 1:
+        elif not is_stage and part is not None and part.spec \
+                and const.MODEL_AXIS in part.spec:
+            # Vocab parallelism: a *shared* variable (the tied
+            # embedding/unembedding) sharded over the model axis.
+            shared_specs[nc.var_name] = tuple(part.spec)
+    if (tp_specs or shared_specs) and tp_mesh == 1:
         raise ValueError(
-            "strategy shards stage variables over the model axis but the "
+            "strategy shards variables over the model axis but the "
             f"mesh has none: {dict(mesh.shape)}")
     # Latency-hiding collectives: the graph-level knob drives the stage_fn
     # (one mode for the whole stage body); the per-variable partitioner
@@ -885,7 +1026,7 @@ def lower_pipeline_ir(trainable, strategy, mesh):
 
     policies = policies_from_node_configs(
         strategy, mesh, replicated_axes=shared_axes, axes_for=axes_for,
-        sharded_vars=tp_specs)
+        sharded_vars=set(tp_specs) | set(shared_specs))
     if not d_axes:
         dropped = sorted(nm for nm, p in policies.items()
                          if p.compressor != "none")
@@ -905,4 +1046,5 @@ def lower_pipeline_ir(trainable, strategy, mesh):
         virtual_stages=V, stage_aux=trainable.stage_aux,
         policies=policies, stage_rng=trainable.stage_rng,
         remat=bool(cfg.parallel.get("remat", False)),
-        tp_specs=tp_specs, comm_overlap=overlap)
+        tp_specs=tp_specs, comm_overlap=overlap,
+        shared_specs=shared_specs)

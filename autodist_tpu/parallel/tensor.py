@@ -251,6 +251,185 @@ def _collective_matmul_bwd(model_axis, axes, res, ct):
 collective_matmul_row.defvjp(_collective_matmul_fwd, _collective_matmul_bwd)
 
 
+# --------------------------------------------------------------------------- #
+# Vocab parallelism: sharded embedding lookup + the streaming fused
+# cross-entropy epilogue
+# --------------------------------------------------------------------------- #
+def vocab_pad(vocab_size: int, tp: int) -> int:
+    """Rows of zero-padding that make ``vocab_size`` divide ``tp``."""
+    return (-vocab_size) % max(tp, 1)
+
+
+def vocab_parallel_embedding(tokens, embedding, *, model_axis=None,
+                             comm_overlap=None):
+    """Token lookup on a vocab-sharded (dim 0) embedding table.
+
+    With ``model_axis`` set, ``embedding`` is the *local* ``[V_pad/tp, H]``
+    shard (zero-padded rows at the tail of the last shard when the true
+    vocab doesn't divide).  Each shard contributes its rows' vectors
+    (zeros for out-of-shard tokens) and one psum over the model group
+    assembles the full lookup — the Megatron/GSPMD vocab-parallel input
+    embedding (arxiv 1909.08053 §3, 2105.04663).  The psum wears the
+    :func:`sum_partials` custom-VJP contract (identity backward), so the
+    backward is the purely local masked scatter into this shard's rows —
+    no model-axis collective and never a full-vocab buffer.
+    ``comm_overlap`` (any mode) decomposes the forward psum into the
+    rs+ag pair.  ``model_axis=None`` is the exact unsharded lookup.
+    """
+    if model_axis is None:
+        return embedding[tokens]
+    rows = embedding.shape[0]
+    start = lax.axis_index(model_axis) * rows
+    local = tokens - start
+    in_shard = (local >= 0) & (local < rows)
+    safe = jnp.clip(local, 0, rows - 1)
+    out = embedding[safe] * in_shard[..., None].astype(embedding.dtype)
+    overlap = normalize_comm_overlap(comm_overlap)
+    return (sum_partials_decomposed(out, model_axis) if overlap
+            else sum_partials(out, model_axis))
+
+
+def _resolve_seq_chunk(length: int, seq_chunk) -> int:
+    """Largest divisor of ``length`` that is <= the requested chunk
+    (default 128): ``lax.scan`` needs equal chunks, and a divisor keeps
+    the streaming epilogue padding-free along the sequence."""
+    want = max(min(length, seq_chunk or 128), 1)
+    for c in range(want, 0, -1):
+        if length % c == 0:
+            return c
+    return length
+
+
+def vocab_parallel_cross_entropy(x, embedding, targets, *, vocab_size: int,
+                                 model_axis=None, seq_chunk=None,
+                                 comm_overlap=None):
+    """Streaming fused softmax cross-entropy against a vocab-sharded
+    (tied) unembedding — the GSPMD-style epilogue (arxiv 2105.04663).
+
+    ``x``: ``[B, L, H]`` final hidden states (fp32 math recommended);
+    ``embedding``: the local ``[V_pad/tp, H]`` shard (full ``[V, H]``
+    table when ``model_axis`` is ``None``); ``targets``: ``[B, L]`` int
+    ids ``< vocab_size``.  Returns ``(nll, pred)``: per-token negative
+    log-likelihood ``[B, L]`` fp32 and the argmax token id ``[B, L]``
+    int32 (ties resolve to the smallest id, matching ``argmax``).
+
+    Neither forward nor backward ever materializes the full-vocab
+    logits: per sequence chunk the local ``[B, chunk, V/tp]`` logits are
+    reduced to three token-shaped statistics — shard max → ``pmax``,
+    shard sum-exp → psum, target-logit extraction by in-shard mask →
+    psum — and the backward *recomputes* the chunk logits from the saved
+    ``(x, shard, logsumexp)`` residuals, so the live buffer is bounded
+    by ``chunk × V/tp`` in both directions.  Zero-padded vocab rows are
+    masked to ``-inf`` so they never enter max/sum-exp/argmax.  The
+    backward's hidden-state cotangent (each shard holds only its slice's
+    contribution) psums over the model group; ``comm_overlap`` (any
+    mode) lowers that psum — and the forward's two scalar-sized sum
+    psums — as the rs+ag pair with the re-fusion barrier
+    (:func:`psum_decomposed`).  ``model_axis=None`` runs the same
+    streaming math on the full table with zero collectives (the
+    sequential-reference path the parity goldens compare against).
+    """
+    overlap = normalize_comm_overlap(comm_overlap)
+    B, L = targets.shape[0], targets.shape[1]
+    chunk = _resolve_seq_chunk(L, seq_chunk)
+    n_chunks = L // chunk
+    rows = embedding.shape[0]
+    neg_inf = jnp.finfo(jnp.float32).min
+
+    def _psum(v):
+        if model_axis is None:
+            return v
+        return (psum_decomposed(v, model_axis) if overlap
+                else lax.psum(v, model_axis))
+
+    def shard_start():
+        if model_axis is None:
+            return 0
+        return lax.axis_index(model_axis) * rows
+
+    def chunk_logits(xc, emb):
+        """Local ``[B, chunk, V/tp]`` logits, padded rows at -inf."""
+        logits = jnp.tensordot(xc.astype(jnp.float32),
+                               emb.astype(jnp.float32).T, axes=1)
+        valid = (shard_start() + jnp.arange(rows)) < vocab_size
+        return jnp.where(valid, logits, neg_inf)
+
+    def to_chunks(a):
+        # [B, L, ...] -> [n_chunks, B, chunk, ...] for the scan
+        a = a.reshape(B, n_chunks, chunk, *a.shape[2:])
+        return jnp.moveaxis(a, 1, 0)
+
+    def from_chunks(a):
+        return jnp.moveaxis(a, 0, 1).reshape(B, L, *a.shape[3:])
+
+    def fwd_impl(x, emb):
+        start = shard_start()
+
+        def body(_, args):
+            xc, tc = args
+            logits = chunk_logits(xc, emb)
+            m_loc = jnp.max(logits, axis=-1)
+            m = m_loc if model_axis is None else lax.pmax(m_loc, model_axis)
+            s = _psum(jnp.sum(jnp.exp(logits - m[..., None]), axis=-1))
+            loc = tc - start
+            in_shard = (loc >= 0) & (loc < rows)
+            safe = jnp.clip(loc, 0, rows - 1)
+            tgt_loc = jnp.take_along_axis(logits, safe[..., None],
+                                          axis=-1)[..., 0]
+            tgt = _psum(jnp.where(in_shard, tgt_loc, 0.0))
+            lse = m + jnp.log(s)
+            # argmax: the shard holding the global max proposes its id;
+            # losers propose vocab_size, pmin keeps the smallest winner.
+            am = start + jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            cand = jnp.where(m_loc >= m, am, jnp.int32(vocab_size))
+            pred = cand if model_axis is None else lax.pmin(cand, model_axis)
+            return None, (lse - tgt, pred, lse)
+
+        _, (nll, pred, lse) = lax.scan(
+            body, None, (to_chunks(x), to_chunks(targets)))
+        return from_chunks(nll), from_chunks(pred), from_chunks(lse)
+
+    @jax.custom_vjp
+    def xent(x, emb):
+        nll, pred, _ = fwd_impl(x, emb)
+        return nll, pred
+
+    def xent_fwd(x, emb):
+        nll, pred, lse = fwd_impl(x, emb)
+        return (nll, pred), (x, emb, lse)
+
+    def xent_bwd(res, cts):
+        x, emb, lse = res
+        ct_nll = cts[0].astype(jnp.float32)  # ct for pred is symbolic zero
+        start = shard_start()
+
+        def body(dW, args):
+            xc, tc, lse_c, ct_c = args
+            logits = chunk_logits(xc, emb)
+            p = jnp.exp(logits - lse_c[..., None])   # padded rows -> 0
+            loc = tc - start
+            in_shard = (loc >= 0) & (loc < rows)
+            safe = jnp.clip(loc, 0, rows - 1)
+            onehot = (jnp.arange(rows) == safe[..., None]) \
+                & in_shard[..., None]
+            g = (p - onehot.astype(jnp.float32)) * ct_c[..., None]
+            dx_c = jnp.tensordot(g, emb.astype(jnp.float32), axes=1)
+            dW = dW + jnp.tensordot(
+                g.reshape(-1, rows).T,
+                xc.astype(jnp.float32).reshape(-1, xc.shape[-1]), axes=1)
+            return dW, dx_c
+
+        dW0 = jnp.zeros((rows, emb.shape[1]), jnp.float32)
+        dW, dx = lax.scan(
+            body, dW0, (to_chunks(x), to_chunks(targets), to_chunks(lse),
+                        to_chunks(ct_nll)))
+        dx = _psum(from_chunks(dx))
+        return dx.astype(x.dtype), dW.astype(emb.dtype)
+
+    xent.defvjp(xent_fwd, xent_bwd)
+    return xent(x, embedding)
+
+
 def column_parallel(x, kernel, bias=None, *, model_axis=None, axes: int = 1,
                     comm_overlap=None):
     """``x @ kernel (+ bias)`` with the kernel's *output* dims sharded.

@@ -63,6 +63,17 @@ def default_candidates() -> list[StrategyBuilder]:
         # profile and wins whenever chunk compute can hide hop latency.
         parallel_builders.Pipeline(num_microbatches=4, tensor_parallel=2,
                                    comm_overlap=True),
+        # Vocab-parallel variant: the shared embedding/unembedding
+        # shards over the model axis and the loss head runs the
+        # streaming fused cross-entropy epilogue — the first candidate
+        # that shrinks *memory* (embedding state, opt moments, and peak
+        # logits all /tp) rather than step time, so the feasibility
+        # gate can elect it when the replicated head's [B,L,V] logits
+        # blow HBM.  Scores only for trainables whose prologue/loss_head
+        # are vocab-parallel aware; otherwise build() raises ValueError
+        # and the candidate is skipped.
+        parallel_builders.Pipeline(num_microbatches=4, tensor_parallel=2,
+                                   vocab_parallel=True),
         parallel_builders.ExpertParallel(),
     ]
 

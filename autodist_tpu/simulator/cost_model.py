@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import re
 from typing import Optional
 
 from autodist_tpu.capture import Trainable
@@ -49,6 +50,12 @@ COMPRESSOR_FACTOR = {
 
 # Activation bytes per element on the wire/in HBM (bf16 activations).
 _ACT_BYTES = 2.0
+
+# The tied-table naming the pipeline vocab rules key on
+# (parallel_builders.PIPELINE_VOCAB_RULES): used to identify the
+# unembedding among replicated shared variables when no partitioner
+# spec marks it.
+_VOCAB_NAME_RE = re.compile(r"(^|/)embedding$")
 
 # Link-pricing constants for the overlap-aware model (the pipeline/TP
 # path): effective per-link bandwidth, per-hop launch latency, and the
@@ -144,6 +151,11 @@ class StrategyCost:
     # included in comm_time_s; broken out so the telemetry drift report
     # can show comm vs exposed-overlap per term.
     overlap_time_s: float = 0.0
+    # Peak loss-head logits buffer (pipeline lowering, priced only with
+    # a tokens hint), already included in mem_bytes_per_device; broken
+    # out because it is the term vocab parallelism divides by tp — the
+    # drift report joins it against measured HBM and telemetry gauges it.
+    peak_logits_bytes: float = 0.0
 
     @property
     def score(self) -> float:
@@ -373,6 +385,7 @@ class CostModel:
         overlap_s = 0.0
         hidden_bytes = 0.0
         extra_colls = 0
+        peak_logits = 0.0
 
         def ring(k: int) -> float:
             return 2.0 * (k - 1) / k if k > 1 else 0.0
@@ -434,6 +447,7 @@ class CostModel:
             overlap_cfg = normalize_comm_overlap(
                 strategy.graph_config.parallel.get("comm_overlap"))
             tokens_local = tokens / max(n_data, 1) if tokens else 0.0
+            emb_var = None      # ((priority, bytes), V, H, vocab shards)
             # V chunks of C = S*V total live per device -> stage
             # params/opt at 1/S, grads sync over the data axis; shared
             # (embedding/unembedding) vars replicate and sync over
@@ -530,11 +544,61 @@ class CostModel:
                             extra_colls += execs * (
                                 (tp + 1 if mode == "matmul" else 2) + 2)
                 else:
+                    # Shared (non-stage) variable.  Vocab parallelism
+                    # (model axis in a shared var's spec) stores the tied
+                    # embedding at 1/tp per device — params, grads, AND
+                    # optimizer state all shrink (ZeRO on it degrades:
+                    # state already shards with the parameter) — and the
+                    # pipe x data grad sync moves 1/tp the bytes.
+                    v_sharded = (part is not None and part.spec
+                                 and const.MODEL_AXIS in part.spec)
+                    vsh = tp if v_sharded else 1
+                    per_dev = bytes_ / vsh
                     n_pd = S * n_data
-                    opt_div = n_pd if node_is_ps(node) else 1
-                    mem += bytes_ * 2.0 + bytes_ * opt_mult / opt_div
-                    comm += ring(n_pd) * bytes_ * node_factor(node)
+                    opt_div = n_pd if (node_is_ps(node)
+                                       and vsh == 1) else 1
+                    mem += per_dev * 2.0 + per_dev * opt_mult / opt_div
+                    comm += ring(n_pd) * per_dev * node_factor(node)
                     colls += 2 if opt_div > 1 else 1
+                    # Track the unembedding for the loss-head epilogue
+                    # pricing below.  Identification priority: a
+                    # model-sharded spec (the strategy SAYS which var is
+                    # the vocab table), then the vocab-rule naming
+                    # (…/embedding — so the replicated baseline of a
+                    # small-vocab long-context model doesn't mistake
+                    # pos_embed for the unembedding), then largest
+                    # rank-2 shared var; bytes break ties within a tier.
+                    if len(info.shape) == 2:
+                        prio = (2 if v_sharded else
+                                1 if _VOCAB_NAME_RE.search(info.name)
+                                else 0)
+                        if emb_var is None or (prio, bytes_) > emb_var[0]:
+                            emb_var = ((prio, bytes_), info.shape[0],
+                                       info.shape[1], vsh)
+            if tokens and emb_var is not None:
+                # Loss-head epilogue: the [tokens_local, V] fp32 logits
+                # buffer dominates HBM as vocab grows; vocab parallelism
+                # bounds it at 1/tp (the streaming chunked epilogue never
+                # materializes more than its local shard), replacing the
+                # replicated [B,L,H]x[H,V] matmul with a sharded one plus
+                # psums: the prologue lookup psum + 3 token-shaped stat
+                # psums (max, sum-exp, target logit) forward, one hidden-
+                # state cotangent psum backward.
+                _, V_dim, width, vsh = emb_var
+                tokens_local = tokens / max(n_data, 1)
+                # 1/vsh is an upper bound for the sharded case: the
+                # streaming epilogue further bounds the live buffer to
+                # [B, chunk, V/tp], but the model only knows tokens
+                # (B x L fused), not the B/L split the chunk bound
+                # needs — so it prices the conservative full-sequence
+                # shard.  Safe direction for the feasibility gate: it
+                # can under-elect vocab parallelism, never over-elect.
+                peak_logits = tokens_local * V_dim * 4.0 / vsh
+                mem += peak_logits
+                if vsh > 1:
+                    comm += ring(tp) * tokens_local \
+                        * (2.0 * width + 3.0) * 4.0
+                    colls += 6
             if tokens:
                 # activation hop per schedule tick (ppermute ring), fwd +
                 # transposed bwd; T = M*V + S - 1 ticks of a microbatch
@@ -604,7 +668,10 @@ class CostModel:
                             mem_bytes_per_device=mem,
                             feasible=mem <= hbm,
                             overlap_time_s=(overlap_s
-                                            if total_devices > 1 else 0.0))
+                                            if total_devices > 1 else 0.0),
+                            peak_logits_bytes=(peak_logits
+                                               if kind == "pipeline"
+                                               else 0.0))
 
     def strategy_cost(self, trainable: Trainable,
                       strategy: Strategy) -> StrategyCost:

@@ -44,6 +44,15 @@ PIPELINE_TP_RULES = tuple(
     (r"(^|/)wi/bias$", [const.MODEL_AXIS]),
 )
 
+# Vocab-parallel rules for the *shared* group (the pipelined
+# transformer's tied embedding/unembedding, excluded from the stage rule
+# table above): dim 0 — the vocab — shards over the model axis.
+# Matched against the shared-variable name minus its ``shared/`` prefix;
+# non-divisible vocab sizes are legal (the lowering zero-pads storage).
+PIPELINE_VOCAB_RULES = (
+    (r"(^|/)embedding$", [const.MODEL_AXIS, None]),
+)
+
 
 def _default_sync(zero1: bool, compressor: str,
                   zero_min_bytes=None):
@@ -147,6 +156,20 @@ class Pipeline(StrategyBuilder):
     does).  With ``tensor_parallel == 1`` the knob is recorded but the
     lowering is collective-free either way (the tp∈{1,2} parity
     goldens rely on that no-op).
+
+    ``vocab_parallel=True`` (with ``tensor_parallel > 1``) additionally
+    shards the *shared* embedding/unembedding's vocab dimension over the
+    model axis (``vocab_rules``, default :data:`PIPELINE_VOCAB_RULES`) —
+    the prologue runs the masked shard-lookup psum and the loss head the
+    streaming fused cross-entropy epilogue of
+    :mod:`autodist_tpu.parallel.tensor`, so embedding state, optimizer
+    moments, and peak logits memory all shrink by ``1/tp`` and no
+    full-vocab buffer is ever materialized (``tools/hlo_probe.py
+    --probe vocab_parallel`` proves it structurally).  The trainable's
+    ``prologue``/``loss_head`` must accept ``model_axis=`` (the bundled
+    pipelined LM does); non-divisible vocab sizes are zero-padded by
+    the lowering.  Like ``comm_overlap``, a no-op at
+    ``tensor_parallel == 1``.
     """
 
     def __init__(self, num_microbatches: int = 1, virtual_stages: int = 1,
@@ -154,7 +177,8 @@ class Pipeline(StrategyBuilder):
                  zero_min_bytes=None, remat: bool = False,
                  tensor_parallel: int = 1,
                  tp_rules: Sequence[tuple[str, list]] = None,
-                 comm_overlap=None):
+                 comm_overlap=None, vocab_parallel: bool = False,
+                 vocab_rules: Sequence[tuple[str, list]] = None):
         if num_microbatches < 1:
             raise ValueError("num_microbatches must be >= 1")
         if virtual_stages < 1:
@@ -176,6 +200,18 @@ class Pipeline(StrategyBuilder):
         self.tp_rules = [(re.compile(pat), list(spec))
                          for pat, spec in (tp_rules if tp_rules is not None
                                            else PIPELINE_TP_RULES)]
+        # Vocab parallelism for the *shared* embedding/unembedding: dim 0
+        # of matching shared variables shards over the model axis, the
+        # prologue runs the masked-lookup psum and the loss head the
+        # streaming fused cross-entropy epilogue (parallel/tensor.py) —
+        # the first knob that shrinks shared-parameter *memory* (state,
+        # opt moments, and peak logits all /tp).  Like comm_overlap, the
+        # knob is recorded but a no-op at tensor_parallel == 1.
+        self.vocab_parallel = bool(vocab_parallel)
+        self.vocab_rules = [(re.compile(pat), list(spec))
+                            for pat, spec in
+                            (vocab_rules if vocab_rules is not None
+                             else PIPELINE_VOCAB_RULES)]
         from autodist_tpu.parallel.tensor import normalize_comm_overlap
         self.comm_overlap = normalize_comm_overlap(comm_overlap)
         self.make_sync = _default_sync(zero1, compressor, zero_min_bytes)
@@ -242,9 +278,39 @@ class Pipeline(StrategyBuilder):
                     "overlap-aware stage_fn: it must accept comm_overlap= "
                     "and route it to its row/column-parallel boundaries "
                     "(autodist_tpu.parallel.tensor primitives)")
+        if tp > 1 and self.vocab_parallel:
+            # Build-time validation (mirrors the comm_overlap check): a
+            # vocab-parallel lowering hands the prologue and loss head
+            # local vocab shards, so both must accept model_axis= —
+            # otherwise AutoStrategy's candidate loop must SKIP this
+            # builder instead of electing it and failing at compile.
+            import inspect
+            if not getattr(trainable, "has_shared", False):
+                raise ValueError(
+                    "vocab_parallel=True shards the shared embedding/"
+                    "unembedding; this trainable declares no shared_params")
+            for role in ("prologue", "loss_head"):
+                fn = getattr(trainable, role, None)
+                try:
+                    sig = inspect.signature(fn).parameters
+                except (TypeError, ValueError):  # partials: trust it
+                    sig = {"model_axis": None}
+                if "model_axis" not in sig:
+                    raise ValueError(
+                        f"vocab_parallel=True needs a vocab-parallel-aware "
+                        f"{role}: it must accept model_axis= and use the "
+                        "autodist_tpu.parallel.tensor vocab primitives "
+                        "(vocab_parallel_embedding / "
+                        "vocab_parallel_cross_entropy)")
+                if self.comm_overlap and "comm_overlap" not in sig:
+                    raise ValueError(
+                        f"comm_overlap={self.comm_overlap!r} with "
+                        f"vocab_parallel=True needs the {role} to accept "
+                        "comm_overlap= and route it to the epilogue psums")
         has_shared = getattr(trainable, "has_shared", False)
         nodes = []
         tp_matched = []
+        vocab_matched = []
         for i in trainable.var_infos():
             node = NodeConfig(var_name=i.name,
                               synchronizer=self.make_sync(i),
@@ -271,7 +337,25 @@ class Pipeline(StrategyBuilder):
                     mesh_axis=const.PIPE_AXIS,
                     spec=[const.PIPE_AXIS] + tail,
                     comm_overlap=overlap)
+            elif self.vocab_parallel and tp > 1:
+                # Shared-group variable: vocab rules shard dim 0 over the
+                # model axis (the lowering zero-pads non-divisible
+                # vocabs); everything else stays replicated — the
+                # per-leaf record parallel/pipeline.py reads instead of
+                # pinning every shared leaf to P().
+                for pat, spec in self.vocab_rules:
+                    if pat.search(i.name) and len(spec) == len(i.shape):
+                        node.partitioner = PartitionerConfig(
+                            mesh_axis=const.MODEL_AXIS, spec=list(spec),
+                            comm_overlap=self.comm_overlap)
+                        vocab_matched.append(i.name)
+                        break
             nodes.append(node)
+        if tp > 1 and self.vocab_parallel and not vocab_matched:
+            raise ValueError(
+                "Pipeline(vocab_parallel=True): no shared variable "
+                "matched the vocab rules; name the tied table "
+                "'embedding' (PIPELINE_VOCAB_RULES) or pass vocab_rules=...")
         if tp > 1 and not tp_matched:
             # ValueError (not a warning): AutoStrategy's candidate loop
             # skips the builder, and a direct user gets told their
@@ -287,7 +371,8 @@ class Pipeline(StrategyBuilder):
                         "virtual_stages": self.virtual_stages,
                         "remat": self.remat,
                         "tensor_parallel": tp,
-                        "comm_overlap": self.comm_overlap}
+                        "comm_overlap": self.comm_overlap,
+                        "vocab_parallel": self.vocab_parallel}
         return Strategy(node_configs=nodes, graph_config=cfg)
 
 

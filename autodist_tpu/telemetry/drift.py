@@ -102,11 +102,14 @@ def drift_report(strategy=None, cost_model=None,
             "num_collectives": predicted.num_collectives,
             "mem_bytes_per_device": predicted.mem_bytes_per_device,
             "feasible": predicted.feasible,
+            "peak_logits_bytes": getattr(predicted, "peak_logits_bytes",
+                                         0.0),
         }
 
     comm_s = float(predicted.get("comm_time_s") or 0.0)
     overlap_s = float(predicted.get("overlap_time_s") or 0.0)
     pred_mem = float(predicted.get("mem_bytes_per_device") or 0.0)
+    pred_logits = float(predicted.get("peak_logits_bytes") or 0.0)
 
     compute_s = None
     wire_s = None
@@ -131,6 +134,11 @@ def drift_report(strategy=None, cost_model=None,
         "compute_time_s": compute_s,
         "comm_only": compute_s is None,
         "mem_bytes_per_device": pred_mem,
+        # Peak loss-head logits buffer — the memory term vocab
+        # parallelism divides by tp; broken out so a hardware window can
+        # attribute an HBM delta between the replicated and
+        # vocab-parallel configs to the logits term specifically.
+        "peak_logits_bytes": pred_logits or None,
         "comm_bytes": predicted.get("comm_bytes"),
         "num_collectives": predicted.get("num_collectives"),
         "feasible": predicted.get("feasible"),
@@ -228,6 +236,8 @@ def drift_report(strategy=None, cost_model=None,
         tel.gauge(f"drift/{name}_ratio").set(value)
     if mfu is not None:
         tel.gauge("drift/mfu").set(mfu)
+    if pred_logits > 0:
+        tel.gauge("memory/peak_logits_bytes").set(pred_logits)
 
     out_dir = out_dir or tel.out_dir
     if out_dir and tel.enabled:

@@ -14,6 +14,14 @@ all-reduce per stage.
     python examples/pipeline_train.py --tensor-parallel 2 --stages 2
     python examples/pipeline_train.py --tensor-parallel 2 --stages 2 \
         --comm-overlap matmul --profile-dir /tmp/pp_trace
+    python examples/pipeline_train.py --tensor-parallel 2 --stages 2 \
+        --vocab-parallel --vocab 512
+
+``--vocab-parallel`` switches the workload to the pipelined
+transformer LM (the MLP has no embedding to shard) and shards its tied
+embedding/unembedding over the ``model`` axis: the prologue runs the
+masked-lookup psum and the loss head the streaming fused cross-entropy
+epilogue, so embedding state and peak logits memory drop by 1/tp.
 """
 import argparse
 import os
@@ -39,6 +47,17 @@ def main():
                          "activation collectives (with --tensor-parallel "
                          "> 1): rsag = reduce-scatter + all-gather pairs, "
                          "matmul = chunked collective-matmul ppermute ring")
+    ap.add_argument("--vocab-parallel", action="store_true",
+                    help="shard the tied embedding/unembedding's vocab "
+                         "dim over the model axis (with --tensor-parallel "
+                         "> 1) and run the streaming fused cross-entropy "
+                         "epilogue; switches the workload to the "
+                         "pipelined transformer LM")
+    ap.add_argument("--vocab", type=int, default=256,
+                    help="LM vocab size (with --vocab-parallel; odd "
+                         "values exercise the zero-pad path)")
+    ap.add_argument("--seq", type=int, default=16,
+                    help="LM sequence length (with --vocab-parallel)")
     ap.add_argument("--zero1", action="store_true",
                     help="ZeRO-1: shard optimizer state over the data "
                          "axes (stage vars) / pipe x data (shared vars)")
@@ -113,12 +132,43 @@ def main():
         loss = jnp.mean((outputs - batch["y"]) ** 2)
         return loss, {}
 
-    trainable = PipelineTrainable(stage, stacked, head, optax.adam(1e-3),
-                                  num_stages=C)
+    if args.vocab_parallel:
+        # Vocab parallelism shards the shared embedding/unembedding —
+        # the MLP has neither, so this mode trains the pipelined
+        # transformer LM (one encoder layer per chunk, tied table).
+        from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+        from autodist_tpu.models.transformer import TransformerConfig
+
+        cfg = TransformerConfig(
+            vocab_size=args.vocab, hidden_size=HID, num_layers=C,
+            num_heads=2, mlp_dim=FF, max_len=args.seq,
+            dtype=jnp.float32, dropout_rate=0.0,
+            attention_dropout_rate=0.0)
+        trainable = make_pipeline_lm_trainable(
+            cfg, optax.adam(1e-3), jax.random.PRNGKey(0))
+        # activation hints so the cost model prices the epilogue
+        # (peak-logits memory, psums) for the drift report below
+        trainable.tokens_per_step = args.batch * args.seq
+        trainable.act_bytes_per_token = float(4 * HID)
+
+        def make_batch():
+            x = r.randint(0, args.vocab,
+                          (args.batch, args.seq)).astype(np.int32)
+            y = np.concatenate([x[:, 1:], x[:, :1]], axis=1)
+            return {"x": x, "y": y}
+    else:
+        trainable = PipelineTrainable(stage, stacked, head,
+                                      optax.adam(1e-3), num_stages=C)
+        target = r.randn(HID, HID).astype(np.float32) * 0.1
+
+        def make_batch():
+            x = r.randn(args.batch, HID).astype(np.float32)
+            return {"x": x, "y": x @ target}
     overlap = None if args.comm_overlap == "off" else args.comm_overlap
     builder = Pipeline(num_microbatches=args.microbatches,
                        virtual_stages=args.virtual_stages,
                        tensor_parallel=tp, comm_overlap=overlap,
+                       vocab_parallel=args.vocab_parallel,
                        zero1=args.zero1, remat=args.remat)
     if args.accum_steps > 1:
         builder = GradAccumulation(builder, steps=args.accum_steps)
@@ -138,9 +188,19 @@ def main():
 
     print(f"pipe={pp} x virtual={args.virtual_stages} "
           f"(C={C} chunks), dp={dp}, tp={tp}, M={args.microbatches}, "
-          f"comm_overlap={overlap}; schedule bubble = "
+          f"comm_overlap={overlap}, vocab_parallel={args.vocab_parallel}; "
+          f"schedule bubble = "
           f"{bubble_fraction(args.microbatches, pp, args.virtual_stages):.3f}")
-    target = r.randn(HID, HID).astype(np.float32) * 0.1
+
+    from autodist_tpu.simulator.cost_model import CostModel
+
+    # Predicted peak-logits buffer (the memory term vocab parallelism
+    # divides by tp) rides every step record + a gauge, so a hardware
+    # window's metrics.jsonl can join it against measured HBM.
+    cost = CostModel(ad.resource_spec).strategy_cost(trainable, strategy)
+    peak_logits = cost.peak_logits_bytes or None
+    if peak_logits:
+        telemetry.get().gauge("memory/peak_logits_bytes").set(peak_logits)
 
     from contextlib import nullcontext
 
@@ -156,8 +216,7 @@ def main():
 
     with trace_cm:
         for step in range(args.steps):
-            x = r.randn(args.batch, HID).astype(np.float32)
-            batch = {"x": x, "y": x @ target}
+            batch = make_batch()
             t_step = time.perf_counter()
             with timer:
                 metrics = runner.step(batch)
@@ -166,22 +225,25 @@ def main():
                     # without a telemetry/profile sink, keep the
                     # dispatch async.
                     jax.block_until_ready(metrics)
+            extra = {"peak_logits_bytes": peak_logits} if peak_logits \
+                else {}
             telemetry.record_step(step=step,
                                   duration_s=time.perf_counter() - t_step,
-                                  examples=args.batch)
+                                  examples=args.batch, **extra)
             if step % 5 == 0 or step == args.steps - 1:
                 print(f"step {step}: "
                       f"loss={float(np.asarray(metrics['loss'])):.5f}")
 
     summary = timer.summary()
     if tel_dir:
-        from autodist_tpu.simulator.cost_model import CostModel
         from autodist_tpu.utils.profiling import memory_summary
 
         telemetry.annotate(mesh=mesh, microbatches=args.microbatches,
                            virtual_stages=args.virtual_stages,
                            comm_overlap=overlap, batch=args.batch,
                            tensor_parallel=tp, zero1=args.zero1,
+                           vocab_parallel=args.vocab_parallel,
+                           peak_logits_bytes=peak_logits,
                            remat=args.remat, step_summary=summary)
         report = telemetry.drift_report(
             strategy, CostModel(ad.resource_spec),

@@ -9,9 +9,10 @@ steps-per-loop scan each fail CI here, on CPU, before any hardware
 window."""
 import json
 
-from tools.hlo_probe import (collective_counts, main, probe_collective_matmul,
-                             probe_pipeline_tp, probe_single_replica,
-                             probe_steps_per_loop)
+from tools.hlo_probe import (buffers_with_dim, collective_counts, main,
+                             probe_collective_matmul, probe_pipeline_tp,
+                             probe_single_replica, probe_steps_per_loop,
+                             probe_vocab_parallel)
 
 
 def test_collective_counts_parses_hlo_idioms():
@@ -68,6 +69,34 @@ def test_collective_matmul_removes_monolithic_all_reduce():
         assert c["reduce-scatter"] >= 1 and c["all-gather"] >= 1, (mode, c)
     assert report["ring_collective_permutes"] >= 1
     assert report["model_axis_all_reduces_removed"] >= 4
+
+
+def test_buffers_with_dim_parses_hlo_shapes():
+    text = """
+  %p0 = f32[8,8,93]{2,1,0} parameter(0)
+  %t = (f32[93,16]{1,0}, s32[8,8]{1,0}) tuple(%a, %b)
+  %c = bf16[47,16]{1,0} convert(f32[47,16]{1,0} %x)
+"""
+    assert buffers_with_dim(text, 93) == 2
+    assert buffers_with_dim(text, 47) == 2
+    assert buffers_with_dim(text, 94) == 0
+
+
+def test_vocab_parallel_materializes_no_full_vocab_buffer():
+    """The vocab-parallel memory claim, structurally: the sharded tp=2
+    program's optimized HLO carries ZERO buffers of the (distinctive)
+    vocab extent — no [B,L,V] logits, no replicated [V,H] table, no
+    vocab-axis all-gather result — while the replicated baseline
+    carries them; a silent re-replication of the loss head fails here,
+    on CPU, before any hardware window."""
+    report = probe_vocab_parallel()
+    assert report["baseline_full_vocab_buffers"] > 0
+    assert report["vocab_parallel_full_vocab_buffers"] == 0
+    # the epilogue's model-axis collectives exist (lookup psum, stat
+    # psums/pmax/pmin, backward hidden-cotangent psum)
+    extra = (report["collectives_vocab_parallel"]["all-reduce"]
+             - report["collectives_baseline"]["all-reduce"])
+    assert extra >= 3, report
 
 
 def test_probe_cli_json_output(tmp_path, capsys):

@@ -252,15 +252,12 @@ def test_pipeline_strategy_serializes():
     ad = AutoDist(PIPE_SPEC, "Pipeline", num_microbatches=2)
     strategy = ad.build_or_load_strategy(make_pipeline_trainable())
     assert strategy.graph_config.lowering == "pipeline"
-    assert strategy.graph_config.parallel == {"num_microbatches": 2,
-                                              "virtual_stages": 1,
-                                              "remat": False,
-                                              "tensor_parallel": 1}
+    expected = {"num_microbatches": 2, "virtual_stages": 1,
+                "remat": False, "tensor_parallel": 1,
+                "comm_overlap": None, "vocab_parallel": False}
+    assert strategy.graph_config.parallel == expected
     clone = Strategy.from_json(strategy.to_json())
-    assert clone.graph_config.parallel == {"num_microbatches": 2,
-                                           "virtual_stages": 1,
-                                           "remat": False,
-                                           "tensor_parallel": 1}
+    assert clone.graph_config.parallel == expected
     # every stage variable is pipe-sharded in the IR
     for n in clone.node_configs:
         assert n.partitioner.spec[0] == "pipe"

@@ -24,6 +24,12 @@ asserts collective *counts and kinds* in the optimized HLO text:
   tp=1 program's — nothing re-fused) while emitting the decomposed
   forms instead: ≥ tp−1 extra ``collective-permute`` (the chunked
   collective-matmul ring) plus ``reduce-scatter``/``all-gather`` pairs.
+* ``probe_vocab_parallel`` — vocab parallelism
+  (``Pipeline(vocab_parallel=True)``): the vocab-sharded tp=2 program
+  contains no full-vocab-sized buffer and no vocab-axis all-gather
+  anywhere (distinctive-dimension shape scan), vs. the replicated
+  baseline which carries the ``[V, H]`` table and ``[.., V]`` logits —
+  a silent re-replication of the loss head fails CI on CPU.
 
 Run as a script for a JSON report::
 
@@ -56,6 +62,11 @@ _COLLECTIVE_RE = re.compile(
     r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
     r"all-to-all)(?:-start)?\(")
 
+# Every typed array shape in HLO text: `f32[8,8,93]{2,1,0}` etc.
+_SHAPE_RE = re.compile(
+    r"\b(?:pred|s4|u4|s8|u8|s16|u16|s32|u32|s64|u64|"
+    r"f8\w*|bf16|f16|f32|f64|c64|c128)\[([0-9,]*)\]")
+
 
 def collective_counts(hlo_text: str) -> dict[str, int]:
     """Count collective ops by kind in optimized HLO text."""
@@ -63,6 +74,20 @@ def collective_counts(hlo_text: str) -> dict[str, int]:
     return {k: counts.get(k, 0)
             for k in ("all-reduce", "all-gather", "reduce-scatter",
                       "collective-permute", "all-to-all")}
+
+
+def buffers_with_dim(hlo_text: str, dim: int) -> int:
+    """Count array shapes carrying ``dim`` in optimized HLO text — the
+    memory-shape analog of :func:`collective_counts`: with a dim chosen
+    to be distinctive (a vocab size no other tensor dimension equals),
+    zero hits proves the program never materializes a buffer of that
+    extent on any device."""
+    hits = 0
+    for m in _SHAPE_RE.finditer(hlo_text):
+        dims = [int(d) for d in m.group(1).split(",") if d]
+        if dim in dims:
+            hits += 1
+    return hits
 
 
 def compiled_text(jitted, *args) -> str:
@@ -158,7 +183,8 @@ def probe_single_replica() -> dict:
     return {"collectives": counts}
 
 
-def _pipeline_runner(tensor_parallel: int, comm_overlap=None):
+def _pipeline_runner(tensor_parallel: int, comm_overlap=None,
+                     vocab_parallel: bool = False, vocab_size: int = 32):
     import jax
     import jax.numpy as jnp
     import optax
@@ -167,7 +193,8 @@ def _pipeline_runner(tensor_parallel: int, comm_overlap=None):
     from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
     from autodist_tpu.models.transformer import TransformerConfig
 
-    cfg = TransformerConfig(vocab_size=32, hidden_size=16, num_layers=2,
+    cfg = TransformerConfig(vocab_size=vocab_size, hidden_size=16,
+                            num_layers=2,
                             num_heads=2, mlp_dim=32, max_len=8,
                             dtype=jnp.float32, dropout_rate=0.0,
                             attention_dropout_rate=0.0)
@@ -179,14 +206,17 @@ def _pipeline_runner(tensor_parallel: int, comm_overlap=None):
                                            jax.random.PRNGKey(0))
     return AutoDist(spec, "Pipeline", num_microbatches=2,
                     tensor_parallel=tensor_parallel,
-                    comm_overlap=comm_overlap).build(trainable)
+                    comm_overlap=comm_overlap,
+                    vocab_parallel=vocab_parallel).build(trainable)
 
 
 import functools
 
 
 @functools.lru_cache(maxsize=None)
-def _pipeline_step_text(tensor_parallel: int, comm_overlap=None) -> str:
+def _pipeline_step_text(tensor_parallel: int, comm_overlap=None,
+                        vocab_parallel: bool = False,
+                        vocab_size: int = 32) -> str:
     """Optimized HLO of one pipeline train step (memoized: the tp=1 and
     blocking tp=2 programs serve both probe_pipeline_tp and
     probe_collective_matmul — each 8-device compile costs tens of
@@ -195,9 +225,10 @@ def _pipeline_step_text(tensor_parallel: int, comm_overlap=None) -> str:
     import numpy as np
 
     r = np.random.RandomState(0)
-    batch = {"x": r.randint(0, 32, (8, 8)).astype(np.int32),
-             "y": r.randint(0, 32, (8, 8)).astype(np.int32)}
-    runner = _pipeline_runner(tensor_parallel, comm_overlap)
+    batch = {"x": r.randint(0, vocab_size, (8, 8)).astype(np.int32),
+             "y": r.randint(0, vocab_size, (8, 8)).astype(np.int32)}
+    runner = _pipeline_runner(tensor_parallel, comm_overlap,
+                              vocab_parallel, vocab_size)
     try:
         return compiled_text(runner.lowered.step_fn, runner.state,
                              runner._place_batch(batch),
@@ -262,11 +293,45 @@ def probe_collective_matmul() -> dict:
     return report
 
 
+def probe_vocab_parallel() -> dict:
+    """Vocab parallelism (``Pipeline(vocab_parallel=True)``), the memory
+    claim, structurally: at tp=2 the vocab-sharded program's loss head
+    never materializes a full-vocab buffer — no array shape in the whole
+    optimized per-device module carries the vocab extent V (or its
+    zero-padded V_pad; that also rules out a vocab-axis all-gather,
+    whose result would be V-sized) — while the replicated tp=2 baseline
+    carries the ``[V, H]`` table and ``[.., V]`` logits.  V is chosen so
+    no other tensor dimension collides with it (93: odd, so the
+    non-divisible zero-pad path compiles too; V_pad=94, shard=47)."""
+    V = 93
+    V_pad = V + (-V) % 2
+    base = collective_counts(_pipeline_step_text(2, vocab_size=V))
+    base_full = buffers_with_dim(_pipeline_step_text(2, vocab_size=V), V)
+    vp_text = _pipeline_step_text(2, vocab_parallel=True, vocab_size=V)
+    vp = collective_counts(vp_text)
+    assert base_full > 0, (
+        "replicated baseline shows no full-vocab buffer — the probe's "
+        "distinctive-dim scan is broken, not proving anything")
+    leaks = buffers_with_dim(vp_text, V) + buffers_with_dim(vp_text, V_pad)
+    assert leaks == 0, (
+        f"vocab-parallel tp=2 program materializes {leaks} full-vocab-"
+        f"sized buffer(s) (dim {V}/{V_pad}) — the loss head re-replicated "
+        "(or a vocab-axis all-gather assembled the full logits)")
+    assert vp["collective-permute"] > 0, (
+        f"pipeline ring missing from the vocab-parallel program: {vp}")
+    return {"vocab_size": V, "padded_vocab": V_pad,
+            "baseline_full_vocab_buffers": base_full,
+            "vocab_parallel_full_vocab_buffers": leaks,
+            "collectives_baseline": base,
+            "collectives_vocab_parallel": vp}
+
+
 PROBES = {
     "steps_per_loop": probe_steps_per_loop,
     "single_replica": probe_single_replica,
     "pipeline_tp": probe_pipeline_tp,
     "collective_matmul": probe_collective_matmul,
+    "vocab_parallel": probe_vocab_parallel,
 }
 
 
