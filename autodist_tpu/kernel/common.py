@@ -7,6 +7,7 @@ TPU: these are pure shape/padding/collective helpers used inside
 """
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -104,6 +105,98 @@ def local_axis_shard(x, axis_name: str, n: int, axis: int):
     k = x.shape[axis] // n
     i = lax.axis_index(axis_name)
     return lax.dynamic_slice_in_dim(x, i * k, k, axis=axis)
+
+
+# --------------------------------------------------------------------------- #
+# ZeRO-3: on-demand parameter materialization.  The forward all-gathers a
+# flat shard back into the full parameter; the backward is the transposed
+# collective — a reduce-scatter (SUM, callers apply the replica mean) of
+# the full-parameter cotangent into the shard.  Because the pair is a
+# custom VJP, AD through a step function whose parameters enter as shards
+# yields shard-shaped gradients automatically: the full gradient is a
+# transient inside the backward, never part of the differentiated
+# state — the structural property ``tools/hlo_probe.py probe_zero3``
+# asserts on CPU.
+# --------------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def zero3_gather(shard, axis_entry, n: int, shape: tuple):
+    """Materialize one full parameter from its flat ZeRO-3 shard.
+
+    ``shard``: the local ``[padded/n]`` flat chunk (``local_flat_shard``
+    layout); ``axis_entry``: the replica axes (``axes_entry`` form);
+    ``n``: their total device count; ``shape``: the full parameter
+    shape.  Backward: the cotangent reduce-scatters (sum — divide by the
+    data-replica count where a mean is wanted) into shard form, so the
+    gradient of a sharded-stored parameter is born sharded.
+    """
+    return all_gather_flat(shard, axis_entry, shape)
+
+
+def _zero3_gather_fwd(shard, axis_entry, n, shape):
+    return all_gather_flat(shard, axis_entry, shape), None
+
+
+def _zero3_gather_bwd(axis_entry, n, shape, _, ct):
+    return (reduce_scatter_flat(ct, axis_entry, n, mean=False),)
+
+
+zero3_gather.defvjp(_zero3_gather_fwd, _zero3_gather_bwd)
+
+
+@jax.custom_vjp
+def chain_gathers(x, token):
+    """Serialize a ZeRO-3 gather behind the previous layer's: tie this
+    gather's input to a 1-element sentinel of the prior gather's output
+    (see :func:`gather_sentinel`) through an ``optimization_barrier``.
+    The explicit data dependence (a) stops XLA's collective combiner
+    from merging the per-layer gathers into one bulk up-front
+    materialization, and (b) expresses the prefetch order — layer *k*'s
+    gather is scheduled before layer *k+1*'s, so with the
+    async-collective flags the *k+1* transfer can overlap *k*'s compute.
+    Identity value-wise; a custom VJP because ``optimization_barrier``
+    itself carries no differentiation rule."""
+    x, _ = lax.optimization_barrier((x, token))
+    return x
+
+
+def _chain_gathers_fwd(x, token):
+    x, _ = lax.optimization_barrier((x, token))
+    return x, token
+
+
+def _chain_gathers_bwd(token, ct):
+    return ct, jnp.zeros_like(token)
+
+
+chain_gathers.defvjp(_chain_gathers_fwd, _chain_gathers_bwd)
+
+
+def gather_sentinel(full):
+    """1-element data-flow handle on a gathered parameter, used as the
+    ``token`` chaining the next layer's gather behind this one."""
+    return lax.slice(full.reshape(-1), (0,), (1,))
+
+
+def make_chained_gather():
+    """ONE implementation of the layer-ordered ZeRO-3 gather chain (both
+    the replicated-SPMD and pipeline lowerings materialize shards with
+    it): returns ``gather(shard, axis_entry, n, shape)`` whose
+    successive calls are chained — each gather's input is tied behind
+    the previous gather's :func:`gather_sentinel` through
+    :func:`chain_gathers`, so XLA can neither combine the per-layer
+    gathers into one bulk materialization nor reorder them, and the
+    next layer's gather can prefetch under the current layer's compute.
+    Call in layer order; make a fresh chain per traced function."""
+    token = [None]
+
+    def gather(shard, axis_entry, n: int, shape):
+        s = shard if token[0] is None else chain_gathers(shard, token[0])
+        full = zero3_gather(s, axis_entry, n,
+                            tuple(int(d) for d in shape))
+        token[0] = gather_sentinel(full)
+        return full
+
+    return gather
 
 
 # --------------------------------------------------------------------------- #

@@ -86,6 +86,24 @@ def lower_gspmd(trainable: Trainable, strategy: Strategy, mesh) -> GspmdLowered:
             "gspmd lowering ignores compressor config on %d variable(s), "
             "e.g. %s — use the collective lowering for compressed "
             "gradients", len(ignored), ignored[0])
+    # ZeRO stages beyond 1 have no gspmd realization here (stage 3's
+    # sharded-parameter layout under gspmd is the FSDPSharded builder;
+    # stages 2/3 with explicit per-layer gathers are the pipeline
+    # lowering's knob).  The Sharded builder rejects stage > 1 at build
+    # time; a hand-edited or deserialized strategy reaching this
+    # lowering must not silently train stage-1 semantics — warn, like
+    # the compressor path above.
+    staged = sorted({
+        n.var_name for n in strategy.node_configs
+        if isinstance(n.synchronizer, PSSynchronizer)
+        and int(getattr(n.synchronizer, "zero_stage", 1) or 1) > 1})
+    if staged:
+        logging.warning(
+            "gspmd lowering realizes PS as ZeRO-1 state sharding only; "
+            "zero_stage>1 on %d variable(s), e.g. %s, lowers with "
+            "stage-1 semantics (params/grads stay unsharded) — use "
+            "FSDPSharded for the GSPMD sharded-parameter layout or the "
+            "pipeline lowering's zero_stage", len(staged), staged[0])
 
     def axis_size(axis) -> int:
         axes = axis if isinstance(axis, tuple) else (axis,)

@@ -47,9 +47,15 @@ class VarPolicy:
     """Per-variable synchronization choice for the replicated-SPMD
     builder (resolved from a Strategy's node configs).
 
-    ``zero_axes``: non-empty = ZeRO-1 — shard this variable's optimizer
+    ``zero_axes``: non-empty = ZeRO — shard this variable's optimizer
     state flat over these mesh axes (grad reduce-scatter + update
-    all-gather).  ``compressor``: run the named compressed allreduce
+    all-gather).  ``zero_stage`` picks the rung (arxiv 2004.13336):
+    ``1``/``2`` share the U_FLAT program (the grad sync is already a
+    reduce-scatter; the stage is the cost model's accounting record),
+    ``3`` additionally *stores* the parameter as the flat shard and
+    all-gathers it on demand inside the step (``common.zero3_gather`` —
+    identity-storage update space, no re-gather after the update).
+    ``compressor``: run the named compressed allreduce
     instead of a plain pmean.  ``sync_axes``: the axes a plain/compressed
     sync averages over (defaults to the builder's ``sync_axes``).
     ``scale``: applied after the mean — the expert lowering's 1/E factor
@@ -57,6 +63,7 @@ class VarPolicy:
     """
 
     zero_axes: tuple = ()
+    zero_stage: int = 1
     compressor: str = "none"
     sync_axes: Optional[tuple] = None
     scale: float = 1.0
@@ -77,7 +84,9 @@ def ssp_staleness_from(strategy) -> int:
 def policies_from_node_configs(strategy, mesh, *, replicated_axes,
                                axes_for: Optional[Callable] = None,
                                scale_for: Optional[Callable] = None,
-                               sharded_vars=()) -> dict[str, VarPolicy]:
+                               sharded_vars=(),
+                               degraded: Optional[dict] = None
+                               ) -> dict[str, VarPolicy]:
     """Resolve a Strategy's per-variable synchronizer configs into
     :class:`VarPolicy` entries for :func:`build_replicated_spmd`.
 
@@ -85,9 +94,11 @@ def policies_from_node_configs(strategy, mesh, *, replicated_axes,
     ``axes_for(name)`` / ``scale_for(name)``: per-variable overrides (the
     expert lowering syncs expert-sharded variables over the data axes
     only, scaled 1/E).  ``sharded_vars``: variables whose *parameters*
-    are stored sharded by this lowering — ZeRO-1 requests on them fall
-    back to plain sync with a warning (their optimizer state already
-    shards with the parameter; the flat re-shard is not implemented).
+    are stored sharded by this lowering — ZeRO requests on them fall
+    back to plain sync (their optimizer state already shards with the
+    parameter; the flat re-shard is not implemented).  ``degraded``:
+    when given, each such fallback is recorded there as ``name ->
+    reason`` (the lowered plan carries it) instead of logging a warning.
     """
     from autodist_tpu.strategy.ir import AllReduceSynchronizer, PSSynchronizer
     from autodist_tpu.utils import logging
@@ -105,18 +116,26 @@ def policies_from_node_configs(strategy, mesh, *, replicated_axes,
                     "not lower to a synchronous SPMD program; build through "
                     "AutoDist (which dispatches to AsyncPSRunner) or use "
                     "sync=True")
+            stage = int(getattr(sync, "zero_stage", 1) or 1)
+            if stage not in (1, 2, 3):
+                raise ValueError(
+                    f"{name}: PSSynchronizer.zero_stage must be 1, 2 or 3 "
+                    f"(got {stage})")
             if name in sharded_vars:
-                logging.warning(
-                    "%s: parameter is stored sharded by this lowering; its "
-                    "optimizer state shards with it — the ZeRO-1 (PS) "
-                    "request degrades to plain sync", name)
+                reason = ("parameter stored sharded by this lowering; "
+                          "optimizer state already shards with it — the "
+                          f"ZeRO-{stage} (PS) request degrades to plain sync")
+                if degraded is not None:
+                    degraded[name] = reason
+                else:
+                    logging.warning("%s: %s", name, reason)
                 if scale != 1.0 or axes != tuple(replicated_axes):
                     policies[name] = VarPolicy(sync_axes=axes, scale=scale)
                 continue
             n = math.prod(mesh.shape[a] for a in axes)
             if n > 1:
-                policies[name] = VarPolicy(zero_axes=axes, sync_axes=axes,
-                                           scale=scale)
+                policies[name] = VarPolicy(zero_axes=axes, zero_stage=stage,
+                                           sync_axes=axes, scale=scale)
         elif isinstance(sync, AllReduceSynchronizer):
             comp = sync.compressor or "none"
             if comp != "none":
@@ -172,13 +191,43 @@ def apply_compressed(name, g, comp_name: str, axes_entry, sync_state,
     return red.reshape(g.shape).astype(g.dtype)
 
 
+@dataclasses.dataclass
+class ZeroLowered(SimpleLowered):
+    """SimpleLowered + the logical shapes of ZeRO-3 flat-stored
+    parameters, so ``get_params`` / portable checkpoints expose the
+    layout the user declared (the 'looks unpartitioned' contract)."""
+
+    zero3_shapes: dict = None
+    # name -> reason for every ZeRO request the lowering degraded
+    # (param already sharded): the plan record that replaced the old
+    # warn-and-degrade logging.
+    zero_degraded: dict = None
+
+    def unpad_params(self, params):
+        shapes = self.zero3_shapes or {}
+        if not shapes:
+            return params
+
+        def restore(nm, p):
+            shape = shapes.get(nm)
+            if shape is None:
+                return p
+            arr = np.asarray(jax.device_get(p)).reshape(-1)
+            size = max(int(np.prod(shape)), 1) if shape else 1
+            return arr[:size].reshape(shape)
+
+        return common.tree_from_names(params, restore)
+
+
 def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
                           batch_spec_fn: Callable,
                           batch_spec,
                           param_spec_fn: Optional[Callable] = None,
                           grad_sync: Optional[Callable] = None,
                           accum: int = 1,
-                          policies: Optional[dict] = None) -> SimpleLowered:
+                          policies: Optional[dict] = None,
+                          zero_degraded: Optional[dict] = None
+                          ) -> SimpleLowered:
     """Compile a train/eval step for a (mostly) replicated-parameter
     strategy.
 
@@ -209,12 +258,19 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
     shapes_by_name = {v.name: v.shape for v in trainable.var_infos()}
     sizes_by_name = {v.name: max(v.size, 1) for v in trainable.var_infos()}
 
-    # --- ZeRO-1 bookkeeping ------------------------------------------------ #
+    # --- ZeRO bookkeeping -------------------------------------------------- #
     def zero_n(name) -> int:
         pol = policies.get(name)
         if pol is None or not pol.zero_axes:
             return 1
         return math.prod(mesh.shape[a] for a in pol.zero_axes)
+
+    def zero3(name) -> bool:
+        """Stage 3: the parameter itself is stored as the flat shard and
+        gathered on demand inside the step."""
+        pol = policies.get(name)
+        return (pol is not None and bool(pol.zero_axes)
+                and pol.zero_stage >= 3 and zero_n(name) > 1)
 
     def u_shape(name) -> tuple:
         """Global update-space shape: padded flat for ZeRO vars, the
@@ -227,8 +283,8 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
     for name, pol in policies.items():
         if pol.zero_axes and spec_by_name.get(name, P()) != P():
             raise ValueError(
-                f"{name}: ZeRO-1 requires a replicated parameter; it is "
-                f"stored {spec_by_name[name]}")
+                f"{name}: ZeRO-{pol.zero_stage} requires a replicated "
+                f"parameter; it is stored {spec_by_name[name]}")
 
     def u_view(name, p):
         """Global update-space view (runs in plain jit, not shard_map)."""
@@ -274,8 +330,29 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
     sync_rows = init_sync_rows(policies, local_size)
     sync_specs, n_total = sync_state_layout(mesh, sync_rows)
 
+    # ZeRO-3 parameters are *stored* in update space (the flat padded
+    # shard); everything else keeps its declared spec.
+    store_specs = common.tree_from_names(
+        trainable.params,
+        lambda nm, l: u_spec(nm) if zero3(nm) else spec_by_name.get(nm, P()))
+
+    def gather_full(params):
+        """Materialize ZeRO-3 shards into full parameters for the loss
+        (per-variable gathers, chained layer-order so XLA cannot merge
+        them into one bulk materialization; the custom VJP makes their
+        gradients born sharded)."""
+        gather = common.make_chained_gather()
+
+        def one(name, p):
+            if not zero3(name):
+                return p
+            return gather(p, common.axes_entry(policies[name].zero_axes),
+                          zero_n(name), shapes_by_name[name])
+
+        return common.tree_from_names(params, one)
+
     extra_specs = jax.tree.map(lambda _: P(), trainable.extra)
-    state_specs = {"step": P(), "params": p_specs, "opt_state": o_specs,
+    state_specs = {"step": P(), "params": store_specs, "opt_state": o_specs,
                    "extra": extra_specs, "sync_state": sync_specs}
     state_shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), state_specs,
@@ -283,8 +360,10 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
 
     def _init(params, extra):
         params = jax.tree.map(jnp.asarray, params)
+        stored = common.tree_from_names(
+            params, lambda nm, p: u_view(nm, p) if zero3(nm) else p)
         return {"step": jnp.zeros((), jnp.int32),
-                "params": params,
+                "params": stored,
                 "opt_state": opt.init(common.tree_from_names(params, u_view)),
                 "extra": extra,
                 "sync_state": tile_sync_rows(sync_rows, n_total)}
@@ -297,7 +376,7 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
         def micro_grads(mb, rng_, extra_in):
             def loss_of(params):
                 loss, new_extra, metrics = trainable.loss(
-                    params, extra_in, mb, rng_)
+                    gather_full(params), extra_in, mb, rng_)
                 return loss, (new_extra, metrics)
 
             return jax.value_and_grad(loss_of, has_aux=True)(
@@ -322,9 +401,15 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
             # mesh) and must not fall back to the full sync set.
             axes = sync_axes if pol.sync_axes is None else pol.sync_axes
             if pol.zero_axes:
-                rs = common.reduce_scatter_flat(
-                    g, common.axes_entry(pol.zero_axes),
-                    zero_n(name), mean=True)
+                if zero3(name):
+                    # The gather's custom VJP already reduce-scattered
+                    # (sum) the cotangent into shard form; the mean just
+                    # divides.
+                    rs = g / zero_n(name)
+                else:
+                    rs = common.reduce_scatter_flat(
+                        g, common.axes_entry(pol.zero_axes),
+                        zero_n(name), mean=True)
                 return rs if pol.scale == 1.0 else rs * pol.scale
             if not axes:
                 # Variable replicated over no axes (e.g. expert-sharded on
@@ -341,11 +426,11 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
         u_grads = common.tree_from_names(grads, sync_one)
 
         def u_param(name, p):
-            if zero_n(name) > 1:
+            if zero_n(name) > 1 and not zero3(name):
                 return common.local_flat_shard(
                     p, common.axes_entry(policies[name].zero_axes),
                     zero_n(name))
-            return p
+            return p  # zero-3 storage IS the update-space shard
 
         u_params = common.tree_from_names(state["params"], u_param)
         metrics = _reduce_metrics(dict(metrics), sync_axes)
@@ -359,11 +444,11 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
         u_new = optax.apply_updates(u_params, updates)
 
         def to_store(name, un):
-            if zero_n(name) > 1:
+            if zero_n(name) > 1 and not zero3(name):
                 return common.all_gather_flat(
                     un, common.axes_entry(policies[name].zero_axes),
                     shapes_by_name[name])
-            return un
+            return un  # zero-3: the shard persists; no re-gather
 
         new_params = common.tree_from_names(u_new, to_store)
         full_sync = dict(state["sync_state"])
@@ -383,7 +468,7 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
 
     def _local_eval(state, batch, rng):
         _, _, metrics = trainable.eval_loss(
-            state["params"], state["extra"], batch,
+            gather_full(state["params"]), state["extra"], batch,
             jax.random.fold_in(rng, lax.axis_index(sync_axes)))
         return _reduce_metrics(dict(metrics), sync_axes)
 
@@ -395,8 +480,12 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
 
     eval_fn = jax.jit(_eval)
 
-    return SimpleLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
-                         state_specs=state_specs,
-                         state_shardings=state_shardings,
-                         batch_spec=batch_spec, eval_fn=eval_fn,
-                         batch_spec_fn=batch_spec_fn)
+    zero3_shapes = {name: tuple(shapes_by_name[name])
+                    for name in policies if zero3(name)}
+    return ZeroLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
+                       state_specs=state_specs,
+                       state_shardings=state_shardings,
+                       batch_spec=batch_spec, eval_fn=eval_fn,
+                       batch_spec_fn=batch_spec_fn,
+                       zero3_shapes=zero3_shapes,
+                       zero_degraded=dict(zero_degraded or {}))

@@ -164,15 +164,17 @@ def lower_expert_ir(trainable, strategy, mesh):
     # Per-variable synchronizer configs (PS -> ZeRO-1, compressors):
     # replicated variables sync over (data x expert) — both are batch
     # axes for them; expert-sharded variables over data only, scaled
-    # 1/E_shards (same objective as sync_grad above).  ZeRO-1 on an
-    # expert-sharded variable degrades with a warning — its optimizer
-    # state is already E-way sharded with the parameter.
+    # 1/E_shards (same objective as sync_grad above).  ZeRO on an
+    # expert-sharded variable degrades — its optimizer state is already
+    # E-way sharded with the parameter — with the reason recorded on the
+    # lowered plan (``ZeroLowered.zero_degraded``).
     from autodist_tpu.parallel._spmd import policies_from_node_configs
+    degraded: dict = {}
     policies = policies_from_node_configs(
         strategy, mesh, replicated_axes=batch_axes,
         axes_for=lambda n: d_axes if n in expert_vars else batch_axes,
         scale_for=lambda n: 1.0 / E_shards if n in expert_vars else 1.0,
-        sharded_vars=expert_vars)
+        sharded_vars=expert_vars, degraded=degraded)
 
     batch_spec = P(common.axes_entry(batch_axes))
     return build_replicated_spmd(
@@ -181,7 +183,7 @@ def lower_expert_ir(trainable, strategy, mesh):
         batch_spec=batch_spec, param_spec_fn=param_spec,
         grad_sync=sync_grad,
         accum=max(strategy.graph_config.accum_steps, 1),
-        policies=policies)
+        policies=policies, zero_degraded=degraded)
 
 
 def dense_moe_reference(tokens, gate_w, expert_wi, expert_wo,

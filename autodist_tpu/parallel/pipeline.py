@@ -265,6 +265,13 @@ class _PipelineLowered(SimpleLowered):
     # (vocab parallelism zero-pads non-divisible vocab dims in storage);
     # fetch paths slice the padding back off.
     shared_orig_shapes: Any = None
+    # Logical shapes of ZeRO-3 flat-stored leaves (full variable name ->
+    # pre-flattening shape): fetch paths restore the declared layout.
+    zero3_shapes: Any = None
+    # name -> reason for every ZeRO request this lowering degraded
+    # (tp-sharded stage vars, stage-3 on the vocab-sharded table): the
+    # plan record that replaced the old warn-and-degrade logging.
+    zero_degraded: Any = None
 
     def unpad_params(self, params):
         if self.perm_inv is None:
@@ -273,22 +280,35 @@ class _PipelineLowered(SimpleLowered):
         # would need a reshard; fetch callers (get_params, portable save)
         # device_get immediately anyway.
         inv = np.asarray(self.perm_inv)
+        z3 = self.zero3_shapes or {}
 
-        def unperm(tree):
-            return jax.tree.map(
-                lambda p: np.asarray(jax.device_get(p))[inv], tree)
+        def unstage(nm, p):
+            arr = np.asarray(jax.device_get(p))
+            shape = z3.get(nm)
+            if shape is not None:
+                elems = max(int(np.prod(shape[1:])), 1)
+                arr = arr[:, :elems].reshape(shape)
+            return arr[inv]
+
+        def unperm(tree, prefix=""):
+            return common.tree_from_names(
+                tree, lambda nm, p: unstage(prefix + nm, p))
 
         if self.has_shared:
             orig = self.shared_orig_shapes or {}
 
             def unpad_shared(nm, p):
                 arr = np.asarray(jax.device_get(p))
+                shape = z3.get(f"shared/{nm}")
+                if shape is not None:
+                    size = max(int(np.prod(shape)), 1)
+                    return arr.reshape(-1)[:size].reshape(shape)
                 shape = orig.get(nm)
                 if shape is not None and tuple(arr.shape) != tuple(shape):
                     arr = arr[tuple(slice(0, s) for s in shape)]
                 return arr
 
-            return {"stages": unperm(params["stages"]),
+            return {"stages": unperm(params["stages"], "stages/"),
                     "shared": common.tree_from_names(params["shared"],
                                                      unpad_shared)}
         return unperm(params)
@@ -307,7 +327,8 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                     policies=None, stage_rng: bool = False,
                     remat: bool = False, tp_specs=None,
                     model_axis: str = const.MODEL_AXIS,
-                    comm_overlap=None, shared_specs=None):
+                    comm_overlap=None, shared_specs=None,
+                    zero_degraded=None):
     """Shared construction for the direct API and the Strategy-IR entry;
     returns a Lowered-contract container.
 
@@ -342,6 +363,21 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
       over ``pipe x data`` jointly: one ``psum_scatter`` realizes the
       sum-over-pipe (each device contributes a different role) and the
       shard split, divided by the data-replica count for the mean;
+    * ``zero_stage == 2`` lowers identically (the U_FLAT scheme above
+      already reduce-scatters the gradient sync); the stage is the
+      record the cost model prices the 1/n gradient term from;
+    * ``zero_stage == 3`` additionally *stores* the parameter sharded:
+      a stage variable lives as ``[C, padded_chunk]`` flat rows sharded
+      ``P(pipe, data)`` and each chunk is all-gathered on demand inside
+      the step — one gather per (layer, leaf), chained through
+      ``optimization_barrier`` sentinels (``common.chain_gathers``) so
+      XLA can neither merge them into a bulk up-front materialization
+      nor hoist them, and the next layer's gather can prefetch under
+      the current layer's compute with the async-collective flags.  The
+      gather's custom VJP (``common.zero3_gather``) reduce-scatters the
+      cotangent, so gradients are born sharded, the update runs on the
+      stored shard, and nothing full-sized survives the step boundary
+      (``tools/hlo_probe.py probe_zero3`` asserts both properties);
     * a ``compressor`` runs the compressed allreduce over the data axes
       (stage grads differ across pipe; shared grads psum over pipe at
       full precision first).
@@ -362,10 +398,10 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     differ along the data axes only; model-replicated stage variables
     (layer norms, row-parallel biases) compute bitwise-identical
     gradients on every model member because every boundary activation
-    and cotangent is model-replicated by the psum placement.  ZeRO-1 on
-    a tp-sharded variable is rejected here (its optimizer state already
+    and cotangent is model-replicated by the psum placement.  ZeRO on a
+    tp-sharded variable is rejected here (its optimizer state already
     shards with the parameter; ``lower_pipeline_ir`` degrades such
-    requests with a warning before calling).
+    requests, recording the reason on the lowered plan, before calling).
 
     ``comm_overlap`` (with tensor parallelism): how the model-axis
     activation collectives lower — ``None`` blocking psum, ``"rsag"``
@@ -387,10 +423,14 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     psum; streaming fused cross-entropy).  Shared-grad sync is
     unchanged: the psum over ``pipe`` composes with model-axis sharding
     because each (pipe, model) coordinate owns its vocab slice's
-    contribution and the sum runs per model coordinate.  ZeRO-1 on a
-    model-sharded shared variable is rejected here (state already
-    shards with the parameter; ``lower_pipeline_ir`` degrades such
-    requests with a warning before calling)."""
+    contribution and the sum runs per model coordinate.  ZeRO on a
+    model-sharded shared variable shards its optimizer state
+    *additionally* over ``pipe x data`` — the local ``[V_pad/tp, H]``
+    shard's flat update space lives ``P((model, pipe, data))``, state
+    at ``1/(tp·pipe·data)`` — the grad reduce-scatter and update
+    all-gather running entirely within each model coordinate (a stage-3
+    request on it degrades to this state-sharding form, recorded on the
+    lowered plan: the parameter is already 1/tp-sharded)."""
     from autodist_tpu.parallel.tensor import normalize_comm_overlap
 
     n = mesh.shape[pipe_axis]
@@ -506,6 +546,8 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                          if a is not None)
 
     def stage_param_spec(name: str) -> P:
+        if zero3(name):   # ZeRO-3 storage: [C, padded_chunk] flat rows
+            return u_spec(name)
         tail = tp_specs.get(name)
         return P(pipe_axis, *tail) if tail else P(pipe_axis)
 
@@ -515,6 +557,8 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                          if a is not None)
 
     def shared_param_spec(name: str) -> P:
+        if zero3(name):   # ZeRO-3 storage: the flat padded shard
+            return u_spec(name)
         spec = shared_specs.get(name)
         return P(*spec) if spec else P()
 
@@ -528,24 +572,14 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
             common.padded_flat_size(d, mesh.shape[a]) if a is not None
             else d for d, a in zip(shape, spec))
 
-    stage_specs = common.tree_from_names(
-        stacked_params, lambda nm, _: stage_param_spec(full_stage_name(nm)))
     if has_shared:
-        # Per-leaf shared specs from the Strategy IR (vocab parallelism
-        # shards the tied embedding P(model, None)); replicated P()
-        # remains the default.
-        p_specs = {"stages": stage_specs,
-                   "shared": common.tree_from_names(
-                       shared_params,
-                       lambda nm, _: shared_param_spec(f"shared/{nm}"))}
         full_params = {"stages": stacked_params, "shared": shared_params}
     else:
-        p_specs = stage_specs
         full_params = stacked_params
-    state_specs = {"step": P(), "params": p_specs, "opt_state": p_specs,
-                   "extra": None, "sync_state": {}}
 
-    # --- per-variable policy bookkeeping (ZeRO-1 / compressors) ----------- #
+    # --- per-variable policy bookkeeping (ZeRO / compressors) ------------- #
+    zero_degraded = dict(zero_degraded or {})
+
     def is_stage_var(name: str) -> bool:
         return name.startswith("stages/") if has_shared else True
 
@@ -556,6 +590,15 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     def zero_count(pol) -> int:
         return math.prod(mesh.shape[a] for a in pol.zero_axes)
 
+    def zero3(name) -> bool:
+        """Stage 3: the variable's parameter is *stored* as its ZeRO
+        shard and gathered on demand per layer inside the step.  Never
+        true for model-sharded variables — their stage-3 requests
+        degrade to the state-sharding form (recorded below)."""
+        pol = zero_pol(name)
+        return (pol is not None and pol.zero_stage >= 3
+                and name not in tp_specs and name not in shared_specs)
+
     for name, pol in policies.items():
         if pol.zero_axes and is_stage_var(name) \
                 and pipe_axis in pol.zero_axes:
@@ -565,13 +608,29 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         if pol.zero_axes and name in tp_specs:
             raise ValueError(
                 f"{name}: a tensor-parallel sharded variable's optimizer "
-                "state already shards with the parameter; ZeRO-1 on it "
+                "state already shards with the parameter; ZeRO on it "
                 "is a no-op request (lower_pipeline_ir degrades it)")
         if pol.zero_axes and name in shared_specs:
-            raise ValueError(
-                f"{name}: a vocab-sharded shared variable's optimizer "
-                "state already shards with the parameter; ZeRO-1 on it "
-                "is a no-op request (lower_pipeline_ir degrades it)")
+            # The model-sharded (vocab-parallel) table: its *parameter*
+            # already lives 1/tp, so ZeRO here shards the optimizer
+            # state additionally over pipe x data (update space
+            # P((model, pipe, data)), state at 1/(tp * pipe * data)).
+            # Only a dim-0 model shard is supported — the vocab-rule
+            # form; anything fancier degrades to plain sync.
+            spec = shared_specs[name]
+            if not (spec and spec[0] == model_axis
+                    and all(a is None for a in spec[1:])):
+                zero_degraded[name] = (
+                    "ZeRO on a shared variable model-sharded beyond "
+                    f"dim 0 (spec {list(spec)}) is unsupported; state "
+                    "shards with the parameter only")
+                policies = {k: p for k, p in policies.items() if k != name}
+            elif pol.zero_stage >= 3:
+                zero_degraded[name] = (
+                    "zero_stage=3 on the model-sharded table degrades "
+                    "to optimizer-state sharding: the parameter is "
+                    "already 1/tp-sharded over the model axis; state "
+                    "shards over (model, pipe, data)")
 
     leaves_by_name = dict(common.flatten_with_names(full_params))
     # Per-device sizes: stage leaves hold this device's V chunks (1/n of
@@ -588,6 +647,18 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                else max(int(np.prod(np.shape(leaf))), 1))
         for name, leaf in leaves_by_name.items()}
 
+    def chunk_elems(name) -> int:
+        """Elements of ONE chunk of a stage leaf (the stacked shape
+        minus its leading chunk dim)."""
+        return max(local_sizes[name] // V, 1)
+
+    def padded_chunk(name) -> int:
+        """ZeRO-3 stage storage row width: one chunk's elements padded
+        to divide the ZeRO shard count (per-chunk padding keeps every
+        layer's shard contiguous, so each layer gathers independently)."""
+        return common.padded_flat_size(chunk_elems(name),
+                                       zero_count(zero_pol(name)))
+
     def u_shape(name) -> tuple:
         pol = zero_pol(name)
         if pol is None:
@@ -597,22 +668,44 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                 # padded stored leaf
                 shape = shared_padded_shape(name, shape)
             return shape
+        if zero3(name):
+            # Stage 3: update space IS the storage — [C, padded_chunk]
+            # rows for stage leaves, the flat padded shard for shared.
+            if is_stage_var(name):
+                return (C, padded_chunk(name))
+            return (common.padded_flat_size(local_sizes[name],
+                                            zero_count(pol)),)
+        if name in shared_specs:
+            # Model-sharded table + ZeRO: the local 1/tp shard's flat
+            # update space, model-major over the full group.
+            tp_n = shared_shards(name)
+            return (tp_n * common.padded_flat_size(local_sizes[name],
+                                                   zero_count(pol)),)
         padded = common.padded_flat_size(local_sizes[name], zero_count(pol))
         return (n * padded,) if is_stage_var(name) else (padded,)
 
     def u_spec(name):
         pol = zero_pol(name)
         if is_stage_var(name):
+            if zero3(name):
+                return P(pipe_axis, common.axes_entry(pol.zero_axes))
             return P((pipe_axis, *pol.zero_axes))
+        if name in shared_specs:
+            return P((model_axis, *pol.zero_axes))
         return P(common.axes_entry(pol.zero_axes))
 
     def u_view(name, leaf):
         """Global update-space view (runs in plain jit on the *stored*,
         i.e. interleave-permuted, layout): ZeRO leaves flatten pipe-major
         so the jit sharding matches what ``local_flat_shard`` /
-        ``reduce_scatter_flat`` produce inside shard_map."""
+        ``reduce_scatter_flat`` produce inside shard_map (model-major
+        for the vocab-sharded table's state — its shards live within
+        each model coordinate).  ZeRO-3 leaves are stored in update
+        space already."""
         pol = zero_pol(name)
         if pol is None:
+            return leaf
+        if zero3(name):
             return leaf
         nz = zero_count(pol)
         if is_stage_var(name):
@@ -620,9 +713,30 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
             flat = common.pad_axis_to(
                 flat, 1, common.padded_flat_size(local_sizes[name], nz))
             return flat.reshape(-1)
+        if name in shared_specs:
+            tp_n = shared_shards(name)
+            flat = jnp.reshape(leaf, (tp_n, local_sizes[name]))
+            flat = common.pad_axis_to(
+                flat, 1, common.padded_flat_size(local_sizes[name], nz))
+            return flat.reshape(-1)
         flat = jnp.reshape(leaf, (-1,))
         return common.pad_axis_to(
             flat, 0, common.padded_flat_size(flat.size, nz))
+
+    stage_specs = common.tree_from_names(
+        stacked_params, lambda nm, _: stage_param_spec(full_stage_name(nm)))
+    if has_shared:
+        # Per-leaf shared specs from the Strategy IR (vocab parallelism
+        # shards the tied embedding P(model, None)); replicated P()
+        # remains the default; ZeRO-3 leaves store their flat shard.
+        p_specs = {"stages": stage_specs,
+                   "shared": common.tree_from_names(
+                       shared_params,
+                       lambda nm, _: shared_param_spec(f"shared/{nm}"))}
+    else:
+        p_specs = stage_specs
+    state_specs = {"step": P(), "params": p_specs, "opt_state": p_specs,
+                   "extra": None, "sync_state": {}}
 
     def opt_specs_tree(opt_state_shapes):
         # ZeRO leaves resolve by path-suffix + u-shape match; otherwise
@@ -693,14 +807,31 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
             arr = common.pad_axis_to(arr, dim, t)
         return arr
 
+    def _store_stage(name, p):
+        """Storage form of one stage leaf: interleave-permuted; ZeRO-3
+        leaves additionally flatten per chunk into [C, padded_chunk]
+        rows (update space — no separate re-layout at optimizer time)."""
+        arr = jnp.asarray(p)[perm]
+        if zero3(name):
+            flat = arr.reshape(C, chunk_elems(name))
+            return common.pad_axis_to(flat, 1, padded_chunk(name))
+        return arr
+
+    def _store_shared(name, p):
+        if zero3(name):
+            flat = jnp.asarray(p).reshape(-1)
+            return common.pad_axis_to(flat, 0, u_shape(name)[0])
+        return _pad_shared(name, p)
+
     def _permute(params):
         if has_shared:
-            return {"stages": jax.tree.map(
-                lambda p: jnp.asarray(p)[perm], params["stages"]),
+            return {"stages": common.tree_from_names(
+                params["stages"],
+                lambda nm, p: _store_stage(f"stages/{nm}", p)),
                 "shared": common.tree_from_names(
                     params["shared"],
-                    lambda nm, p: _pad_shared(f"shared/{nm}", p))}
-        return jax.tree.map(lambda p: jnp.asarray(p)[perm], params)
+                    lambda nm, p: _store_shared(f"shared/{nm}", p))}
+        return common.tree_from_names(params, _store_stage)
 
     def _init(params, extra=None):
         stored = _permute(params)
@@ -713,6 +844,55 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
 
     init_fn = jax.jit(_init, out_shardings=state_shardings)
 
+    any_zero3 = any(zero3(nm) for nm in leaves_by_name)
+
+    def _materialize_zero3(vp):
+        """Gather ZeRO-3 stored shards back into logical parameters for
+        this forward: shared leaves first (the prologue consumes them
+        first), then stage chunks in layer order — one independent
+        all-gather per (layer, leaf), chained through barrier sentinels
+        (``common.chain_gathers``) so XLA neither merges them into a
+        bulk up-front materialization nor reorders them; the next
+        layer's gather can prefetch under the current layer's compute.
+        Gradients flow back *sharded* through the gathers' custom VJP
+        (``common.zero3_gather``), so no full gradient ever joins the
+        differentiated state."""
+        if not any_zero3:
+            return vp
+        chained = common.make_chained_gather()
+
+        def gather(shard, pol, shape):
+            return chained(shard, common.axes_entry(pol.zero_axes),
+                           zero_count(pol), shape)
+
+        stages = vp["stages"] if has_shared else vp
+        shared = vp.get("shared") if has_shared else None
+        if shared is not None:
+            def one_shared(nm, leaf):
+                name = f"shared/{nm}"
+                if not zero3(name):
+                    return leaf
+                return gather(leaf, zero_pol(name),
+                              np.shape(leaves_by_name[name]))
+
+            shared = common.tree_from_names(shared, one_shared)
+        named = common.flatten_with_names(stages)
+        chunks: dict = {}
+        for v in range(V):
+            for rel, leaf in named:
+                name = full_stage_name(rel)
+                if not zero3(name):
+                    continue
+                shape1 = tuple(np.shape(leaves_by_name[name]))[1:]
+                chunks.setdefault(rel, []).append(
+                    gather(leaf[v], zero_pol(name), shape1))
+        if chunks:
+            stages = common.tree_from_names(
+                stages, lambda rel, leaf: jnp.stack(chunks[rel])
+                if rel in chunks else leaf)
+        return {"stages": stages, "shared": shared} if has_shared \
+            else stages
+
     def _forward_loss(vp, batch, rng=None, slice_idx=0, slices=1):
         """Masked local loss+metrics of one batch slice (the head loss is
         nonzero on the last device only; per-stage aux losses are local
@@ -720,6 +900,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         transposed ppermute ring; a psum before the grad would double-
         scale cotangents under check_vma=False, so values are broadcast
         after)."""
+        vp = _materialize_zero3(vp)
         stages = vp["stages"] if has_shared else vp
         shared = vp.get("shared") if has_shared else None
         # local shard of the [C]-stacked params is [V, ...]; the V == 1
@@ -824,6 +1005,11 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                 # Stage grads: each pipe shard owns its chunks; replicas
                 # differ along the data axes only.
                 if pol is not None and pol.zero_axes:
+                    if zero3(name):
+                        # The gathers' custom VJP already reduce-
+                        # scattered (sum) the cotangent into storage
+                        # form; the data mean just divides.
+                        return g / zero_count(pol)
                     return common.reduce_scatter_flat(
                         g, common.axes_entry(pol.zero_axes),
                         zero_count(pol), mean=True)
@@ -835,9 +1021,15 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
             # (injection on device 0, the head on device n-1, zeros in
             # between): sum, don't average, over the pipe axis.
             if pol is not None and pol.zero_axes:
+                if zero3(name):
+                    # vjp reduce-scattered the (pipe x data) sum; /n_d
+                    # restores the data mean, keeping the pipe sum.
+                    return g / n_d
                 # One psum_scatter over (pipe x data) realizes the
                 # pipe-sum and the ZeRO shard split; /n_d restores the
-                # data mean.
+                # data mean.  For the model-sharded (vocab-parallel)
+                # table the same code runs on the local 1/tp shard —
+                # each model coordinate owns its slice's state shards.
                 rs = common.reduce_scatter_flat(
                     g, common.axes_entry(pol.zero_axes),
                     zero_count(pol), mean=False)
@@ -851,8 +1043,8 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
 
         def u_param(name, p):
             pol = zero_pol(name)
-            if pol is None:
-                return p
+            if pol is None or zero3(name):
+                return p  # ZeRO-3 storage IS the update-space shard
             return common.local_flat_shard(
                 p, common.axes_entry(pol.zero_axes), zero_count(pol))
 
@@ -866,8 +1058,8 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         def to_store(path, un, p_local):
             name = path_to_name(path)
             pol = zero_pol(name)
-            if pol is None:
-                return un
+            if pol is None or zero3(name):
+                return un  # ZeRO-3: the shard persists; no re-gather
             return common.all_gather_flat(
                 un, common.axes_entry(pol.zero_axes), p_local.shape)
 
@@ -909,12 +1101,17 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
             nm: tuple(np.shape(leaf)) for nm, leaf in
             common.flatten_with_names(shared_params)
             if f"shared/{nm}" in shared_specs}
+    zero3_shapes = {name: tuple(np.shape(leaf))
+                    for name, leaf in leaves_by_name.items()
+                    if zero3(name)}
     return _PipelineLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
                             state_specs=state_specs,
                             state_shardings=state_shardings,
                             batch_spec=batch_spec, eval_fn=eval_fn,
                             perm_inv=perm_inv, has_shared=has_shared,
-                            shared_orig_shapes=shared_orig_shapes)
+                            shared_orig_shapes=shared_orig_shapes,
+                            zero3_shapes=zero3_shapes,
+                            zero_degraded=zero_degraded)
 
 
 def lower_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
@@ -1008,10 +1205,14 @@ def lower_pipeline_ir(trainable, strategy, mesh):
                 "mode — set graph_config.parallel['comm_overlap']")
         overlap = var_overlaps.pop()
 
-    # Per-variable synchronizer configs (PS -> ZeRO-1, compressors)
+    # Per-variable synchronizer configs (PS -> ZeRO stages, compressors)
     # compose with the pipeline: stage variables zero/compress over the
     # data axes (they are pipe-sharded already), shared variables zero
-    # over pipe x data jointly.
+    # over pipe x data jointly.  tp-sharded stage variables degrade
+    # (their state shards with the parameter), the reason recorded on
+    # the lowered plan; the model-sharded (vocab-parallel) table keeps
+    # its ZeRO request — _build_pipeline shards its optimizer state
+    # additionally over pipe x data (state at 1/(tp·pipe·data)).
     from autodist_tpu.parallel._spmd import policies_from_node_configs
     from autodist_tpu.utils import logging
 
@@ -1024,9 +1225,10 @@ def lower_pipeline_ir(trainable, strategy, mesh):
             return d_axes
         return shared_axes
 
+    degraded: dict = {}
     policies = policies_from_node_configs(
         strategy, mesh, replicated_axes=shared_axes, axes_for=axes_for,
-        sharded_vars=set(tp_specs) | set(shared_specs))
+        sharded_vars=set(tp_specs), degraded=degraded)
     if not d_axes:
         dropped = sorted(nm for nm, p in policies.items()
                          if p.compressor != "none")
@@ -1047,4 +1249,4 @@ def lower_pipeline_ir(trainable, strategy, mesh):
         policies=policies, stage_rng=trainable.stage_rng,
         remat=bool(cfg.parallel.get("remat", False)),
         tp_specs=tp_specs, comm_overlap=overlap,
-        shared_specs=shared_specs)
+        shared_specs=shared_specs, zero_degraded=degraded)

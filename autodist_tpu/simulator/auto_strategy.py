@@ -74,6 +74,18 @@ def default_candidates() -> list[StrategyBuilder]:
         # and the candidate is skipped.
         parallel_builders.Pipeline(num_microbatches=4, tensor_parallel=2,
                                    vocab_parallel=True),
+        # ZeRO-3 variants: parameters stored sharded over the data axis
+        # and all-gathered on demand per layer.  Wire volume matches the
+        # stage-1 rs+ag pair, but the per-layer gather launches price
+        # strictly above it — so these rank below replication/stage-1 on
+        # step time and win through the HBM feasibility gate, exactly
+        # when the replicated params+grads (or their Adam moments) blow
+        # the memory budget: the second memory lever after
+        # vocab_parallel, and the knob AutoStrategy arbitrates against
+        # raising the tp degree.
+        parallel_builders.Pipeline(num_microbatches=4, zero_stage=3),
+        parallel_builders.Pipeline(num_microbatches=4, tensor_parallel=2,
+                                   zero_stage=3),
         parallel_builders.ExpertParallel(),
     ]
 

@@ -156,6 +156,15 @@ class StrategyCost:
     # out because it is the term vocab parallelism divides by tp — the
     # drift report joins it against measured HBM and telemetry gauges it.
     peak_logits_bytes: float = 0.0
+    # Predicted per-device parameter-storage and gradient bytes after
+    # sharding (parallel lowerings), already included in
+    # mem_bytes_per_device; broken out like peak_logits_bytes because
+    # they are the terms the ZeRO stages divide — stage 2 shards the
+    # gradient term by the data-replica count, stage 3 the parameter
+    # term too — so the drift report can attribute an HBM delta between
+    # stages to the right term.
+    param_shard_bytes: float = 0.0
+    grad_shard_bytes: float = 0.0
 
     @property
     def score(self) -> float:
@@ -410,19 +419,41 @@ class CostModel:
             return getattr(getattr(node, "synchronizer", None),
                            "kind", "") == "ps"
 
+        def zero_divisors(node, group: int):
+            """(stage, param_div, grad_div, opt_div) of a PS node over a
+            ``group``-device replica set: stage 1 shards optimizer state,
+            stage 2 additionally accounts the gradients sharded (same
+            reduce-scatter program), stage 3 stores the parameters
+            sharded too (all-gathered on demand per layer)."""
+            if not node_is_ps(node) or group <= 1:
+                return 0, 1, 1, 1
+            stage = int(getattr(getattr(node, "synchronizer", None),
+                                "zero_stage", 1) or 1)
+            return (stage, group if stage >= 3 else 1,
+                    group if stage >= 2 else 1, group)
+
+        accum = max(int(strategy.graph_config.accum_steps or 1), 1)
+        param_b = grad_b = 0.0   # per-device param/grad bytes (sharded)
+
         if kind == "sequence":
             S = mesh.get(const.SEQ_AXIS, 1)
             n_sync = n_data * S
             # params replicated; per-var sync over data x seq.  PS ->
-            # ZeRO-1 (parallel/_spmd.py): same ring-equivalent volume,
-            # opt state at 1/n_sync; compressors scale the wire bytes.
+            # ZeRO (parallel/_spmd.py): same ring-equivalent volume, opt
+            # state at 1/n_sync (stage 2 accounts grads sharded, stage 3
+            # stores params sharded); compressors scale the wire bytes.
             for info in infos:
                 node = nodes_by_name.get(info.name)
                 bytes_ = float(info.byte_size)
-                opt_div = n_sync if (node_is_ps(node) and n_sync > 1) else 1
-                mem += bytes_ * 2.0 + bytes_ * opt_mult / opt_div
-                comm += ring(n_sync) * bytes_ * node_factor(node)
-                colls += 2 if opt_div > 1 else 1
+                stage, p_div, g_div, opt_div = zero_divisors(node, n_sync)
+                param_b += bytes_ / p_div
+                grad_b += bytes_ / g_div
+                mem += bytes_ / p_div + bytes_ / g_div \
+                    + bytes_ * opt_mult / opt_div
+                comm += (accum if stage >= 3 else 1) \
+                    * ring(n_sync) * bytes_ * node_factor(node)
+                colls += (2 * accum if stage >= 3
+                          else 2 if opt_div > 1 else 1)
             if tokens:
                 # ring attention: each device rotates its local k/v
                 # (2 tensors of tokens_local x hidden) S-1 hops forward,
@@ -474,12 +505,57 @@ class CostModel:
                     tp_sharded = const.MODEL_AXIS in spec_tail
                     per_dev = bytes_ / (S * (tp if tp_sharded else 1))
                     # ZeRO on a tp-sharded var degrades (state shards
-                    # with the parameter — lower_pipeline_ir's warning).
-                    opt_div = n_data if (node_is_ps(node) and n_data > 1
-                                         and not tp_sharded) else 1
-                    mem += per_dev * 2.0 + per_dev * opt_mult / opt_div
-                    comm += ring(n_data) * per_dev * node_factor(node)
-                    colls += 2 if opt_div > 1 else 1
+                    # with the parameter — recorded on the lowered plan).
+                    stage, p_div, g_div, opt_div = (
+                        zero_divisors(node, n_data) if not tp_sharded
+                        else (0, 1, 1, 1))
+                    param_b += per_dev / p_div
+                    grad_b += per_dev / g_div
+                    mem += per_dev / p_div + per_dev / g_div \
+                        + per_dev * opt_mult / opt_div
+                    if stage >= 3:
+                        # Stage 3: the backward grad reduce-scatter
+                        # keeps the blocking wire term; the per-layer
+                        # forward all-gathers (V per leaf, once per
+                        # accumulation slice) are overlap-capped like
+                        # the PR 2 envelope — exposed time is what the
+                        # prefetched layer's own compute cannot hide,
+                        # never more than the blocking gather.  The
+                        # total is FLOORED at the stage-1 rs+ag pair:
+                        # replication's grad all-reduce hides behind
+                        # backprop just as well (XLA's scheduler, not
+                        # modeled here), so crediting only stage 3 with
+                        # overlap would elect it as a phantom *speed*
+                        # lever on token-hinted models — it must win
+                        # through the memory gate alone (the
+                        # auto_strategy zoo contract, pinned by
+                        # test_zero_stage_ladder_memory_and_election).
+                        half = ring(n_data) / 2.0
+                        rs_bytes = accum * half * per_dev
+                        ag_bytes = accum * half * per_dev
+                        comm += rs_bytes
+                        colls += accum   # backward grad reduce-scatters
+                        t_ag = ag_bytes / bw_link
+                        alpha_floor = hop_alpha * accum * V
+                        t_hide = 0.0
+                        if tokens:
+                            # the step's matmul passes over this leaf's
+                            # weights hide the next layer's gathers
+                            # (elems ~ bytes/4; tokens_local is the
+                            # whole step's share, accum slices included)
+                            t_hide = 2.0 * tokens_local \
+                                * (per_dev / 4.0) / flops_rate
+                        exposed = alpha_floor + max(0.0, t_ag - t_hide)
+                        stage1_pair = ring(n_data) * per_dev / bw_link \
+                            + 2.0 * hop_alpha
+                        already = rs_bytes / bw_link + hop_alpha * accum
+                        overlap_s += max(exposed,
+                                         stage1_pair - already)
+                        hidden_bytes += ag_bytes
+                        extra_colls += accum * 2 * V
+                    else:
+                        comm += ring(n_data) * per_dev * node_factor(node)
+                        colls += 2 if opt_div > 1 else 1
                     # rank >= 2 gates out the column-parallel biases
                     # (spec tail ['model']), which shard but never
                     # all-reduce activations.
@@ -547,19 +623,37 @@ class CostModel:
                     # Shared (non-stage) variable.  Vocab parallelism
                     # (model axis in a shared var's spec) stores the tied
                     # embedding at 1/tp per device — params, grads, AND
-                    # optimizer state all shrink (ZeRO on it degrades:
-                    # state already shards with the parameter) — and the
-                    # pipe x data grad sync moves 1/tp the bytes.
+                    # optimizer state all shrink — and the pipe x data
+                    # grad sync moves 1/tp the bytes.  ZeRO on the
+                    # model-sharded table shards its optimizer state
+                    # *additionally* over pipe x data (state at
+                    # 1/(tp·pipe·data)); its params/grads stay 1/tp
+                    # (a stage-3 request degrades to this form).  A
+                    # model-replicated shared var takes the full stage
+                    # ladder over pipe x data.
                     v_sharded = (part is not None and part.spec
                                  and const.MODEL_AXIS in part.spec)
                     vsh = tp if v_sharded else 1
                     per_dev = bytes_ / vsh
                     n_pd = S * n_data
-                    opt_div = n_pd if (node_is_ps(node)
-                                       and vsh == 1) else 1
-                    mem += per_dev * 2.0 + per_dev * opt_mult / opt_div
-                    comm += ring(n_pd) * per_dev * node_factor(node)
-                    colls += 2 if opt_div > 1 else 1
+                    stage, p_div, g_div, opt_div = zero_divisors(node, n_pd)
+                    if v_sharded:
+                        p_div = g_div = 1   # param already 1/tp-stored
+                    param_b += per_dev / p_div
+                    grad_b += per_dev / g_div
+                    mem += per_dev / p_div + per_dev / g_div \
+                        + per_dev * opt_mult / opt_div
+                    if stage >= 3 and not v_sharded:
+                        half = ring(n_pd) / 2.0
+                        comm += accum * half * per_dev
+                        colls += accum   # backward grad reduce-scatters
+                        t_ag = accum * half * per_dev / bw_link
+                        overlap_s += t_ag + hop_alpha * accum
+                        hidden_bytes += accum * half * per_dev
+                        extra_colls += accum * 2
+                    else:
+                        comm += ring(n_pd) * per_dev * node_factor(node)
+                        colls += 2 if opt_div > 1 else 1
                     # Track the unembedding for the loss-head epilogue
                     # pricing below.  Identification priority: a
                     # model-sharded spec (the strategy SAYS which var is
@@ -642,15 +736,22 @@ class CostModel:
                     or part.mesh_axis == const.EXPERT_AXIS)
                 if is_expert:
                     mem += bytes_ * (2.0 + opt_mult) / E
+                    param_b += bytes_ / E
+                    grad_b += bytes_ / E
                     comm += ring(n_data) * (bytes_ / E) * node_factor(node)
                     colls += 1
                 else:
                     n_sync = n_data * E
-                    opt_div = n_sync if (node_is_ps(node)
-                                         and n_sync > 1) else 1
-                    mem += bytes_ * 2.0 + bytes_ * opt_mult / opt_div
-                    comm += ring(n_sync) * bytes_ * node_factor(node)
-                    colls += 2 if opt_div > 1 else 1
+                    stage, p_div, g_div, opt_div = zero_divisors(node,
+                                                                 n_sync)
+                    param_b += bytes_ / p_div
+                    grad_b += bytes_ / g_div
+                    mem += bytes_ / p_div + bytes_ / g_div \
+                        + bytes_ * opt_mult / opt_div
+                    comm += (accum if stage >= 3 else 1) \
+                        * ring(n_sync) * bytes_ * node_factor(node)
+                    colls += (2 * accum if stage >= 3
+                              else 2 if opt_div > 1 else 1)
             if tokens:
                 # all_to_all dispatch + combine, fwd + bwd: 4 passes of
                 # the local token activations, (E-1)/E leaving the device
@@ -671,7 +772,9 @@ class CostModel:
                                             if total_devices > 1 else 0.0),
                             peak_logits_bytes=(peak_logits
                                                if kind == "pipeline"
-                                               else 0.0))
+                                               else 0.0),
+                            param_shard_bytes=param_b,
+                            grad_shard_bytes=grad_b)
 
     def strategy_cost(self, trainable: Trainable,
                       strategy: Strategy) -> StrategyCost:

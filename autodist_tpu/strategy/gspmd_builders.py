@@ -30,16 +30,29 @@ class Sharded(StrategyBuilder):
     First matching rule wins; unmatched variables are replicated (pure DP
     via the sharded batch).
 
-    ``zero1=True`` emits ``PSSynchronizer`` node configs: the gspmd
-    lowering shards each variable's optimizer-state leading dim over the
-    data axes (GSPMD ZeRO-1; XLA derives the reduce-scatter/all-gather)
-    — composable with TP sharding of the other dims.
+    ``zero_stage=1`` (alias ``zero1=True``) emits ``PSSynchronizer``
+    node configs: the gspmd lowering shards each variable's
+    optimizer-state leading dim over the data axes (GSPMD ZeRO-1; XLA
+    derives the reduce-scatter/all-gather) — composable with TP sharding
+    of the other dims.  Stages 2/3 are the *pipeline* lowering's knob
+    (``parallel_builders.Pipeline(zero_stage=...)``); under gspmd the
+    stage-3 layout is :class:`FSDPSharded` (params stored data-sharded,
+    XLA inserts the gathers), so this builder rejects stage > 1 instead
+    of silently training stage-1 semantics.
     """
 
     def __init__(self, rules: Sequence[tuple[str, list]] = (), *,
-                 zero1: bool = False):
+                 zero_stage: int = None, zero1: bool = None):
+        from autodist_tpu.strategy.parallel_builders import \
+            _resolve_zero_stage
         self.rules = [(re.compile(pat), spec) for pat, spec in rules]
-        self.zero1 = zero1
+        stage = _resolve_zero_stage(zero_stage, zero1)
+        if stage > 1:
+            raise ValueError(
+                f"zero_stage={stage} under the gspmd lowering: use "
+                "FSDPSharded (the GSPMD-native sharded-parameter layout) "
+                "or the pipeline builder's zero_stage knob")
+        self.zero1 = bool(stage)
 
     def spec_for(self, info) -> Optional[list]:
         for pat, spec in self.rules:
@@ -84,9 +97,9 @@ class TensorParallel(Sharded):
     can extend/override the defaults."""
 
     def __init__(self, extra_rules: Sequence[tuple[str, list]] = (), *,
-                 zero1: bool = False):
+                 zero_stage: int = None, zero1: bool = None):
         super().__init__(tuple(extra_rules) + TRANSFORMER_TP_RULES,
-                         zero1=zero1)
+                         zero_stage=zero_stage, zero1=zero1)
 
 
 class FSDPSharded(Sharded):

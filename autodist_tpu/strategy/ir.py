@@ -70,6 +70,20 @@ class PSSynchronizer:
     ``staleness > 0`` (SSP, ``ps_synchronizer.py:387-458``) fundamentally
     fights SPMD lockstep; it is accepted in the IR and surfaced as a
     documented host-coordination extension (SURVEY.md §5.7 / §7).
+
+    ``zero_stage`` extends the PS semantics along the classic weight-
+    update-sharding ladder (arxiv 2004.13336):
+
+    * ``1`` — optimizer state sharded (the U_FLAT scheme above; the
+      default, and what every pre-stage strategy JSON deserializes to);
+    * ``2`` — gradients live sharded too.  The U_FLAT lowering already
+      reduce-scatters instead of all-reducing, so stages 1 and 2 emit
+      the same program; the stage is the *accounting* record — the cost
+      model charges the gradient term at 1/n only for stage >= 2.
+    * ``3`` — the parameter itself is *stored* sharded over the replica
+      axes and all-gathered on demand per layer inside the step (the
+      gathers are step-internal temporaries; nothing full-sized lives
+      across the step boundary).
     """
 
     kind: str = "ps"
@@ -77,6 +91,7 @@ class PSSynchronizer:
     local_replication: bool = False   # ≙ proxy variable; TPU: params re-gathered anyway
     sync: bool = True
     staleness: int = 0
+    zero_stage: int = 1
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -307,8 +322,11 @@ class Strategy:
                         else n.partitioner.partition_str)
                 if n.partitioner.comm_overlap:
                     part += f" overlap={n.partitioner.comm_overlap}"
+            detail = getattr(n.synchronizer, "compressor", "")
+            if n.synchronizer.kind == "ps":
+                detail = f"zero{getattr(n.synchronizer, 'zero_stage', 1)}"
             lines.append(
                 f"  {n.var_name}: sync={n.synchronizer.kind}"
-                f"({getattr(n.synchronizer, 'compressor', '')}) part={part}"
+                f"({detail}) part={part}"
                 f"{' sparse' if n.is_sparse else ''}")
         return "\n".join(lines)

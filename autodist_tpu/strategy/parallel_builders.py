@@ -54,40 +54,57 @@ PIPELINE_VOCAB_RULES = (
 )
 
 
-def _default_sync(zero1: bool, compressor: str,
+def _resolve_zero_stage(zero_stage, zero1) -> int:
+    """Canonicalize the ZeRO request: ``zero_stage`` ∈ {0, 1, 2, 3} is
+    the API (0 = off); ``zero1=True`` survives as a deprecated alias for
+    ``zero_stage=1`` (note: prefer ``zero_stage=`` — the boolean cannot
+    express stages 2/3 and will be removed)."""
+    if zero1 is not None and zero_stage is not None:
+        raise ValueError(
+            "pass either zero_stage= or the deprecated zero1= alias, "
+            "not both")
+    if zero1 is not None:
+        return 1 if zero1 else 0
+    if zero_stage is None:
+        return 0
+    if zero_stage not in (0, 1, 2, 3):
+        raise ValueError(
+            f"zero_stage must be 0 (off), 1, 2 or 3; got {zero_stage!r}")
+    return int(zero_stage)
+
+
+def _default_sync(zero_stage: int, compressor: str,
                   zero_min_bytes=None):
     """The per-variable synchronizer a parallel builder emits, as a
     function of the variable's :class:`~autodist_tpu.capture.VarInfo`:
-    PS ≙ ZeRO-1 sharded optimizer state (the reference's PS semantics on
-    TPU, ``ir.py:56-73``), AllReduce with an optional compressor
-    otherwise.
+    PS ≙ ZeRO sharding at the requested stage (the reference's PS
+    semantics on TPU, ``ir.py:56-95``), AllReduce with an optional
+    compressor otherwise.
 
     ``zero_min_bytes`` is the heterogeneous Parallax-style mix
     (``parallax_strategy.py:24-71``): variables at or above the
-    threshold get ZeRO-1, smaller ones the (optionally compressed)
-    allreduce — the classic big-tensors-sharded / small-tensors-cheap
-    split, per variable in the serialized strategy.  Arbitrary mixes
-    remain available by editing the emitted node configs before
-    ``AutoDist.build``."""
-    if zero1 and compressor not in ("", "none"):
-        raise ValueError(
-            "zero1 and compressor are mutually exclusive per variable: "
-            "PS (ZeRO-1) sync reduces at full precision; compression is "
-            "an AllReduce knob (zero_min_bytes composes them: large "
-            "vars ZeRO, small vars compressed)")
-    if zero1 and zero_min_bytes is not None:
-        raise ValueError(
-            "zero1=True already applies ZeRO-1 to every variable; a "
-            "zero_min_bytes threshold would be a silent no-op — pass "
-            "only zero_min_bytes for the size-split mix")
+    threshold get ZeRO (at ``zero_stage``, default stage 1), smaller
+    ones the (optionally compressed) allreduce — the classic
+    big-tensors-sharded / small-tensors-cheap split, per variable in the
+    serialized strategy.  Arbitrary mixes remain available by editing
+    the emitted node configs before ``AutoDist.build``."""
     comp = compressor or "none"
+    if zero_stage and comp != "none" and zero_min_bytes is None:
+        raise ValueError(
+            f"zero_stage={zero_stage} and compressor are mutually "
+            "exclusive per variable: PS (ZeRO) sync reduces at full "
+            "precision; compression is an AllReduce knob (zero_min_bytes "
+            "composes them: large vars ZeRO-staged, small vars "
+            "compressed)")
+    stage = zero_stage or 1   # the stage the threshold mix shards at
 
     def sync_for(info):
-        if zero_min_bytes is not None \
-                and info.byte_size >= zero_min_bytes:
-            return PSSynchronizer()
-        if zero1:
-            return PSSynchronizer()
+        if zero_min_bytes is not None:
+            if info.byte_size >= zero_min_bytes:
+                return PSSynchronizer(zero_stage=stage)
+            return AllReduceSynchronizer(compressor=comp)
+        if zero_stage:
+            return PSSynchronizer(zero_stage=zero_stage)
         return AllReduceSynchronizer(compressor=comp)
 
     return sync_for
@@ -105,10 +122,12 @@ class SequenceParallel(StrategyBuilder):
     """
 
     def __init__(self, seq_leaves: Sequence[str] = ("x", "y"), *,
-                 zero1: bool = False, compressor: str = "none",
-                 zero_min_bytes=None):
+                 zero_stage: int = None, zero1: bool = None,
+                 compressor: str = "none", zero_min_bytes=None):
         self.seq_leaves = tuple(seq_leaves)
-        self.make_sync = _default_sync(zero1, compressor, zero_min_bytes)
+        self.zero_stage = _resolve_zero_stage(zero_stage, zero1)
+        self.make_sync = _default_sync(self.zero_stage, compressor,
+                                       zero_min_bytes)
 
     def build(self, trainable, resource_spec):
         shape = resource_spec.resolved_mesh_shape()
@@ -173,7 +192,8 @@ class Pipeline(StrategyBuilder):
     """
 
     def __init__(self, num_microbatches: int = 1, virtual_stages: int = 1,
-                 *, zero1: bool = False, compressor: str = "none",
+                 *, zero_stage: int = None, zero1: bool = None,
+                 compressor: str = "none",
                  zero_min_bytes=None, remat: bool = False,
                  tensor_parallel: int = 1,
                  tp_rules: Sequence[tuple[str, list]] = None,
@@ -214,7 +234,13 @@ class Pipeline(StrategyBuilder):
                              else PIPELINE_VOCAB_RULES)]
         from autodist_tpu.parallel.tensor import normalize_comm_overlap
         self.comm_overlap = normalize_comm_overlap(comm_overlap)
-        self.make_sync = _default_sync(zero1, compressor, zero_min_bytes)
+        # ZeRO stage over the data axes (stage vars) / pipe x data
+        # (shared vars): 1 shards optimizer state, 2 additionally
+        # accounts the gradients sharded (same U_FLAT program), 3 stores
+        # the parameters sharded with per-layer on-demand gathers.
+        self.zero_stage = _resolve_zero_stage(zero_stage, zero1)
+        self.make_sync = _default_sync(self.zero_stage, compressor,
+                                       zero_min_bytes)
 
     def _tp_spec_for(self, name: str, stage_shape: tuple, tp: int):
         """Per-stage model-axis spec for a stage variable, or None.
@@ -372,7 +398,11 @@ class Pipeline(StrategyBuilder):
                         "remat": self.remat,
                         "tensor_parallel": tp,
                         "comm_overlap": self.comm_overlap,
-                        "vocab_parallel": self.vocab_parallel}
+                        "vocab_parallel": self.vocab_parallel,
+                        # Builder-level record (telemetry/manifest); the
+                        # authoritative per-variable stage lives in each
+                        # PSSynchronizer.zero_stage node config.
+                        "zero_stage": self.zero_stage}
         return Strategy(node_configs=nodes, graph_config=cfg)
 
 
@@ -394,11 +424,14 @@ class ExpertParallel(StrategyBuilder):
     """
 
     def __init__(self, expert_params: Sequence[str] = (),
-                 detect: bool = True, *, zero1: bool = False,
+                 detect: bool = True, *, zero_stage: int = None,
+                 zero1: bool = None,
                  compressor: str = "none", zero_min_bytes=None):
         self.expert_params = tuple(expert_params)
         self.detect = detect
-        self.make_sync = _default_sync(zero1, compressor, zero_min_bytes)
+        self.zero_stage = _resolve_zero_stage(zero_stage, zero1)
+        self.make_sync = _default_sync(self.zero_stage, compressor,
+                                       zero_min_bytes)
 
     def build(self, trainable, resource_spec):
         shape = resource_spec.resolved_mesh_shape()

@@ -104,12 +104,18 @@ def drift_report(strategy=None, cost_model=None,
             "feasible": predicted.feasible,
             "peak_logits_bytes": getattr(predicted, "peak_logits_bytes",
                                          0.0),
+            "param_shard_bytes": getattr(predicted, "param_shard_bytes",
+                                         0.0),
+            "grad_shard_bytes": getattr(predicted, "grad_shard_bytes",
+                                        0.0),
         }
 
     comm_s = float(predicted.get("comm_time_s") or 0.0)
     overlap_s = float(predicted.get("overlap_time_s") or 0.0)
     pred_mem = float(predicted.get("mem_bytes_per_device") or 0.0)
     pred_logits = float(predicted.get("peak_logits_bytes") or 0.0)
+    pred_param_shard = float(predicted.get("param_shard_bytes") or 0.0)
+    pred_grad_shard = float(predicted.get("grad_shard_bytes") or 0.0)
 
     compute_s = None
     wire_s = None
@@ -139,6 +145,11 @@ def drift_report(strategy=None, cost_model=None,
         # attribute an HBM delta between the replicated and
         # vocab-parallel configs to the logits term specifically.
         "peak_logits_bytes": pred_logits or None,
+        # Per-device param/grad storage — the terms the ZeRO stages
+        # divide (stage 2 the grads, stage 3 the params too); broken out
+        # so an HBM delta between stages attributes to the right term.
+        "param_shard_bytes": pred_param_shard or None,
+        "grad_shard_bytes": pred_grad_shard or None,
         "comm_bytes": predicted.get("comm_bytes"),
         "num_collectives": predicted.get("num_collectives"),
         "feasible": predicted.get("feasible"),
@@ -238,6 +249,10 @@ def drift_report(strategy=None, cost_model=None,
         tel.gauge("drift/mfu").set(mfu)
     if pred_logits > 0:
         tel.gauge("memory/peak_logits_bytes").set(pred_logits)
+    if pred_param_shard > 0:
+        tel.gauge("memory/param_shard_bytes").set(pred_param_shard)
+    if pred_grad_shard > 0:
+        tel.gauge("memory/grad_shard_bytes").set(pred_grad_shard)
 
     out_dir = out_dir or tel.out_dir
     if out_dir and tel.enabled:

@@ -58,9 +58,15 @@ def main():
                          "values exercise the zero-pad path)")
     ap.add_argument("--seq", type=int, default=16,
                     help="LM sequence length (with --vocab-parallel)")
+    ap.add_argument("--zero-stage", type=int, default=0,
+                    choices=[0, 1, 2, 3],
+                    help="ZeRO stage over the data axes (stage vars) / "
+                         "pipe x data (shared vars): 1 shards optimizer "
+                         "state, 2 accounts gradients sharded (same "
+                         "reduce-scatter program), 3 stores parameters "
+                         "sharded with per-layer on-demand all-gathers")
     ap.add_argument("--zero1", action="store_true",
-                    help="ZeRO-1: shard optimizer state over the data "
-                         "axes (stage vars) / pipe x data (shared vars)")
+                    help="deprecated alias for --zero-stage 1")
     ap.add_argument("--remat", action="store_true",
                     help="jax.checkpoint each chunk (memory for compute)")
     ap.add_argument("--hidden", type=int, default=64)
@@ -165,11 +171,12 @@ def main():
             x = r.randn(args.batch, HID).astype(np.float32)
             return {"x": x, "y": x @ target}
     overlap = None if args.comm_overlap == "off" else args.comm_overlap
+    zero_stage = max(args.zero_stage, 1 if args.zero1 else 0)
     builder = Pipeline(num_microbatches=args.microbatches,
                        virtual_stages=args.virtual_stages,
                        tensor_parallel=tp, comm_overlap=overlap,
                        vocab_parallel=args.vocab_parallel,
-                       zero1=args.zero1, remat=args.remat)
+                       zero_stage=zero_stage, remat=args.remat)
     if args.accum_steps > 1:
         builder = GradAccumulation(builder, steps=args.accum_steps)
 
@@ -188,7 +195,8 @@ def main():
 
     print(f"pipe={pp} x virtual={args.virtual_stages} "
           f"(C={C} chunks), dp={dp}, tp={tp}, M={args.microbatches}, "
-          f"comm_overlap={overlap}, vocab_parallel={args.vocab_parallel}; "
+          f"comm_overlap={overlap}, vocab_parallel={args.vocab_parallel}, "
+          f"zero_stage={zero_stage}; "
           f"schedule bubble = "
           f"{bubble_fraction(args.microbatches, pp, args.virtual_stages):.3f}")
 
@@ -201,6 +209,15 @@ def main():
     peak_logits = cost.peak_logits_bytes or None
     if peak_logits:
         telemetry.get().gauge("memory/peak_logits_bytes").set(peak_logits)
+    # The terms the ZeRO stages divide (stage 2: grads /n, stage 3:
+    # params /n too) ride the run as gauges so a hardware window can
+    # attribute the measured HBM delta between --zero-stage settings.
+    if cost.param_shard_bytes:
+        telemetry.get().gauge("memory/param_shard_bytes").set(
+            cost.param_shard_bytes)
+    if cost.grad_shard_bytes:
+        telemetry.get().gauge("memory/grad_shard_bytes").set(
+            cost.grad_shard_bytes)
 
     from contextlib import nullcontext
 
@@ -227,6 +244,10 @@ def main():
                     jax.block_until_ready(metrics)
             extra = {"peak_logits_bytes": peak_logits} if peak_logits \
                 else {}
+            if zero_stage:
+                extra["zero_stage"] = zero_stage
+                extra["param_shard_bytes"] = cost.param_shard_bytes
+                extra["grad_shard_bytes"] = cost.grad_shard_bytes
             telemetry.record_step(step=step,
                                   duration_s=time.perf_counter() - t_step,
                                   examples=args.batch, **extra)
@@ -241,9 +262,11 @@ def main():
         telemetry.annotate(mesh=mesh, microbatches=args.microbatches,
                            virtual_stages=args.virtual_stages,
                            comm_overlap=overlap, batch=args.batch,
-                           tensor_parallel=tp, zero1=args.zero1,
+                           tensor_parallel=tp, zero_stage=zero_stage,
                            vocab_parallel=args.vocab_parallel,
                            peak_logits_bytes=peak_logits,
+                           param_shard_bytes=cost.param_shard_bytes,
+                           grad_shard_bytes=cost.grad_shard_bytes,
                            remat=args.remat, step_summary=summary)
         report = telemetry.drift_report(
             strategy, CostModel(ad.resource_spec),

@@ -9,10 +9,11 @@ steps-per-loop scan each fail CI here, on CPU, before any hardware
 window."""
 import json
 
-from tools.hlo_probe import (buffers_with_dim, collective_counts, main,
+from tools.hlo_probe import (buffers_with_dim, collective_counts,
+                             entry_signature, main,
                              probe_collective_matmul, probe_pipeline_tp,
                              probe_single_replica, probe_steps_per_loop,
-                             probe_vocab_parallel)
+                             probe_vocab_parallel, probe_zero3)
 
 
 def test_collective_counts_parses_hlo_idioms():
@@ -97,6 +98,38 @@ def test_vocab_parallel_materializes_no_full_vocab_buffer():
     extra = (report["collectives_vocab_parallel"]["all-reduce"]
              - report["collectives_baseline"]["all-reduce"])
     assert extra >= 3, report
+
+
+def test_entry_signature_extracts_step_boundary():
+    text = """
+HloModule m
+%fused (p.0: f32[8,29]) -> f32[8,29] {
+  %p.0 = f32[8,29]{1,0} parameter(0)
+}
+ENTRY %main.1 (Arg_0.1: f32[2,116], Arg_1.2: s32[8]) -> (f32[2,116]) {
+  %big = f32[4,8,29]{2,1,0} all-gather(f32[2,116]{1,0} %x)
+}
+"""
+    sig = entry_signature(text)
+    # internal computations and step-internal temporaries are excluded
+    assert buffers_with_dim(sig, 29) == 0
+    assert buffers_with_dim(sig, 116) == 2
+
+
+def test_zero3_shards_step_boundary_and_gathers_per_layer():
+    """The ZeRO-2/3 re-materialization guard, tier-1 on CPU: a stage-3
+    program whose returned state regains a full parameter (e.g. a
+    reintroduced update all-gather), whose per-layer gathers collapse
+    into one bulk materialization (a collective-combiner pass undoing
+    the chain), or whose stage-2 grad sync regresses to an all-reduce,
+    fails CI here before any hardware window."""
+    report = probe_zero3()
+    assert report["boundary_full_param_buffers_stage0"] > 0
+    assert report["boundary_full_param_buffers_stage3"] == 0
+    assert (report["collectives_stage3"]["all-gather"]
+            >= report["min_per_layer_gathers"])
+    assert report["collectives_stage2"]["reduce-scatter"] >= 1
+    assert report["collectives_stage0"]["reduce-scatter"] == 0
 
 
 def test_probe_cli_json_output(tmp_path, capsys):

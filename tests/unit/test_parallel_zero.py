@@ -576,6 +576,164 @@ def test_sequence_int8_ring_compressor_over_tuple_axes():
         assert row.shape[0] == 8
 
 
+# --------------------------------------------------------------------------- #
+# ZeRO stages 2/3 on the 3D mesh (PR 6): goldens pinning loss/grad parity
+# of the higher stages against the stage-0/1 reference, composed with
+# dp x tp x vocab_parallel x bf16_ef, plus the non-divisible-leaf
+# padding edge.  Stage 2 lowers identically to stage 1 (the U_FLAT
+# scheme already reduce-scatters) so its parity is exact; stage 3 only
+# reorders the same gather/scatter sums, so it is pinned at the same
+# tolerance as the stage-1 goldens above.
+# --------------------------------------------------------------------------- #
+def _lm_cfg(vocab=32):
+    from autodist_tpu.models.transformer import TransformerConfig
+
+    return TransformerConfig(vocab_size=vocab, hidden_size=16, num_layers=2,
+                             num_heads=2, mlp_dim=32, max_len=8,
+                             dtype=jnp.float32, dropout_rate=0.0,
+                             attention_dropout_rate=0.0)
+
+
+def _make_lm(opt=None, vocab=32):
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+
+    return make_pipeline_lm_trainable(_lm_cfg(vocab), opt or optax.sgd(0.05),
+                                      jax.random.PRNGKey(0))
+
+
+def _lm_token_batches(n, vocab=32):
+    r = np.random.RandomState(3)
+    out = []
+    for _ in range(n):
+        x = r.randint(0, vocab, (8, 8)).astype(np.int32)
+        out.append({"x": x, "y": np.roll(x, -1, axis=1)})
+    return out
+
+
+_Z_SPECS = {
+    "dp4": ({"topology": {"platform": "cpu", "num_devices": 8},
+             "mesh": {"data": 4, "pipe": 2}}, 1, False),
+    "dp2_tp2": ({"topology": {"platform": "cpu", "num_devices": 8},
+                 "mesh": {"data": 2, "pipe": 2, "model": 2}}, 2, False),
+    "dp2_tp2_vocab": ({"topology": {"platform": "cpu", "num_devices": 8},
+                       "mesh": {"data": 2, "pipe": 2, "model": 2}}, 2, True),
+}
+
+
+@pytest.mark.parametrize("mesh_key", sorted(_Z_SPECS))
+@pytest.mark.parametrize("stage", [2, 3])
+def test_pipeline_zero_stages_match_reference(mesh_key, stage):
+    """Stages 2 and 3 reproduce the stage-0 AND stage-1 trajectories of
+    the pipelined LM for dp in {2,4} x tp in {1,2} x vocab_parallel
+    in {off,on}.  sgd at the TP-golden tolerance (repo precedent: adam's
+    eps nonlinearity amplifies ulp-level fp reordering on near-zero
+    grads; the adam-moment load-bearing coverage lives in the MLP and
+    padding-edge tests below, where the sum order is identical)."""
+    spec, tp, vocab_parallel = _Z_SPECS[mesh_key]
+    bs = _lm_token_batches(3)
+
+    def build(**kw):
+        return AutoDist(spec, "Pipeline", num_microbatches=2,
+                        tensor_parallel=tp, vocab_parallel=vocab_parallel,
+                        **kw).build(_make_lm(optax.sgd(0.05)))
+
+    r0 = build()
+    r1 = build(zero_stage=1)
+    rs = build(zero_stage=stage)
+    for b in bs:
+        m0 = r0.step(b, rng=jax.random.PRNGKey(0))
+        r1.step(b, rng=jax.random.PRNGKey(0))
+        ms = rs.step(b, rng=jax.random.PRNGKey(0))
+        np.testing.assert_allclose(float(np.asarray(m0["loss"])),
+                                   float(np.asarray(ms["loss"])),
+                                   rtol=1e-5)
+    assert_trees_close(rs.get_params(), r0.get_params(), rtol=1e-5,
+                       atol=1e-6)
+    assert_trees_close(rs.get_params(), r1.get_params(), rtol=1e-5,
+                       atol=1e-6)
+    if stage >= 3:
+        # stage-3 storage: non-tp stage leaves live as [C, padded]
+        # flat rows sharded P(pipe, data); shared ones flat (pipe, data)
+        ln = rs.state["params"]["shared"]["ln_final_scale"]
+        assert ln.ndim == 1 and ln.sharding.spec == P(("pipe", "data"))
+
+
+@pytest.mark.parametrize("stage", [2, 3])
+def test_pipeline_zero_stages_with_bf16_ef_mix(stage):
+    """The Parallax-style size split composes with the higher stages:
+    large variables ZeRO at the requested stage, small ones bf16_ef-
+    compressed — same mix at stage 1 is the bit-close reference (the
+    compression error is identical; the stage only reorders exact
+    sums)."""
+    kw = dict(num_microbatches=2, zero_min_bytes=512,
+              compressor="bf16_ef")
+    r1 = AutoDist(PIPE_SPEC, "Pipeline", zero_stage=1, **kw).build(
+        make_pipeline_trainable(optax.sgd(0.05)))
+    rs = AutoDist(PIPE_SPEC, "Pipeline", zero_stage=stage, **kw).build(
+        make_pipeline_trainable(optax.sgd(0.05)))
+    r_plain = AutoDist(PIPE_SPEC, "Pipeline", num_microbatches=2).build(
+        make_pipeline_trainable(optax.sgd(0.05)))
+    bs = pipe_batches(3)
+    for b in bs:
+        r1.step(b)
+        rs.step(b)
+        r_plain.step(b)
+    assert_trees_close(rs.get_params(), r1.get_params(), rtol=1e-5,
+                       atol=1e-6)
+    # EF keeps the mixed run near the uncompressed one (loose bound)
+    assert_trees_close(rs.get_params(), r_plain.get_params(), rtol=5e-2,
+                       atol=5e-3)
+    # the mix is heterogeneous: w [HID,HID] f32 = 256B < 512 threshold?
+    # HID=8 -> w is 8*8*4 = 256B, b 32B: everything below 512 would be
+    # uniform — assert the split actually split on this model.
+    from autodist_tpu.strategy.ir import PSSynchronizer
+    strat = AutoDist(PIPE_SPEC, "Pipeline", zero_stage=stage, **kw) \
+        .build_or_load_strategy(make_pipeline_trainable())
+    kinds = {n.var_name: isinstance(n.synchronizer, PSSynchronizer)
+             for n in strat.node_configs}
+    assert any(kinds.values()) and not all(kinds.values()), kinds
+    ps_stages = {n.var_name: n.synchronizer.zero_stage
+                 for n in strat.node_configs
+                 if isinstance(n.synchronizer, PSSynchronizer)}
+    assert set(ps_stages.values()) == {stage}
+
+
+def test_pipeline_zero3_non_divisible_leaf_padding():
+    """The padding edge: stage-leaf chunk sizes that do not divide the
+    data-replica count pad per chunk ([C, padded_chunk] rows), train
+    bit-close to the unsharded run, and fetch back unpadded."""
+    HID_ODD = 7   # chunk elems 49 / 7: neither divides dp=2
+
+    def make(opt=None):
+        r = np.random.RandomState(0)
+        stacked = {"w": jnp.asarray(r.randn(S_STAGES, HID_ODD, HID_ODD)
+                                    * 0.5, jnp.float32),
+                   "b": jnp.asarray(r.randn(S_STAGES, HID_ODD) * 0.1,
+                                    jnp.float32)}
+        return PipelineTrainable(mlp_stage, stacked, mse_head,
+                                 opt or optax.adam(1e-2),
+                                 num_stages=S_STAGES)
+
+    def batches(n):
+        r = np.random.RandomState(2)
+        return [{"x": r.randn(8, HID_ODD).astype(np.float32),
+                 "y": r.randn(8, HID_ODD).astype(np.float32)}
+                for _ in range(n)]
+
+    r0 = AutoDist(PIPE_SPEC, "Pipeline", num_microbatches=2).build(make())
+    r3 = AutoDist(PIPE_SPEC, "Pipeline", num_microbatches=2,
+                  zero_stage=3).build(make())
+    for b in batches(3):
+        r0.step(b)
+        r3.step(b)
+    assert_trees_close(r3.get_params(), r0.get_params(), rtol=1e-5,
+                       atol=1e-6)
+    # stored padded: w chunk = 49 elems -> 50 wide over dp=2
+    w = r3.state["params"]["w"]
+    assert w.shape == (S_STAGES, 50), w.shape
+    assert r3.get_params()["w"].shape == (S_STAGES, HID_ODD, HID_ODD)
+
+
 def test_expert_compressor_on_sharded_vars_sizes_ef_locally():
     """Stateful compressor on expert-SHARDED variables: the EF residual
     row is sized from the per-device shard (global size / E), not the

@@ -367,6 +367,89 @@ def test_pipeline_candidate_priced_for_pipeline_trainables():
     assert pipe.feasible and pipe.comm_bytes > 0
 
 
+def test_zero_stage_ladder_memory_and_election():
+    """The ZeRO rungs on the pipeline lowering: memory strictly
+    decreases stage 0 -> 1 -> 2 -> 3 (param/grad shard terms broken
+    out), step-time never improves over replication — so stage 3 ranks
+    above replication EXACTLY when the memory budget binds (the
+    feasibility gate, not the time term, elects it)."""
+    from autodist_tpu import PipelineTrainable
+    from autodist_tpu.strategy.parallel_builders import Pipeline
+
+    S = 4
+    r_ = np.random.RandomState(0)
+    stacked = {"w": jnp.asarray(r_.randn(S, 64, 64), jnp.float32)}
+    t = PipelineTrainable(lambda p, x: jnp.tanh(x @ p["w"]), stacked,
+                          lambda o, b: (jnp.mean(o ** 2), {}),
+                          optax.adam(1e-2), num_stages=S)
+    spec = ResourceSpec({"topology": {"platform": "cpu",
+                                      "num_devices": 8},
+                         "mesh": {"data": 2, "pipe": 4}})
+    cm = CostModel(spec)
+    costs = {s: cm.strategy_cost(
+        t, Pipeline(num_microbatches=2, zero_stage=s).build(t, spec))
+        for s in (0, 1, 2, 3)}
+    assert costs[1].mem_bytes_per_device < costs[0].mem_bytes_per_device
+    assert costs[2].mem_bytes_per_device < costs[1].mem_bytes_per_device
+    assert costs[3].mem_bytes_per_device < costs[2].mem_bytes_per_device
+    assert costs[2].grad_shard_bytes < costs[1].grad_shard_bytes
+    assert costs[3].param_shard_bytes < costs[2].param_shard_bytes
+    # never a step-time win: replication stays ahead when memory is free
+    for s in (1, 2, 3):
+        assert costs[s].comm_time_s >= costs[0].comm_time_s
+    # ... and a tokens hint must NOT turn stage 3 into a phantom speed
+    # lever: the gather-hiding credit is floored at the stage-1 rs+ag
+    # pair (replication's all-reduce hides behind backprop just as
+    # well, unmodeled on both sides).
+    t.tokens_per_step = 1 << 14
+    hinted = {s: cm.strategy_cost(
+        t, Pipeline(num_microbatches=2, zero_stage=s).build(t, spec))
+        for s in (0, 1, 3)}
+    assert hinted[3].comm_time_s >= hinted[1].comm_time_s
+    assert hinted[3].comm_time_s > hinted[0].comm_time_s
+    t.tokens_per_step = None
+    # shrink the budget between stage-1 and stage-3 footprints: only
+    # stage 3 survives the feasibility gate and out-scores everything
+    mid = (costs[1].mem_bytes_per_device
+           + costs[3].mem_bytes_per_device) / 2
+    cm2 = CostModel(spec, hbm_headroom=mid / (cm.chip.hbm_gb * 1e9))
+    bound = {s: cm2.strategy_cost(
+        t, Pipeline(num_microbatches=2, zero_stage=s).build(t, spec))
+        for s in (0, 1, 3)}
+    assert not bound[0].feasible and not bound[1].feasible
+    assert bound[3].feasible
+    assert bound[3].score < bound[0].score
+
+
+def test_zero_stage_alias_and_validation():
+    """zero1=True survives as the stage-1 alias; stage and compressor
+    stay mutually exclusive per variable (error names the stage) unless
+    zero_min_bytes splits them."""
+    from autodist_tpu.strategy.ir import PSSynchronizer
+    from autodist_tpu.strategy.parallel_builders import Pipeline
+
+    b = Pipeline(num_microbatches=2, zero1=True)
+    assert b.zero_stage == 1
+    with pytest.raises(ValueError, match="not both"):
+        Pipeline(num_microbatches=2, zero1=True, zero_stage=2)
+    with pytest.raises(ValueError, match="zero_stage=2"):
+        Pipeline(num_microbatches=2, zero_stage=2, compressor="bf16_ef")
+    # the size-split mix carries the stage on its PS side
+    mix = Pipeline(num_microbatches=2, zero_stage=3, zero_min_bytes=1,
+                   compressor="bf16_ef")
+    info = type("I", (), {"byte_size": 8, "is_sparse": False})()
+    sync = mix.make_sync(info)
+    assert isinstance(sync, PSSynchronizer) and sync.zero_stage == 3
+    # the IR round-trips the stage (chief -> worker handoff)
+    from autodist_tpu.strategy.ir import synchronizer_from_dict
+    clone = synchronizer_from_dict(PSSynchronizer(zero_stage=3).to_dict())
+    assert clone.zero_stage == 3
+    # pre-stage JSON (no zero_stage key) deserializes to stage 1
+    d = PSSynchronizer().to_dict()
+    d.pop("zero_stage")
+    assert synchronizer_from_dict(d).zero_stage == 1
+
+
 def test_calibration_file_overrides_factors(tmp_path, monkeypatch):
     import json
 

@@ -229,11 +229,13 @@ def test_vocab_parallel_comm_overlap_matches():
         runner.close()
 
 
-def test_vocab_parallel_zero1_degrades_on_embedding_and_matches():
-    """ZeRO-1 composes: the vocab-sharded embedding's PS request
-    degrades (its state already shards with the parameter — moments
-    stay P('model', None)), model-replicated shared vars still get flat
-    (pipe x data) moments, and numerics match the plain run."""
+def test_vocab_parallel_zero1_shards_embedding_state_and_matches():
+    """ZeRO composes with the vocab-sharded table *properly* (the
+    ROADMAP carry-over): instead of warn-and-degrade, the model-sharded
+    embedding's optimizer state shards ADDITIONALLY over pipe x data —
+    flat moments at 1/(tp·pipe·data), update space
+    P(('model','pipe','data')) — while model-replicated shared vars keep
+    their flat (pipe x data) moments, and numerics match the plain run."""
     r0 = AutoDist(SPEC_3D, "Pipeline", num_microbatches=2,
                   tensor_parallel=2, vocab_parallel=True).build(make_lm())
     r1 = AutoDist(SPEC_3D, "Pipeline", num_microbatches=2,
@@ -249,9 +251,39 @@ def test_vocab_parallel_zero1_degrades_on_embedding_and_matches():
                   zero1=True).build(make_lm(optax.adam(1e-2)))
     ra.step(lm_batches(1)[0], rng=jax.random.PRNGKey(0))
     mu = ra.state["opt_state"][0].mu
-    # trailing None is normalized away by NamedSharding
-    assert mu["shared"]["embedding"].sharding.spec == P("model")
+    emb = mu["shared"]["embedding"]
+    assert emb.ndim == 1
+    assert emb.sharding.spec == P(("model", "pipe", "data")), \
+        emb.sharding.spec
+    # the parameter itself keeps its model-axis storage (state-only
+    # extra sharding; the stored table is still [V_pad/tp, H] per shard)
+    assert ra.state["params"]["shared"]["embedding"].sharding.spec \
+        in (P("model"), P("model", None))
     ln = mu["shared"]["ln_final_scale"]
+    assert ln.ndim == 1 and ln.sharding.spec == P(("pipe", "data"))
+    # nothing degraded silently: the plan records no fallback for the
+    # table (tp-sharded stage vars do degrade, with reasons recorded)
+    deg = ra.lowered.zero_degraded
+    assert "shared/embedding" not in deg
+    assert any(k.startswith("stages/") for k in deg)
+
+
+def test_vocab_parallel_zero3_degrades_to_state_sharding_with_record():
+    """zero_stage=3 on the model-sharded table: the parameter is already
+    1/tp-sharded, so stage 3 degrades to the state-sharding form — and
+    the lowered plan records the reason (no log-warning contract)."""
+    r0 = AutoDist(SPEC_3D, "Pipeline", num_microbatches=2,
+                  tensor_parallel=2, vocab_parallel=True).build(make_lm())
+    r3 = AutoDist(SPEC_3D, "Pipeline", num_microbatches=2,
+                  tensor_parallel=2, vocab_parallel=True,
+                  zero_stage=3).build(make_lm())
+    for b in lm_batches(2):
+        r0.step(b, rng=jax.random.PRNGKey(0))
+        r3.step(b, rng=jax.random.PRNGKey(0))
+    assert_trees_close(r3.get_params(), r0.get_params())
+    assert "shared/embedding" in r3.lowered.zero_degraded
+    # model-replicated shared vars DO store stage-3 sharded
+    ln = r3.state["params"]["shared"]["ln_final_scale"]
     assert ln.ndim == 1 and ln.sharding.spec == P(("pipe", "data"))
 
 

@@ -30,6 +30,15 @@ asserts collective *counts and kinds* in the optimized HLO text:
   anywhere (distinctive-dimension shape scan), vs. the replicated
   baseline which carries the ``[V, H]`` table and ``[.., V]`` logits —
   a silent re-replication of the loss head fails CI on CPU.
+* ``probe_zero3`` — ZeRO-2/3 on the tp×dp mesh
+  (``Pipeline(zero_stage=...)``): the stage-3 program's *step boundary*
+  (the ENTRY signature: donated-in state + returned state) carries ZERO
+  buffers of the distinctive full-parameter extent — parameters live
+  only as flat shards between steps — while emitting >= per-layer
+  all-gathers (one per (virtual stage, leaf); a collective-combiner
+  pass merging them into one bulk materialization, or a re-gather of
+  full storage, fails here); the stage-2 program syncs gradients by
+  reduce-scatter where the stage-0 baseline has none.
 
 Run as a script for a JSON report::
 
@@ -88,6 +97,17 @@ def buffers_with_dim(hlo_text: str, dim: int) -> int:
         if dim in dims:
             hits += 1
     return hits
+
+
+def entry_signature(hlo_text: str) -> str:
+    """The ENTRY computation's definition line — every array that is
+    live ACROSS the step boundary (donated-in state, fed batch/rng,
+    returned state/metrics) appears in this signature; per-layer
+    gathers and other step-internal temporaries do not."""
+    for line in hlo_text.splitlines():
+        if line.startswith("ENTRY "):
+            return line
+    raise ValueError("no ENTRY computation in HLO text")
 
 
 def compiled_text(jitted, *args) -> str:
@@ -326,12 +346,132 @@ def probe_vocab_parallel() -> dict:
             "collectives_vocab_parallel": vp}
 
 
+# Distinctive dim of the probe's non-tp stage matrices: no activation,
+# batch, or other parameter carries it, so a hit in the ENTRY signature
+# IS a full parameter living across the step boundary.
+_Z3_DIM = 29
+_Z3_V = 2          # virtual stages = per-device layers
+_Z3_LEAVES = 3     # ZeRO-3 stage leaves: mix_in, mix_out, wo/bias
+
+
+def _zero_runner(zero_stage: int):
+    """dp×pp×tp pipeline (mesh {data:2, pipe:2, model:2}, V=2) whose
+    stage has Megatron wi/wo (tp-sharded; their ZeRO requests degrade,
+    state shards with the parameter) plus a non-tp ``mix`` pair carrying
+    the distinctive :data:`_Z3_DIM` — the variables the ZeRO stage
+    actually moves."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from autodist_tpu import AutoDist, PipelineTrainable
+    from autodist_tpu.parallel.tensor import column_parallel, row_parallel
+
+    HID, FF, C = 8, 16, 4
+    r = np.random.RandomState(0)
+    stacked = {
+        "wi": {"kernel": jnp.asarray(r.randn(C, HID, FF) * 0.3,
+                                     jnp.float32),
+               "bias": jnp.zeros((C, FF), jnp.float32)},
+        "wo": {"kernel": jnp.asarray(r.randn(C, FF, HID) * 0.3,
+                                     jnp.float32),
+               "bias": jnp.zeros((C, HID), jnp.float32)},
+        "mix_in": jnp.asarray(r.randn(C, HID, _Z3_DIM) * 0.3, jnp.float32),
+        "mix_out": jnp.asarray(r.randn(C, _Z3_DIM, HID) * 0.3, jnp.float32),
+    }
+
+    def stage_fn(p, x, model_axis=None, comm_overlap=None):
+        h = jax.nn.relu(column_parallel(x, p["wi"]["kernel"],
+                                        p["wi"]["bias"],
+                                        model_axis=model_axis))
+        y = row_parallel(h, p["wo"]["kernel"], p["wo"]["bias"],
+                         model_axis=model_axis)
+        return y + jnp.tanh(y @ p["mix_in"]) @ p["mix_out"]
+
+    def head(outputs, batch):
+        return jnp.mean((outputs - batch["y"]) ** 2), {}
+
+    trainable = PipelineTrainable(stage_fn, stacked, head, optax.adam(1e-2),
+                                  num_stages=C)
+    spec = {"topology": {"platform": "cpu", "num_devices": 8},
+            "mesh": {"data": 2, "pipe": 2, "model": 2}}
+    return AutoDist(spec, "Pipeline", num_microbatches=2,
+                    virtual_stages=_Z3_V, tensor_parallel=2,
+                    zero_stage=zero_stage).build(trainable)
+
+
+@functools.lru_cache(maxsize=None)
+def _zero_step_text(zero_stage: int) -> str:
+    import jax
+    import numpy as np
+
+    r = np.random.RandomState(0)
+    batch = {"x": r.randn(8, 8).astype(np.float32),
+             "y": r.randn(8, 8).astype(np.float32)}
+    runner = _zero_runner(zero_stage)
+    try:
+        return compiled_text(runner.lowered.step_fn, runner.state,
+                             runner._place_batch(batch),
+                             jax.random.PRNGKey(0))
+    finally:
+        runner.close()
+
+
+def probe_zero3() -> dict:
+    """ZeRO-2/3 on the tp×dp pipeline, structurally: the stage-3
+    program stores parameters ONLY as flat shards across the step
+    boundary (zero ENTRY-signature buffers of the distinctive extent,
+    vs. the stage-0 baseline whose state carries them — a re-gather of
+    full storage, or a re-materialization surviving into the returned
+    state, fails here) while emitting >= one all-gather per (layer,
+    leaf) — the per-layer on-demand gathers; a combiner pass collapsing
+    them into one bulk up-front gather drops the count below
+    layers x leaves and fails.  Stage 2 syncs gradients by
+    reduce-scatter where the stage-0 baseline emits none."""
+    t0 = _zero_step_text(0)
+    t2 = _zero_step_text(2)
+    t3 = _zero_step_text(3)
+    c0, c2, c3 = map(collective_counts, (t0, t2, t3))
+    boundary0 = buffers_with_dim(entry_signature(t0), _Z3_DIM)
+    boundary3 = buffers_with_dim(entry_signature(t3), _Z3_DIM)
+    assert boundary0 > 0, (
+        "stage-0 baseline shows no full-parameter buffer at the step "
+        "boundary — the probe's distinctive-dim scan is broken, not "
+        "proving anything")
+    assert boundary3 == 0, (
+        f"stage-3 program carries {boundary3} full-parameter buffer(s) "
+        f"(dim {_Z3_DIM}) across the step boundary — parameters must "
+        "live only as ZeRO shards between steps")
+    min_gathers = _Z3_V * _Z3_LEAVES
+    assert c3["all-gather"] >= min_gathers, (
+        f"stage-3 program emits {c3['all-gather']} all-gather(s); "
+        f"expected >= {min_gathers} (one per (virtual stage, leaf)) — "
+        "the per-layer gathers collapsed into a bulk materialization")
+    assert c3["reduce-scatter"] >= 1, (
+        f"stage-3 program emits no reduce-scatter: {c3} — the gather's "
+        "custom VJP should scatter gradients into shard form")
+    assert c0["reduce-scatter"] == 0, (
+        f"stage-0 baseline unexpectedly reduce-scatters: {c0}")
+    assert c2["reduce-scatter"] >= 1, (
+        f"stage-2 program syncs gradients without a reduce-scatter: "
+        f"{c2} — the ZeRO grad sync regressed to an all-reduce")
+    return {"distinctive_dim": _Z3_DIM,
+            "boundary_full_param_buffers_stage0": boundary0,
+            "boundary_full_param_buffers_stage3": boundary3,
+            "min_per_layer_gathers": min_gathers,
+            "collectives_stage0": c0,
+            "collectives_stage2": c2,
+            "collectives_stage3": c3}
+
+
 PROBES = {
     "steps_per_loop": probe_steps_per_loop,
     "single_replica": probe_single_replica,
     "pipeline_tp": probe_pipeline_tp,
     "collective_matmul": probe_collective_matmul,
     "vocab_parallel": probe_vocab_parallel,
+    "zero3": probe_zero3,
 }
 
 
