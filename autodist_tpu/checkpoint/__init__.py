@@ -1,6 +1,8 @@
 """Checkpointing and serving export (≙ reference ``autodist/checkpoint/``)."""
 from autodist_tpu.checkpoint.export import (ExportedModel, export_model,
-                                            load_exported)
+                                            load_exported,
+                                            load_exported_params)
 from autodist_tpu.checkpoint.saver import Saver
 
-__all__ = ["Saver", "export_model", "load_exported", "ExportedModel"]
+__all__ = ["Saver", "export_model", "load_exported",
+           "load_exported_params", "ExportedModel"]

@@ -75,14 +75,53 @@ def export_model(path: str, apply_fn: Callable, params: Any,
     with open(os.path.join(path, _APPLY_FILE), "wb") as f:
         f.write(exported.serialize())
 
-    # 3. Manifest.
+    # 3. Manifest — including the params tree's shapes/dtypes, so
+    # restore can hand orbax an explicit target (topology-independent,
+    # no UNSAFE untyped restore) and serving engines can validate the
+    # artifact carries unpadded logical shapes.
     with open(os.path.join(path, _META_FILE), "w") as f:
         json.dump({"inputs": jax.tree.map(
             lambda s: {"shape": list(s.shape), "dtype": str(s.dtype)},
             abstract[1:], is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct)),
-            "num_inputs": len(sample_inputs)}, f, indent=2)
+            "num_inputs": len(sample_inputs),
+            "params": jax.tree.map(
+                lambda x: {"shape": list(np.shape(x)),
+                           "dtype": str(np.asarray(x).dtype)}, params)},
+            f, indent=2)
     logging.info("serving export written to %s", path)
     return path
+
+
+def _params_target(meta: dict):
+    """Rebuild the params restore target (``ShapeDtypeStruct`` tree)
+    from the manifest written at export time; ``None`` for artifacts
+    predating the ``params`` manifest entry (untyped restore)."""
+    spec = meta.get("params")
+    if spec is None:
+        return None
+    return jax.tree.map(
+        lambda d: jax.ShapeDtypeStruct(tuple(d["shape"]),
+                                       np.dtype(d["dtype"])),
+        spec, is_leaf=lambda d: isinstance(d, dict)
+        and set(d) == {"shape", "dtype"})
+
+
+def load_exported_params(path: str):
+    """Restore just the ``params/`` tree of an artifact (logical names,
+    unpadded shapes) — what a serving engine that re-shards parameters
+    itself (``autodist_tpu.serving``) needs, without deserializing the
+    StableHLO program."""
+    meta = {}
+    meta_path = os.path.join(path, _META_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    ckpt = ocp.StandardCheckpointer()
+    target = _params_target(meta)
+    params_dir = os.path.join(os.path.abspath(path), _PARAMS_DIR)
+    if target is None:
+        return ckpt.restore(params_dir)
+    return ckpt.restore(params_dir, target)
 
 
 class ExportedModel:
@@ -104,8 +143,11 @@ def load_exported(path: str) -> ExportedModel:
 
     with open(os.path.join(path, _APPLY_FILE), "rb") as f:
         exported = jax_export.deserialize(f.read())
-    ckpt = ocp.StandardCheckpointer()
-    params = ckpt.restore(os.path.join(os.path.abspath(path), _PARAMS_DIR))
     with open(os.path.join(path, _META_FILE)) as f:
         meta = json.load(f)
+    ckpt = ocp.StandardCheckpointer()
+    target = _params_target(meta)
+    params_dir = os.path.join(os.path.abspath(path), _PARAMS_DIR)
+    params = (ckpt.restore(params_dir) if target is None
+              else ckpt.restore(params_dir, target))
     return ExportedModel(exported.call, params, meta)

@@ -38,7 +38,7 @@ def _flax_layer_norm(x, p, dtype, eps=1e-6):
 
 
 def _tp_encoder_layer(cfg: TransformerConfig, chunk, x, mask, model_axis,
-                      comm_overlap=None):
+                      comm_overlap=None, return_kv=False):
     """One encoder layer on Megatron-sharded chunk params.
 
     The flax :class:`EncoderLayer` math, open-coded so the two
@@ -56,6 +56,12 @@ def _tp_encoder_layer(cfg: TransformerConfig, chunk, x, mask, model_axis,
     ring at the row boundaries — see
     :mod:`autodist_tpu.parallel.tensor`); same math, different
     summation order.
+
+    ``return_kv=True`` additionally returns this layer's (local-head)
+    k/v projections — the serving engine's prefill
+    (:mod:`autodist_tpu.serving.engine`) fills its KV cache from the
+    SAME layer definition training runs, so decode-vs-training
+    numerics cannot drift through a copied implementation.
     """
     from autodist_tpu.parallel.tensor import column_parallel, row_parallel
 
@@ -83,7 +89,27 @@ def _tp_encoder_layer(cfg: TransformerConfig, chunk, x, mask, model_axis,
     m = row_parallel(h, chunk["mlp"]["wo"]["kernel"].astype(dtype),
                      chunk["mlp"]["wo"]["bias"].astype(dtype),
                      model_axis=model_axis, comm_overlap=comm_overlap)
-    return _flax_layer_norm(x + m, chunk["ln_mlp"], dtype)
+    y = _flax_layer_norm(x + m, chunk["ln_mlp"], dtype)
+    return (y, k, v) if return_kv else y
+
+
+def sequential_logits(cfg: TransformerConfig, params, tokens):
+    """Full-sequence next-token logits on one device — the sequential
+    reference apply for the pipelined LM's logical params tree
+    (``{"stages": ..., "shared": ...}``).  The single definition the
+    serving-export artifact, the decode goldens, and any full-recompute
+    consumer share: embedding + positions → every encoder layer
+    (:func:`_tp_encoder_layer`, ``model_axis=None``) → final norm →
+    tied unembedding, returning ``[B, L, V]`` fp32 logits."""
+    stages, shared = params["stages"], params["shared"]
+    L = tokens.shape[1]
+    x = shared["embedding"][tokens] + shared["pos_embed"][None, :L]
+    mask = jnp.tril(jnp.ones((L, L), bool))[None, None]
+    for i in range(cfg.num_layers):
+        chunk = jax.tree.map(lambda a, _i=i: a[_i], stages)
+        x = _tp_encoder_layer(cfg, chunk, x, mask, None)
+    x = _layer_norm(x, shared["ln_final_scale"], shared["ln_final_bias"])
+    return x @ shared["embedding"].T.astype(jnp.float32)
 
 
 def make_pipeline_lm_trainable(cfg: TransformerConfig, optimizer, rng, *,

@@ -430,6 +430,41 @@ def vocab_parallel_cross_entropy(x, embedding, targets, *, vocab_size: int,
     return xent(x, embedding)
 
 
+def vocab_parallel_greedy_token(x, embedding, *, vocab_size: int,
+                                model_axis=None):
+    """Greedy next-token ids from *last-position* hidden states against a
+    (possibly vocab-sharded) tied unembedding — the decode-time epilogue.
+
+    ``x``: ``[B, H]`` final hidden states (one position per sequence —
+    a decode step never materializes full-sequence logits);
+    ``embedding``: the local ``[V_pad/tp, H]`` shard (full ``[V, H]``
+    table when ``model_axis`` is ``None``).  Returns ``(token, logit)``:
+    the argmax token id ``[B]`` int32 and its logit ``[B]`` fp32.
+
+    The live logits buffer is bounded at ``[B, V/tp]``: each shard
+    proposes its local argmax, a ``pmax`` finds the global max logit and
+    a ``pmin`` over candidate ids resolves ties to the smallest id —
+    exactly :func:`vocab_parallel_cross_entropy`'s prediction semantics,
+    so greedy decode agrees token-for-token with the training-time
+    ``pred`` metric.  Zero-padded vocab rows (``V % tp != 0``) are
+    masked to ``-inf`` and can never be sampled.  ``model_axis=None``
+    runs the same math on the full table (the sequential-reference path
+    the decode goldens compare against).
+    """
+    rows = embedding.shape[0]
+    logits = jnp.tensordot(x.astype(jnp.float32),
+                           embedding.astype(jnp.float32).T, axes=1)
+    start = 0 if model_axis is None else lax.axis_index(model_axis) * rows
+    valid = (start + jnp.arange(rows)) < vocab_size
+    logits = jnp.where(valid, logits, jnp.finfo(jnp.float32).min)
+    m_loc = jnp.max(logits, axis=-1)
+    m = m_loc if model_axis is None else lax.pmax(m_loc, model_axis)
+    am = (start + jnp.argmax(logits, axis=-1)).astype(jnp.int32)
+    cand = jnp.where(m_loc >= m, am, jnp.int32(vocab_size))
+    tok = cand if model_axis is None else lax.pmin(cand, model_axis)
+    return tok, m
+
+
 def column_parallel(x, kernel, bias=None, *, model_axis=None, axes: int = 1,
                     comm_overlap=None):
     """``x @ kernel (+ bias)`` with the kernel's *output* dims sharded.

@@ -1,0 +1,57 @@
+"""Batched inference on the Strategy IR (ROADMAP: the serving path).
+
+The training stack already owns the hard parts of an inference engine —
+the TP lowering's collective boundaries, the vocab-parallel unembedding,
+the steps-per-loop fused dispatch; this package adds the decode loop:
+
+* :mod:`~autodist_tpu.serving.kv_cache` — TP-sharded KV cache
+  (``[layer, slot, heads/tp, max_len, head_dim]``, in-place
+  ``dynamic_update_slice`` writes);
+* :mod:`~autodist_tpu.serving.engine` — prefill/decode split with a
+  fused multi-token decode loop and last-position-only logits;
+* :mod:`~autodist_tpu.serving.batcher` — continuous batching with a
+  request queue, slot allocation/eviction, and per-token telemetry.
+
+Typical use (see ``docs/usage/serving.md`` / ``examples/serve.py``)::
+
+    from autodist_tpu import serving
+
+    engine = serving.serve(cfg, runner=runner, strategy=strategy,
+                           tensor_parallel=2, vocab_parallel=True)
+    batcher = serving.ContinuousBatcher(engine)
+    rid = batcher.submit([1, 5, 3], max_new_tokens=32, eos_id=2)
+    out = batcher.run()[rid].tokens
+"""
+from autodist_tpu.serving.batcher import (Completion, ContinuousBatcher,
+                                          Request)
+from autodist_tpu.serving.engine import ServingEngine, serving_param_specs
+from autodist_tpu.serving.kv_cache import KVCache, init_cache
+
+__all__ = [
+    "ServingEngine", "ContinuousBatcher", "Request", "Completion",
+    "KVCache", "init_cache", "serve", "serving_param_specs",
+]
+
+
+def serve(cfg, *, params=None, runner=None, artifact=None, strategy=None,
+          **engine_kwargs) -> ServingEngine:
+    """Build a :class:`ServingEngine` from whichever form the trained
+    model is in: a live ``runner`` (parameters fetched through the
+    gather/unpad path — any training strategy), a ``checkpoint/export``
+    ``artifact`` directory, or a logical ``params`` tree.  A training
+    ``strategy`` seeds the serving parallelism knobs from its Strategy
+    IR (``tensor_parallel``/``vocab_parallel``/``comm_overlap``) unless
+    explicitly overridden."""
+    sources = [s for s in (params, runner, artifact) if s is not None]
+    if len(sources) != 1:
+        raise ValueError(
+            "serve() needs exactly one of params=, runner=, artifact=")
+    if runner is not None:
+        return ServingEngine.from_runner(runner, cfg, strategy=strategy,
+                                         **engine_kwargs)
+    from autodist_tpu.serving.engine import seed_engine_kwargs
+
+    engine_kwargs = seed_engine_kwargs(engine_kwargs, strategy)
+    if artifact is not None:
+        return ServingEngine.from_artifact(artifact, cfg, **engine_kwargs)
+    return ServingEngine(cfg, params, **engine_kwargs)

@@ -1,0 +1,251 @@
+"""Continuous batching: a request queue feeding the fused decode loop.
+
+The serving-side counterpart of the training stack's steps-per-loop
+discipline: requests of ragged lengths share ONE compiled prefill and
+ONE compiled decode program — slots that are empty or whose request
+already finished ride along masked (``active=False`` holds their state),
+so admission and eviction never trigger a recompile.  A request's life:
+
+    submit() → queue → slot admission (batched prefill; TTFT stops
+    here — the prefill emits the first token) → fused decode windows
+    (``decode_steps`` tokens per dispatch) → eviction on EOS, token
+    budget, or the cache's ``max_len`` → slot freed for the next
+    admission.
+
+Because every slot's computation depends only on its own cache lane and
+token (batch ops are elementwise/vmapped; the model-axis psums reduce
+over devices, not slots), a request decodes the exact same tokens
+whether it runs alone or interleaved with arrivals and departures — the
+property the continuous-batching goldens pin.
+
+Per-token telemetry flows through the PR 4 sink: ``serve/ttft_ms`` and
+``serve/inter_token_ms`` histograms (a fused window attributes
+``window/K`` to each of its tokens), ``serve/queue_depth`` gauge,
+``serve/requests``/``serve/tokens`` counters, and one ``kind="serve"``
+record per completed request (rendered by ``tools/telemetry_report.py``,
+schema-gated by its ``--check``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from autodist_tpu import telemetry
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request (token ids in, token ids out)."""
+
+    rid: str
+    prompt: list
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    submit_s: float = 0.0
+
+
+@dataclasses.dataclass
+class Completion:
+    """A finished request's output + its latency facts."""
+
+    rid: str
+    tokens: list                 # generated ids (EOS included when hit)
+    finish_reason: str           # "eos" | "max_tokens" | "max_len"
+    ttft_s: float                # submit -> first token available
+    queue_wait_s: float          # submit -> slot admission
+    decode_s: float              # first token -> last token
+    inter_token_ms: list         # per-token latency (window/K attributed)
+
+    @property
+    def tokens_per_sec(self) -> Optional[float]:
+        total = self.ttft_s + self.decode_s
+        return len(self.tokens) / total if total > 0 and self.tokens \
+            else None
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: Request
+    tokens: list
+    admitted_s: float
+    first_tok_s: float
+    inter_token_ms: list
+    done: Optional[str] = None   # finish reason once terminal
+
+
+class ContinuousBatcher:
+    """Drives a :class:`~autodist_tpu.serving.engine.ServingEngine`
+    from a request queue with slot allocation and eviction."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._queue: deque[Request] = deque()
+        self._slots: list[Optional[_Slot]] = [None] * engine.num_slots
+        self._ids = itertools.count()
+        self.completions: dict[str, Completion] = {}
+
+    # ------------------------------------------------------------------ #
+    def submit(self, prompt, *, max_new_tokens: int = 16,
+               eos_id: Optional[int] = None, rid: Optional[str] = None) -> str:
+        """Queue one request; returns its id.  Prompts must fit the
+        engine's prompt bucket; a budget exceeding the cache capacity
+        is accepted but the request truncates at capacity
+        (``finish_reason="max_len"``)."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) > self.engine.prefill_len:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens exceeds the engine's "
+                f"prefill_len={self.engine.prefill_len}")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        rid = rid if rid is not None else f"req-{next(self._ids)}"
+        self._queue.append(Request(rid=rid, prompt=prompt,
+                                   max_new_tokens=int(max_new_tokens),
+                                   eos_id=eos_id,
+                                   submit_s=time.perf_counter()))
+        telemetry.gauge("serve/queue_depth").set(len(self._queue))
+        return rid
+
+    @property
+    def active_slots(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    # ------------------------------------------------------------------ #
+    def _admit(self):
+        """Fill free slots from the queue with ONE batched prefill."""
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free or not self._queue:
+            return
+        B, S = self.engine.num_slots, self.engine.prefill_len
+        prompts = np.zeros((B, S), np.int32)
+        p_lens = np.ones((B,), np.int32)
+        admit = np.zeros((B,), bool)
+        taken: list[tuple[int, Request]] = []
+        now = time.perf_counter()
+        for i in free:
+            if not self._queue:
+                break
+            req = self._queue.popleft()
+            prompts[i, :len(req.prompt)] = req.prompt
+            p_lens[i] = len(req.prompt)
+            admit[i] = True
+            taken.append((i, req))
+        telemetry.gauge("serve/queue_depth").set(len(self._queue))
+        with telemetry.span("serve/prefill", admitted=len(taken)):
+            toks = self.engine.prefill(prompts, p_lens, admit)
+        t_first = time.perf_counter()
+        for i, req in taken:
+            slot = _Slot(req=req, tokens=[int(toks[i])], admitted_s=now,
+                         first_tok_s=t_first, inter_token_ms=[])
+            ttft = t_first - req.submit_s
+            telemetry.histogram("serve/ttft_ms").observe(ttft * 1e3)
+            telemetry.counter("serve/tokens").inc()
+            self._slots[i] = slot
+            self._check_terminal(i)
+
+    def _check_terminal(self, i: int):
+        """Mark slot ``i`` done on EOS / token budget / cache capacity
+        (truncating anything decoded past the terminal token).  Both
+        caps apply BEFORE the EOS scan: an EOS landing beyond
+        ``max_new_tokens`` — or beyond the cache capacity, where the
+        window's clamped writes have already corrupted the last lane —
+        within the same fused window must not stretch the request."""
+        slot = self._slots[i]
+        req = slot.req
+        # tokens decoded while every prior token still fit a cache lane
+        cap = max(1, self.engine.max_len - len(req.prompt))
+        limit = min(req.max_new_tokens, cap)
+        budgeted = slot.tokens[:limit]
+        if req.eos_id is not None and req.eos_id in budgeted:
+            slot.tokens = budgeted[:budgeted.index(req.eos_id) + 1]
+            slot.done = "eos"
+        elif len(slot.tokens) >= limit:
+            slot.tokens = budgeted
+            slot.done = ("max_tokens" if limit == req.max_new_tokens
+                         else "max_len")
+
+    def _evict(self, i: int):
+        slot = self._slots[i]
+        req = slot.req
+        t_end = time.perf_counter()
+        comp = Completion(
+            rid=req.rid, tokens=list(slot.tokens),
+            finish_reason=slot.done,
+            ttft_s=slot.first_tok_s - req.submit_s,
+            queue_wait_s=slot.admitted_s - req.submit_s,
+            decode_s=t_end - slot.first_tok_s,
+            inter_token_ms=list(slot.inter_token_ms))
+        self.completions[req.rid] = comp
+        self._slots[i] = None
+        telemetry.counter("serve/requests").inc()
+        itl = np.asarray(comp.inter_token_ms) if comp.inter_token_ms \
+            else None
+        telemetry.get().record_event(
+            "serve", request=req.rid,
+            prompt_tokens=len(req.prompt), tokens=len(comp.tokens),
+            finish=comp.finish_reason,
+            ttft_ms=comp.ttft_s * 1e3,
+            queue_wait_ms=comp.queue_wait_s * 1e3,
+            inter_token_p50_ms=(float(np.percentile(itl, 50))
+                                if itl is not None else None),
+            inter_token_p99_ms=(float(np.percentile(itl, 99))
+                                if itl is not None else None),
+            tokens_per_sec=comp.tokens_per_sec)
+
+    def _decode_window(self):
+        """One fused decode dispatch; distribute tokens, evict terminal
+        slots."""
+        active = np.array([s is not None and s.done is None
+                           for s in self._slots], bool)
+        if not active.any():
+            return
+        K = self.engine.decode_steps
+        t0 = time.perf_counter()
+        with telemetry.span("serve/decode", tokens=int(active.sum()) * K):
+            toks = self.engine.decode(active)      # [K, B]
+        dt = time.perf_counter() - t0
+        per_tok_ms = dt / K * 1e3
+        for i, slot in enumerate(self._slots):
+            if slot is None or not active[i]:
+                continue
+            before = len(slot.tokens)
+            slot.tokens.extend(int(toks[k, i]) for k in range(K))
+            self._check_terminal(i)
+            # Only tokens the request actually keeps count: a window's
+            # over-decode past EOS/budget is discarded above, and the
+            # counters/histograms must agree with the per-request
+            # serve records the report aggregates.
+            kept = max(0, len(slot.tokens) - before)
+            slot.inter_token_ms.extend([per_tok_ms] * kept)
+            for _ in range(kept):
+                telemetry.histogram("serve/inter_token_ms").observe(
+                    per_tok_ms)
+            telemetry.counter("serve/tokens").inc(kept)
+
+    # ------------------------------------------------------------------ #
+    def step(self):
+        """One scheduler round: evict finished, admit, decode."""
+        for i, slot in enumerate(self._slots):
+            if slot is not None and slot.done is not None:
+                self._evict(i)
+        self._admit()
+        self._decode_window()
+
+    def run(self) -> dict[str, Completion]:
+        """Drain the queue and every in-flight request; returns
+        ``{rid: Completion}`` for the requests finished DURING this
+        call (a long-lived server loop calling ``run()`` per admission
+        round must not re-receive old completions; the full history
+        stays on :attr:`completions`)."""
+        before = set(self.completions)
+        while self._queue or self.active_slots:
+            self.step()
+        return {rid: c for rid, c in self.completions.items()
+                if rid not in before}

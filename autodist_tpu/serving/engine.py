@@ -1,0 +1,419 @@
+"""Batched-inference engine on the Strategy IR's tensor-parallel specs.
+
+The decode program reuses the training stack's hard parts instead of
+growing a second model implementation:
+
+* **Prefill** runs the prompt through the same column/row-parallel
+  matmul boundaries as the training stage_fn
+  (:mod:`autodist_tpu.parallel.tensor` — the ``PartitionerConfig`` spec
+  table that answers "how do I train this" also answers "how do I serve
+  it", the GSPMD one-IR property), filling the TP-sharded KV cache and
+  emitting the first token from *last-position-only* logits.
+* **Decode** runs a fused multi-step loop — the ``run_steps``
+  steps-per-loop idea repurposed for token steps: one ``lax.scan`` body
+  per token, one host dispatch per ``decode_steps`` tokens — attending
+  over the cache via in-place ``dynamic_update_slice`` writes.  The
+  greedy epilogue (:func:`~autodist_tpu.parallel.tensor
+  .vocab_parallel_greedy_token`) keeps the live logits at ``[B, V/tp]``,
+  so a decode step never materializes a full-vocab or full-sequence
+  buffer (``tools/hlo_probe.py --probe decode`` asserts both
+  structurally).
+
+Parameters arrive in the *logical* layout every fetch path produces —
+``runner.get_params()`` from a live pipelined-LM runner, or the
+``params/`` tree of a ``checkpoint/export.py`` artifact — and the
+engine shards them itself from the same rule tables the ``Pipeline``
+builder records in the Strategy IR (``PIPELINE_TP_RULES`` /
+``PIPELINE_VOCAB_RULES``).  Use :func:`autodist_tpu.serving.serve` for
+the entry-point conveniences.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from autodist_tpu import const
+from autodist_tpu.serving import kv_cache
+from autodist_tpu.parallel.tensor import (column_parallel,
+                                          normalize_comm_overlap,
+                                          row_parallel, vocab_pad,
+                                          vocab_parallel_embedding,
+                                          vocab_parallel_greedy_token)
+
+
+def serving_param_specs(params, tp: int, vocab_parallel: bool):
+    """Per-leaf ``PartitionSpec`` tree for the serving mesh, from the
+    SAME rule tables the ``Pipeline`` builder writes into the Strategy
+    IR: stage leaves keep their stacked leading layer dim unsharded and
+    shard the Megatron dims the tp rules name; the shared tied table
+    shards its vocab dim iff ``vocab_parallel``; everything else
+    replicates."""
+    import re
+
+    from autodist_tpu.kernel import common
+    from autodist_tpu.strategy.parallel_builders import (
+        PIPELINE_TP_RULES, PIPELINE_VOCAB_RULES)
+
+    tp_rules = [(re.compile(p), s) for p, s in PIPELINE_TP_RULES]
+    v_rules = [(re.compile(p), s) for p, s in PIPELINE_VOCAB_RULES]
+
+    def spec_for(name, leaf):
+        shape = tuple(np.shape(leaf))
+        if tp > 1 and name.startswith("stages/"):
+            for pat, spec in tp_rules:
+                if pat.search(name) and len(spec) == len(shape) - 1:
+                    for dim, axis in zip(shape[1:], spec):
+                        if axis == const.MODEL_AXIS and dim % tp:
+                            raise ValueError(
+                                f"{name}: dim {dim} does not divide by "
+                                f"tensor_parallel={tp}")
+                    return P(None, *spec)
+        if tp > 1 and vocab_parallel and name.startswith("shared/"):
+            short = name[len("shared/"):]
+            for pat, spec in v_rules:
+                if pat.search(short) and len(spec) == len(shape):
+                    return P(*spec)
+        return P()
+
+    return common.tree_from_names(params, spec_for)
+
+
+def seed_engine_kwargs(engine_kwargs: dict, strategy) -> dict:
+    """Default the serving parallelism knobs from a training strategy's
+    Strategy-IR ``parallel`` record (explicit kwargs win) — the single
+    definition behind every ``strategy=`` entry point, so a new
+    Strategy-IR serving knob cannot be seeded by one path and missed by
+    another."""
+    if strategy is not None:
+        par = strategy.graph_config.parallel or {}
+        engine_kwargs.setdefault(
+            "tensor_parallel", int(par.get("tensor_parallel", 1) or 1))
+        engine_kwargs.setdefault(
+            "vocab_parallel", bool(par.get("vocab_parallel", False)))
+        engine_kwargs.setdefault("comm_overlap", par.get("comm_overlap"))
+    return engine_kwargs
+
+
+class ServingEngine:
+    """Prefill/decode engine for the pipelined transformer LM family.
+
+    ``params``: the logical ``{"stages": ..., "shared": ...}`` tree of
+    :func:`~autodist_tpu.models.pipeline_lm.make_pipeline_lm_trainable`
+    (stacked per-layer leaves + tied embedding/unembedding).  Slots,
+    prompt bucket, and the fused-decode width are static so the whole
+    serving loop is exactly two compiled programs:
+
+    * ``num_slots`` — batch slots the continuous batcher fills;
+    * ``prefill_len`` — the prompt bucket (prompts zero-padded up to
+      it; padded positions write garbage k/v that masked reads never
+      see and forward decode overwrites);
+    * ``decode_steps`` — tokens per fused decode dispatch (``K``).
+
+    ``tensor_parallel``/``vocab_parallel``/``comm_overlap`` mirror the
+    training ``Pipeline`` knobs; with ``tensor_parallel == 1`` the same
+    code runs unsharded with zero collectives (the decode goldens'
+    sequential-reference property).
+    """
+
+    def __init__(self, cfg, params, *, tensor_parallel: int = 1,
+                 vocab_parallel: bool = False, comm_overlap=None,
+                 num_slots: int = 4, max_len: Optional[int] = None,
+                 prefill_len: Optional[int] = None, decode_steps: int = 8,
+                 devices=None):
+        self.cfg = cfg
+        if getattr(cfg, "attention_fn", None) is not None:
+            # The decode step attends over the cache with its own
+            # masked kernel; a custom attention_fn (flash/ring) would
+            # serve different numerics than it trained with.  Flash
+            # decode is a ROADMAP rung — reject rather than drift.
+            raise NotImplementedError(
+                "serving a model with cfg.attention_fn set is not "
+                "supported yet: decode attends over the KV cache with "
+                "the einsum kernel; clear attention_fn (numerics-"
+                "equivalent for trained weights) or wait for the "
+                "flash-decode path")
+        if cfg.dropout_rate or cfg.attention_dropout_rate:
+            raise ValueError(
+                "serving requires dropout_rate == "
+                "attention_dropout_rate == 0 (inference mode)")
+        tp = int(tensor_parallel)
+        if tp < 1:
+            raise ValueError("tensor_parallel must be >= 1")
+        if tp > 1 and cfg.num_heads % tp:
+            raise ValueError(
+                f"num_heads={cfg.num_heads} must divide by "
+                f"tensor_parallel={tp}")
+        self.tensor_parallel = tp
+        self.vocab_parallel = bool(vocab_parallel) and tp > 1
+        self.comm_overlap = normalize_comm_overlap(comm_overlap)
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len or cfg.max_len)
+        if self.max_len > cfg.max_len:
+            raise ValueError(
+                f"max_len={self.max_len} exceeds the model's trained "
+                f"position table ({cfg.max_len})")
+        self.prefill_len = int(prefill_len or min(self.max_len, 16))
+        if self.prefill_len > self.max_len:
+            raise ValueError("prefill_len must be <= max_len")
+        self.decode_steps = int(decode_steps)
+        self._axis = const.MODEL_AXIS if tp > 1 else None
+
+        if devices is None:
+            devices = jax.devices()
+        if tp > len(devices):
+            raise ValueError(
+                f"tensor_parallel={tp} needs {tp} devices; "
+                f"{len(devices)} visible")
+        self.mesh = (Mesh(np.array(devices[:tp]), (const.MODEL_AXIS,))
+                     if tp > 1 else None)
+
+        # ---- parameters: pad the vocab-sharded table, shard per the
+        # Strategy-IR rule tables, place once ---------------------------
+        params = jax.tree.map(jnp.asarray, params)
+        if self.vocab_parallel:
+            pad = vocab_pad(cfg.vocab_size, tp)
+            if pad:
+                emb = params["shared"]["embedding"]
+                params = dict(params, shared=dict(
+                    params["shared"],
+                    embedding=jnp.pad(emb, ((0, pad), (0, 0)))))
+        self._param_specs = serving_param_specs(params, tp,
+                                                self.vocab_parallel)
+        if self.mesh is not None:
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), self._param_specs,
+                is_leaf=lambda x: isinstance(x, P))
+            params = jax.tree.map(jax.device_put, params, shardings)
+        self.params = params
+
+        # ---- cache + per-slot decode state -----------------------------
+        cache = kv_cache.init_cache(
+            cfg.num_layers, self.num_slots, cfg.num_heads,
+            cfg.head_dim, self.max_len,
+            dtype=cfg.dtype)
+        self._tok = jnp.zeros((self.num_slots,), jnp.int32)
+        if self.mesh is not None:
+            csh = NamedSharding(self.mesh, kv_cache.cache_spec())
+            cache = kv_cache.KVCache(
+                k=jax.device_put(cache.k, csh),
+                v=jax.device_put(cache.v, csh),
+                lengths=jax.device_put(
+                    cache.lengths, NamedSharding(self.mesh, P())))
+        self.cache = cache
+
+        self._prefill_jit = self._build_prefill()
+        self._decode_jit = self._build_decode()
+
+    # ------------------------------------------------------------------ #
+    # constructors from the training stack
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def from_runner(cls, runner, cfg, *, strategy=None, **kw):
+        """Serve a live runner's parameters (fetched through the
+        gather/unpad path, any training strategy).  When the training
+        ``strategy`` is given, its Strategy-IR parallel knobs
+        (``tensor_parallel``/``vocab_parallel``/``comm_overlap``) seed
+        the serving config unless overridden."""
+        return cls(cfg, runner.get_params(),
+                   **seed_engine_kwargs(kw, strategy))
+
+    @classmethod
+    def from_artifact(cls, path: str, cfg, **kw):
+        """Serve a ``checkpoint/export.py`` artifact's ``params/``
+        tree (logical names, unpadded shapes)."""
+        from autodist_tpu.checkpoint.export import load_exported_params
+
+        return cls(cfg, load_exported_params(path), **kw)
+
+    # ------------------------------------------------------------------ #
+    # the model math (one definition serves tp=1 and the shard_map path)
+    # ------------------------------------------------------------------ #
+    def _embed(self, shared, tokens, positions):
+        """Token + position embedding for ``[B, S]`` token ids at
+        per-token ``positions`` (``[B, S]`` or a static ``[S]``)."""
+        cfg = self.cfg
+        x = vocab_parallel_embedding(
+            tokens, shared["embedding"], model_axis=self._axis
+            if self.vocab_parallel else None,
+            comm_overlap=self.comm_overlap).astype(cfg.dtype)
+        pos = jnp.take(shared["pos_embed"], positions, axis=0)
+        return x + pos.astype(cfg.dtype)
+
+    def _layer_prefill(self, chunk, x, mask):
+        """One encoder layer over the whole prompt — the training
+        :func:`~autodist_tpu.models.pipeline_lm._tp_encoder_layer`
+        itself (``return_kv=True`` hands back the layer's k/v
+        projections for the cache fill), so the serving forward cannot
+        drift from the trained math."""
+        from autodist_tpu.models.pipeline_lm import _tp_encoder_layer
+
+        return _tp_encoder_layer(self.cfg, chunk, x, mask, self._axis,
+                                 comm_overlap=self.comm_overlap,
+                                 return_kv=True)
+
+    def _layer_decode(self, chunk, x, kc, vc, layer, lengths):
+        """One encoder layer for a single-token step: project, write
+        this layer's k/v into the cache in place, attend over the
+        cache slice."""
+        from autodist_tpu.models.pipeline_lm import _flax_layer_norm
+
+        cfg, axis, overlap = self.cfg, self._axis, self.comm_overlap
+        dtype = cfg.dtype
+        att = chunk["attention"]
+        x = x.astype(dtype)
+        qkv = column_parallel(x, att["qkv"]["kernel"].astype(dtype),
+                              att["qkv"]["bias"].astype(dtype),
+                              model_axis=axis, comm_overlap=overlap)
+        q, k, v = jnp.moveaxis(qkv, -3, 0)          # [B, 1, heads, dh]
+        kc = kv_cache.write_token(kc, layer, k, lengths)
+        vc = kv_cache.write_token(vc, layer, v, lengths)
+        out = kv_cache.cached_attention(q, kc[layer], vc[layer], lengths,
+                                        dtype=dtype)
+        a = row_parallel(out, att["out"]["kernel"].astype(dtype),
+                         att["out"]["bias"].astype(dtype),
+                         model_axis=axis, axes=2, comm_overlap=overlap)
+        x = _flax_layer_norm(x + a, chunk["ln_attention"], dtype)
+        h = column_parallel(x, chunk["mlp"]["wi"]["kernel"].astype(dtype),
+                            chunk["mlp"]["wi"]["bias"].astype(dtype),
+                            model_axis=axis, comm_overlap=overlap)
+        h = jax.nn.gelu(h)
+        m = row_parallel(h, chunk["mlp"]["wo"]["kernel"].astype(dtype),
+                         chunk["mlp"]["wo"]["bias"].astype(dtype),
+                         model_axis=axis, comm_overlap=overlap)
+        return _flax_layer_norm(x + m, chunk["ln_mlp"], dtype), kc, vc
+
+    def _greedy(self, shared, h):
+        """Next token from ``[B, H]`` last-position hidden states (the
+        training loss head's ``_layer_norm`` + tied unembedding)."""
+        from autodist_tpu.models.pipeline_lm import _layer_norm
+
+        x = _layer_norm(h, shared["ln_final_scale"],
+                        shared["ln_final_bias"])
+        return vocab_parallel_greedy_token(
+            x, shared["embedding"], vocab_size=self.cfg.vocab_size,
+            model_axis=self._axis if self.vocab_parallel else None)
+
+    # ------------------------------------------------------------------ #
+    # compiled programs
+    # ------------------------------------------------------------------ #
+    def _wrap(self, fn, n_in_rest: int, n_out_rest: int):
+        """jit ``fn(params, k, v, *rest)``, shard_mapped over the model
+        mesh at tp>1, with the cache arrays donated so updates alias in
+        place.  ``n_in_rest``/``n_out_rest`` count the replicated
+        non-cache operands/results after ``(params, k, v)`` /
+        ``(k, v)``."""
+        if self.mesh is None:
+            return jax.jit(fn, donate_argnums=(1, 2))
+        cspec = kv_cache.cache_spec()
+        sm = jax.shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(self._param_specs, cspec, cspec)
+            + (P(),) * n_in_rest,
+            out_specs=(cspec, cspec) + (P(),) * n_out_rest,
+            check_vma=False)
+        return jax.jit(sm, donate_argnums=(1, 2))
+
+    def _build_prefill(self):
+        L, S = self.cfg.num_layers, self.prefill_len
+
+        def prefill(params, kc, vc, lengths, tok, prompts, p_lens, admit):
+            stages, shared = params["stages"], params["shared"]
+            x = self._embed(shared, prompts, jnp.arange(S))
+            mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
+            for layer in range(L):
+                chunk = jax.tree.map(lambda p: p[layer], stages)
+                x, k, v = self._layer_prefill(chunk, x, mask)
+                kc = kv_cache.write_prompt(kc, layer, k, admit)
+                vc = kv_cache.write_prompt(vc, layer, v, admit)
+            last = jnp.take_along_axis(
+                x, (p_lens - 1)[:, None, None], axis=1)[:, 0]
+            first_tok, _ = self._greedy(shared, last)
+            tok = jnp.where(admit, first_tok, tok)
+            lengths = jnp.where(admit, p_lens, lengths)
+            return kc, vc, lengths, tok
+
+        return self._wrap(prefill, n_in_rest=5, n_out_rest=2)
+
+    def _build_decode(self):
+        L, K = self.cfg.num_layers, self.decode_steps
+
+        def decode(params, kc, vc, lengths, tok, active):
+            stages, shared = params["stages"], params["shared"]
+
+            def body(carry, _):
+                kc, vc, lengths, tok = carry
+                x = self._embed(shared, tok[:, None], lengths[:, None])
+                for layer in range(L):
+                    chunk = jax.tree.map(lambda p: p[layer], stages)
+                    x, kc, vc = self._layer_decode(chunk, x, kc, vc,
+                                                   layer, lengths)
+                nxt, _ = self._greedy(shared, x[:, 0])
+                nxt = jnp.where(active, nxt, tok)
+                lengths = lengths + active.astype(jnp.int32)
+                return (kc, vc, lengths, nxt), nxt
+
+            (kc, vc, lengths, tok), toks = lax.scan(
+                body, (kc, vc, lengths, tok), None, length=K)
+            return kc, vc, lengths, tok, toks
+
+        return self._wrap(decode, n_in_rest=3, n_out_rest=3)
+
+    # ------------------------------------------------------------------ #
+    # host-side driver API (the batcher's contract)
+    # ------------------------------------------------------------------ #
+    def prefill(self, prompts, p_lens, admit):
+        """Run one prefill over the slot batch; admitted slots adopt
+        their prompt's cache/length and first generated token.  Returns
+        the per-slot current token ``[B]`` (numpy)."""
+        prompts = jnp.asarray(prompts, jnp.int32)
+        p_lens = jnp.asarray(p_lens, jnp.int32)
+        admit = jnp.asarray(admit, bool)
+        c = self.cache
+        k, v, lengths, tok = self._prefill_jit(
+            self.params, c.k, c.v, c.lengths, self._tok, prompts,
+            p_lens, admit)
+        self.cache = kv_cache.KVCache(k=k, v=v, lengths=lengths)
+        self._tok = tok
+        return np.asarray(jax.device_get(tok))
+
+    def decode(self, active):
+        """One fused ``decode_steps``-token dispatch; inactive slots
+        hold their state.  Returns the emitted tokens ``[K, B]``
+        (numpy; inactive columns repeat the held token)."""
+        active = jnp.asarray(active, bool)
+        c = self.cache
+        k, v, lengths, tok, toks = self._decode_jit(
+            self.params, c.k, c.v, c.lengths, self._tok, active)
+        self.cache = kv_cache.KVCache(k=k, v=v, lengths=lengths)
+        self._tok = tok
+        return np.asarray(jax.device_get(toks))
+
+    @property
+    def lengths(self):
+        return np.asarray(jax.device_get(self.cache.lengths))
+
+    # ------------------------------------------------------------------ #
+    # HLO probe hooks (tools/hlo_probe.py --probe decode)
+    # ------------------------------------------------------------------ #
+    def compiled_decode_text(self) -> str:
+        """Optimized HLO of the fused decode program."""
+        c = self.cache
+        active = jnp.ones((self.num_slots,), bool)
+        return self._decode_jit.lower(
+            self.params, c.k, c.v, c.lengths, self._tok,
+            active).compile().as_text()
+
+    def compiled_prefill_text(self) -> str:
+        """Optimized HLO of the prefill program."""
+        c = self.cache
+        prompts = jnp.zeros((self.num_slots, self.prefill_len), jnp.int32)
+        p_lens = jnp.ones((self.num_slots,), jnp.int32)
+        admit = jnp.ones((self.num_slots,), bool)
+        return self._prefill_jit.lower(
+            self.params, c.k, c.v, c.lengths, self._tok, prompts,
+            p_lens, admit).compile().as_text()

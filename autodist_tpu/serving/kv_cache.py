@@ -1,0 +1,152 @@
+"""TP-sharded KV cache for batched decode.
+
+One pair of arrays holds every layer's keys and values, laid out
+
+    ``[layer, batch_slot, heads/tp, max_len, head_dim]``
+
+so the whole cache shards over the ``model`` mesh axis with a single
+``P(None, None, 'model', None, None)`` spec — the same head split the
+Megatron column-parallel qkv projection produces, so a decode step's
+freshly projected k/v shards land in their cache slots with zero
+resharding (the GSPMD property: one sharding-annotated layout serves
+both the training program's attention and the decode program's cache,
+arxiv 2105.04663).
+
+Writes are in-place ``lax.dynamic_update_slice`` updates at per-slot
+positions (each batch slot advances its own sequence under continuous
+batching); under ``jax.jit`` with the cache donated, XLA aliases the
+update into the live buffer — ``tools/hlo_probe.py --probe decode``
+asserts the compiled step carries the dynamic-update-slices and no
+per-step full-cache copy.  Slots are recycled by the batcher: a newly
+admitted request's prefill overwrites positions ``[0, prompt_len)`` and
+decode overwrites forward from there, and reads are always masked to
+``pos < length``, so stale tail entries from the previous occupant are
+never observable.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from autodist_tpu import const
+
+
+def cache_spec() -> P:
+    """Partition spec of either cache array: heads over the model axis."""
+    return P(None, None, const.MODEL_AXIS, None, None)
+
+
+@dataclasses.dataclass
+class KVCache:
+    """The decode-time state: cache arrays + per-slot occupancy.
+
+    ``k``/``v``: ``[L, B, heads_local, T, head_dim]`` (``heads_local =
+    num_heads/tp`` inside ``shard_map``; the full head count on the host
+    view).  ``lengths``: ``[B]`` int32 — tokens currently materialized
+    per slot (the next write position).  Registered as a pytree so the
+    whole cache rides jit/scan carries and donation in one piece.
+    """
+
+    k: Any
+    v: Any
+    lengths: Any
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, _, leaves):
+        return cls(*leaves)
+
+
+jax.tree_util.register_pytree_node(
+    KVCache, KVCache.tree_flatten, KVCache.tree_unflatten)
+
+
+def init_cache(num_layers: int, num_slots: int, num_heads: int,
+               head_dim: int, max_len: int, dtype=jnp.float32) -> KVCache:
+    """All-zero cache with every slot empty."""
+    shape = (num_layers, num_slots, num_heads, max_len, head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   lengths=jnp.zeros((num_slots,), jnp.int32))
+
+
+def write_token(cache_arr, layer: int, kv, positions):
+    """Write one decode step's projections into ``cache_arr`` in place.
+
+    ``kv``: ``[B, 1, heads, head_dim]`` (the qkv projection's layout for
+    a single-token step); ``positions``: ``[B]`` int32 — slot ``i``'s
+    row lands at ``[layer, i, :, positions[i], :]``.  Per-slot scalar
+    positions keep the update a true ``dynamic_update_slice`` (the
+    in-place form XLA aliases) instead of a scatter; the slot loop is
+    unrolled — ``B`` is the static slot count, small by construction.
+    """
+    B = kv.shape[0]
+    for slot in range(B):
+        upd = kv[slot, 0][None, None, :, None, :].astype(cache_arr.dtype)
+        cache_arr = lax.dynamic_update_slice(
+            cache_arr, upd, (layer, slot, 0, positions[slot], 0))
+    return cache_arr
+
+
+def write_prompt(cache_arr, layer: int, kv, admit):
+    """Write a prefill's whole-prompt projections for admitted slots.
+
+    ``kv``: ``[B, S, heads, head_dim]``; slot ``i``'s rows land at
+    ``[layer, i, :, 0:S, :]`` when ``admit[i]``, and its existing cache
+    rows are kept bit-for-bit otherwise — the read-modify-write touches
+    only the ``[heads, S, head_dim]`` window, never the full cache (the
+    masking that lets one compiled prefill admit any subset of slots
+    while the others keep decoding state).
+    """
+    B, S = kv.shape[0], kv.shape[1]
+    for slot in range(B):
+        new = jnp.transpose(kv[slot], (1, 0, 2))[None, None] \
+            .astype(cache_arr.dtype)                 # [1,1,heads,S,dh]
+        cur = lax.dynamic_slice(cache_arr, (layer, slot, 0, 0, 0),
+                                new.shape)
+        sel = jnp.where(admit[slot], new, cur)
+        cache_arr = lax.dynamic_update_slice(cache_arr, sel,
+                                             (layer, slot, 0, 0, 0))
+    return cache_arr
+
+
+def cached_attention(q, k_layer, v_layer, lengths, *, dtype=jnp.float32):
+    """One decode step's attention over a layer's cache slice.
+
+    ``q``: ``[B, 1, heads, head_dim]`` (the step's query — the token
+    just written at position ``lengths``); ``k_layer``/``v_layer``:
+    ``[B, heads, T, head_dim]``.  Key positions ``> lengths`` are masked
+    (the just-written token attends to itself and everything before it),
+    so stale or zero entries past a slot's occupancy are unreachable.
+    Softmax in fp32 with the trained model's scaling — matching
+    :func:`~autodist_tpu.models.transformer.dot_product_attention`
+    numerics so incremental decode agrees with full-sequence recompute.
+    Scores live at ``[B, heads, 1, T]`` — never the ``[T, T]`` square
+    the prefill's causal pass needs (the HLO decode probe asserts no
+    such buffer exists).
+    """
+    depth = q.shape[-1]
+    q2 = jnp.transpose(q, (0, 2, 1, 3))              # [B, heads, 1, dh]
+    # dot_general contracting head_dim directly against the cache's
+    # native [.., T, head_dim] layout — an einsum spelling makes XLA
+    # transpose (= copy) the whole cache lane every step.
+    scores = lax.dot_general(
+        q2, k_layer.astype(q.dtype),
+        (((3,), (3,)), ((0, 1), (0, 1)))) / np.sqrt(depth)
+    scores = scores.astype(jnp.float32)              # [B, heads, 1, T]
+    T = k_layer.shape[2]
+    ok = jnp.arange(T)[None, None, None, :] <= \
+        lengths[:, None, None, None]
+    scores = jnp.where(ok, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    out = lax.dot_general(
+        probs, v_layer.astype(dtype),
+        (((3,), (2,)), ((0, 1), (0, 1))))            # [B, heads, 1, dh]
+    return jnp.transpose(out, (0, 2, 1, 3))          # [B, 1, heads, dh]

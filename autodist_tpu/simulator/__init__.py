@@ -1,6 +1,12 @@
 """Strategy cost simulation and auto-selection (the working counterpart
 of the reference's AutoSync stub, ``autodist/simulator/``)."""
-from autodist_tpu.simulator.auto_strategy import AutoStrategy, default_candidates
-from autodist_tpu.simulator.cost_model import CostModel, StrategyCost
+from autodist_tpu.simulator.auto_strategy import (AutoStrategy,
+                                                  default_candidates,
+                                                  default_serving_candidates,
+                                                  rank_serving)
+from autodist_tpu.simulator.cost_model import (CostModel, DecodeCost,
+                                               StrategyCost)
 
-__all__ = ["AutoStrategy", "CostModel", "StrategyCost", "default_candidates"]
+__all__ = ["AutoStrategy", "CostModel", "StrategyCost", "DecodeCost",
+           "default_candidates", "default_serving_candidates",
+           "rank_serving"]

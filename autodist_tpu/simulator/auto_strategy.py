@@ -90,6 +90,50 @@ def default_candidates() -> list[StrategyBuilder]:
     ]
 
 
+def default_serving_candidates(num_devices: int) -> list[dict]:
+    """The serving-config zoo: every (tensor_parallel, vocab_parallel)
+    shape the serving engine can lower on ``num_devices`` devices.
+    Plain dicts rather than builders — the decode program has no pipe
+    axis to build a full training strategy against, and the keys are
+    exactly the Strategy-IR ``parallel`` knobs the engine reads."""
+    candidates = [{"tensor_parallel": 1, "vocab_parallel": False}]
+    tp = 2
+    while tp <= num_devices:
+        candidates.append({"tensor_parallel": tp, "vocab_parallel": False})
+        candidates.append({"tensor_parallel": tp, "vocab_parallel": True})
+        tp *= 2
+    return candidates
+
+
+def rank_serving(trainable, resource_spec, candidates=None, *,
+                 batch_slots: int = 1, max_len: int = 2048,
+                 **cost_model_kwargs):
+    """Rank serving configs by predicted per-token decode latency —
+    AutoStrategy's second objective (ROADMAP: "latency under load, not
+    just training step time").
+
+    ``candidates``: serving configs (dicts with ``tensor_parallel`` /
+    ``vocab_parallel``) or trained :class:`Strategy` objects whose
+    Strategy-IR parallel knobs describe the serving shape; defaults to
+    :func:`default_serving_candidates`.  Returns ``[(config,
+    DecodeCost)]`` best-first (feasible configs before infeasible, then
+    by token time) — the same shape as ``AutoStrategy.report``."""
+    cm = CostModel(resource_spec, **cost_model_kwargs)
+    if candidates is None:
+        candidates = default_serving_candidates(resource_spec.num_devices())
+    scored = []
+    for cand in candidates:
+        try:
+            cost = cm.decode_cost(trainable, cand,
+                                  batch_slots=batch_slots, max_len=max_len)
+        except (ValueError, SpecMeshMismatch) as e:
+            logging.info("serving candidate %s skipped: %s", cand, e)
+            continue
+        scored.append((cand, cost))
+    scored.sort(key=lambda it: it[1].score)
+    return scored
+
+
 class AutoStrategy(StrategyBuilder):
     """Chooses among candidate builders with the analytic cost model
     (≙ the reference's declared AutoStrategy direction, SURVEY.md §2.3),

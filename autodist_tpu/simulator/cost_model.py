@@ -172,6 +172,30 @@ class StrategyCost:
         return self.comm_time_s if self.feasible else math.inf
 
 
+@dataclasses.dataclass
+class DecodeCost:
+    """Per-token decode latency breakdown for one serving config — the
+    cost model's second objective (latency under load, not training
+    step time).  ``token_time_s = compute + comm``: raising the tp
+    degree divides the per-device matmul work but adds the per-layer
+    Megatron boundary all-reduces, so tp=2 ranks above tp=1 exactly
+    when the per-token comm cost is under the compute win."""
+
+    token_time_s: float        # comm + compute, per decoded token
+    comm_time_s: float         # model-axis boundary collectives
+    compute_time_s: float      # per-device matmul passes
+    kv_bytes_per_device: float     # the TP-sharded cache's footprint
+    mem_bytes_per_device: float    # params (sharded) + KV cache
+    feasible: bool
+    tensor_parallel: int = 1
+    vocab_parallel: bool = False
+
+    @property
+    def score(self) -> float:
+        """Lower is better; infeasible configs rank last."""
+        return self.token_time_s if self.feasible else math.inf
+
+
 class CostModel:
     """Scores strategies against a resource spec's topology constants."""
 
@@ -775,6 +799,97 @@ class CostModel:
                                                else 0.0),
                             param_shard_bytes=param_b,
                             grad_shard_bytes=grad_b)
+
+    # ------------------------------------------------------------------ #
+    # Serving: per-token decode latency
+    # ------------------------------------------------------------------ #
+    def decode_cost(self, trainable: Trainable, config,
+                    *, batch_slots: int = 1, max_len: int = 2048,
+                    kv_bytes_per_elem: float = _ACT_BYTES) -> DecodeCost:
+        """Per-token decode latency for one serving config.
+
+        ``config`` is either a training :class:`Strategy` (its Strategy-
+        IR parallel knobs seed the serving shape — the same IR answers
+        both objectives) or a plain dict with ``tensor_parallel`` /
+        ``vocab_parallel`` keys.  The model:
+
+        * **compute** — a decode token's matmul passes touch every
+          parameter once (2 FLOPs/element), divided across the tp group
+          for the vars the Megatron/vocab rule tables shard (the same
+          tables the serving engine shards by);
+        * **comm** — per layer, the row-parallel boundary all-reduces of
+          the ``[B, H]`` activations (attention out-proj + mlp ``wo``,
+          forward only — decode has no backward), plus the
+          vocab-parallel epilogue's lookup psum and greedy pmax/pmin;
+        * **memory** — sharded parameters + the TP-sharded KV cache
+          (``2·layers·H·max_len·slots/tp`` elements), gated against HBM
+          headroom like the training costs.
+        """
+        if isinstance(config, Strategy):
+            par = config.graph_config.parallel or {}
+            tp = int(par.get("tensor_parallel", 1) or 1)
+            vocab_parallel = bool(par.get("vocab_parallel", False))
+        else:
+            tp = int(config.get("tensor_parallel", 1) or 1)
+            vocab_parallel = bool(config.get("vocab_parallel", False))
+        from autodist_tpu.strategy.parallel_builders import (
+            PIPELINE_TP_RULES, PIPELINE_VOCAB_RULES)
+
+        tp_res = [re.compile(p) for p, _ in PIPELINE_TP_RULES]
+        v_res = [re.compile(p) for p, _ in PIPELINE_VOCAB_RULES]
+        hidden = self._hidden_dim(trainable)
+        layers = getattr(trainable, "num_stages", None)
+        if layers is None:
+            # Fallback for non-stage-structured trainables: the most
+            # common leading dim among rank>=3 vars (a stacked layer
+            # stack's shared leading extent).  Rank-2 tables are
+            # excluded on purpose — a [V, H] embedding's vocab dim
+            # would otherwise masquerade as a layer count and inflate
+            # every term by orders of magnitude.
+            import collections as _collections
+
+            leads = _collections.Counter(
+                i.shape[0] for i in trainable.var_infos()
+                if len(i.shape) >= 3)
+            layers = leads.most_common(1)[0][0] if leads else 1
+        layers = int(layers)
+        elems = bytes_ = 0.0
+        for info in trainable.var_infos():
+            shard = 1
+            if tp > 1:
+                name = info.name
+                short = name.split("/", 1)[1] if "/" in name else name
+                if any(p.search(name) for p in tp_res):
+                    shard = tp
+                elif vocab_parallel and any(p.search(short)
+                                            for p in v_res):
+                    shard = tp
+            elems += info.size / shard
+            bytes_ += info.byte_size / shard
+        mxu_eff = float(self.link_profile.get(
+            "mxu_efficiency", _DEFAULT_MXU_EFFICIENCY))
+        flops_rate = self.chip.peak_bf16_tflops * 1e12 * mxu_eff
+        compute = 2.0 * elems * batch_slots / flops_rate
+
+        bw_link = float(self.link_profile.get(
+            "ici_gbps", self.chip.ici_gbps)) * 1e9
+        hop_alpha = float(self.link_profile.get(
+            "hop_alpha_s", COLLECTIVE_ALPHA))
+        ring_m = 2.0 * (tp - 1) / tp if tp > 1 else 0.0
+        comm = 0.0
+        if tp > 1:
+            boundaries = 2 * layers + (1 if vocab_parallel else 0)
+            comm = ring_m * boundaries * batch_slots * hidden * _ACT_BYTES \
+                / bw_link + hop_alpha * (boundaries
+                                         + (2 if vocab_parallel else 0))
+        kv = 2.0 * layers * hidden * max_len * batch_slots \
+            * kv_bytes_per_elem / max(tp, 1)
+        mem = bytes_ + kv
+        hbm = self.chip.hbm_gb * 1e9 * self.hbm_headroom
+        return DecodeCost(token_time_s=compute + comm, comm_time_s=comm,
+                          compute_time_s=compute, kv_bytes_per_device=kv,
+                          mem_bytes_per_device=mem, feasible=mem <= hbm,
+                          tensor_parallel=tp, vocab_parallel=vocab_parallel)
 
     def strategy_cost(self, trainable: Trainable,
                       strategy: Strategy) -> StrategyCost:

@@ -27,7 +27,8 @@ from autodist_tpu.telemetry.records import build_manifest, provenance
 
 __all__ = [
     "Telemetry", "get", "configure", "reset", "enabled", "span", "counter",
-    "gauge", "histogram", "record_step", "annotate", "flush", "manifest",
+    "gauge", "histogram", "record_step", "record_event", "annotate",
+    "flush", "manifest",
     "summary", "drift_report", "provenance", "build_manifest",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "NULL_SPAN", "NULL_INSTRUMENT",
@@ -56,6 +57,10 @@ def histogram(name: str):
 
 def record_step(step: int, duration_s: float, **kw) -> bool:
     return get().record_step(step, duration_s, **kw)
+
+
+def record_event(kind: str, **fields) -> bool:
+    return get().record_event(kind, **fields)
 
 
 def annotate(**kv):

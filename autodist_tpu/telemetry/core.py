@@ -190,6 +190,27 @@ class Telemetry:
             self._steps.append(rec)
         return True
 
+    def record_event(self, kind: str, **fields) -> bool:
+        """One typed event record on the JSONL sink (``kind`` other than
+        the reserved ``"step"`` — e.g. the serving path's per-request
+        ``"serve"`` records).  Events share the step records' retention
+        cap but not the sampling knob: a request-level record is already
+        aggregated, so dropping every Nth would lose requests, not
+        resolution."""
+        if not self.enabled:
+            return False
+        if kind == "step":
+            raise ValueError("use record_step for step records")
+        rec = {"kind": str(kind)}
+        for k, v in fields.items():
+            rec[k] = _jsonable(v)
+        with self._lock:
+            if len(self._steps) >= MAX_STEP_RECORDS:
+                self._steps_dropped += 1
+                return False
+            self._steps.append(rec)
+        return True
+
     def step_records(self) -> list[dict]:
         with self._lock:
             return list(self._steps)

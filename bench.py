@@ -253,9 +253,13 @@ def main():
     # the budget; a wedged tunnel gets a diagnostic JSON line instead of
     # silence.  (jax.default_backend() alone can hang: the tunnel client
     # initializes even under JAX_PLATFORMS=cpu.)
+    # `bench.py serve` measures the serving engine's decode throughput
+    # instead of training MFU; the UNAVAILABLE fresh-process retry
+    # carries the mode through sys.argv.
+    run = _bench_serve if "serve" in sys.argv[1:] else _bench
     dog = _Watchdog(2400, "backend init").arm()
     try:
-        _bench(dog)
+        run(dog)
     except RuntimeError as e:
         # A degraded tunnel surfaces as UNAVAILABLE from PJRT init
         # (observed: ~30 min blocked inside init, then this error; jax
@@ -268,6 +272,98 @@ def main():
         _unavailable_exit(str(e))
     finally:
         dog.disarm()   # every exit path reaps the monitor + stage file
+
+
+def _bench_serve(dog):
+    """`bench.py serve`: decode tokens/sec + TTFT through the serving
+    engine, emitted as the same provenance-stamped one-line JSON record
+    shape as the training bench (hw_session.sh greps the same keys;
+    UNAVAILABLE backends take the same fresh-process backoff via
+    main())."""
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import serving, telemetry
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+    from autodist_tpu.resource import ResourceSpec
+
+    on_accel = jax.default_backend() != "cpu"
+    rs = ResourceSpec({})
+    n = rs.num_devices()
+    if on_accel:
+        cfg = TransformerConfig(vocab_size=32768, hidden_size=1024,
+                                num_layers=8, num_heads=16, mlp_dim=4096,
+                                max_len=1024, dtype=jnp.bfloat16,
+                                dropout_rate=0.0,
+                                attention_dropout_rate=0.0)
+        slots, K, prefill_len, max_new, requests = 8, 16, 64, 128, 16
+        tp = 2 if n >= 2 else 1
+    else:  # CPU dev smoke: same code path, toy size
+        cfg = TransformerConfig(vocab_size=128, hidden_size=32,
+                                num_layers=2, num_heads=2, mlp_dim=64,
+                                max_len=64, dtype=jnp.float32,
+                                dropout_rate=0.0,
+                                attention_dropout_rate=0.0)
+        slots, K, prefill_len, max_new, requests = 2, 4, 8, 8, 4
+        tp = 1
+    telemetry.annotate(bench="serve_decode_tokens_per_sec", devices=n,
+                       chip=rs.chip.name)
+
+    dog.stage = f"serve bench (tp{tp}/slots{slots}: build+compile+decode)"
+    try:
+        trainable = make_pipeline_lm_trainable(
+            cfg, optax.adam(1e-3), jax.random.PRNGKey(0))
+        engine = serving.ServingEngine(
+            cfg, trainable.params, tensor_parallel=tp,
+            vocab_parallel=tp > 1, num_slots=slots, max_len=cfg.max_len,
+            prefill_len=prefill_len, decode_steps=K)
+        batcher = serving.ContinuousBatcher(engine)
+        r = np.random.RandomState(0)
+        # warm the two compiled programs before the timed run (run()
+        # returns only the completions of each call, so the warm-up
+        # request never leaks into the timed tally)
+        batcher.submit(
+            r.randint(0, cfg.vocab_size, (4,)).tolist(), max_new_tokens=K)
+        batcher.run()
+        t0 = time.perf_counter()
+        for _ in range(requests):
+            plen = int(r.randint(1, prefill_len + 1))
+            batcher.submit(r.randint(0, cfg.vocab_size, (plen,)).tolist(),
+                           max_new_tokens=max_new)
+        done = batcher.run()
+        wall = time.perf_counter() - t0
+    except Exception as e:
+        dog.disarm()
+        if "UNAVAILABLE" in str(e) or "Connection" in str(e):
+            _unavailable_exit(f"transport: {e}")
+        print(json.dumps({
+            "metric": "serve_decode_tokens_per_sec", "value": 0.0,
+            "unit": "tokens_per_sec", "vs_baseline": 0.0,
+            "error": f"serve bench failed: {e}",
+            "provenance": _provenance()}))
+        sys.exit(4)
+    tokens = sum(len(c.tokens) for c in done.values())
+    ttfts = sorted(c.ttft_s for c in done.values())
+    itls = [ms for c in done.values() for ms in c.inter_token_ms]
+    rate = tokens / wall if wall > 0 else 0.0
+    record = {
+        "metric": "serve_decode_tokens_per_sec", "value": round(rate, 2),
+        "unit": "tokens_per_sec", "vs_baseline": round(rate, 2),
+        "devices": n, "chip": rs.chip.name, "tensor_parallel": tp,
+        "vocab_parallel": tp > 1, "slots": slots, "decode_steps": K,
+        "requests": len(done), "tokens": tokens,
+        "ttft_ms_p50": round(ttfts[len(ttfts) // 2] * 1e3, 2),
+        "inter_token_ms_p50": round(float(np.percentile(itls, 50)), 3)
+        if itls else None,
+        "inter_token_ms_p99": round(float(np.percentile(itls, 99)), 3)
+        if itls else None,
+        "scored": True, "provenance": _provenance(),
+    }
+    dog.disarm()
+    print(json.dumps(record), flush=True)
+    telemetry.gauge("serve/bench_tokens_per_sec").set(rate)
+    telemetry.flush()
 
 
 def _bench(dog):

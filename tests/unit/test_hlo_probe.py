@@ -9,11 +9,13 @@ steps-per-loop scan each fail CI here, on CPU, before any hardware
 window."""
 import json
 
-from tools.hlo_probe import (buffers_with_dim, collective_counts,
-                             entry_signature, main,
-                             probe_collective_matmul, probe_pipeline_tp,
-                             probe_single_replica, probe_steps_per_loop,
-                             probe_vocab_parallel, probe_zero3)
+from tools.hlo_probe import (buffers_with_dim, buffers_with_dim_repeated,
+                             collective_counts, dynamic_update_slices,
+                             entry_signature, large_copies_with_dim, main,
+                             probe_collective_matmul, probe_decode,
+                             probe_pipeline_tp, probe_single_replica,
+                             probe_steps_per_loop, probe_vocab_parallel,
+                             probe_zero3)
 
 
 def test_collective_counts_parses_hlo_idioms():
@@ -114,6 +116,36 @@ ENTRY %main.1 (Arg_0.1: f32[2,116], Arg_1.2: s32[8]) -> (f32[2,116]) {
     # internal computations and step-internal temporaries are excluded
     assert buffers_with_dim(sig, 29) == 0
     assert buffers_with_dim(sig, 116) == 2
+
+
+def test_decode_probe_helpers_parse_hlo_idioms():
+    text = """
+  %s = f32[3,2,57,57]{3,2,1,0} parameter(0)
+  %dus = f32[2,3,1,57,8]{4,3,2,1,0} dynamic-update-slice(%a, %b, %i0)
+  %dus2 = f32[8]{0} dynamic-update-slice-start(%c, %d, %i1)
+  %cp = f32[3,1,8,57]{3,2,1,0} copy(f32[3,1,8,57]{2,3,1,0} %t)
+  %cp2 = f32[4]{0} copy(f32[4]{0} %u)
+"""
+    assert buffers_with_dim_repeated(text, 57) == 1   # the [.., 57, 57]
+    # times=1 degenerates to a per-shape scan (result + operand shapes)
+    assert buffers_with_dim_repeated(text, 57, times=1) == 4
+    assert dynamic_update_slices(text) == 2
+    assert large_copies_with_dim(text, 57, 3 * 8 * 57) == 1
+    assert large_copies_with_dim(text, 57, 10 ** 6) == 0
+
+
+def test_decode_step_is_buffer_clean_and_in_place():
+    """The serving decode claims, tier-1 on CPU: a vocab-parallel decode
+    step that re-materializes full-vocab logits, builds a [T, T]
+    attention square, regresses the KV write to copy-on-write, or
+    unrolls the K-token window into separate dispatches fails CI here
+    before any hardware window."""
+    report = probe_decode()
+    assert report["baseline_full_vocab_buffers"] > 0
+    assert report["vocab_parallel_full_vocab_buffers"] == 0
+    assert report["dynamic_update_slices_vp"] >= 4    # k+v x 2 layers
+    assert report["collectives_vp"]["all-reduce"] >= 4
+    assert sum(report["collectives_tp1"].values()) == 0
 
 
 def test_zero3_shards_step_boundary_and_gathers_per_layer():

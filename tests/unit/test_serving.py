@@ -1,0 +1,471 @@
+"""Serving-path goldens: the batched-inference engine on the Strategy IR.
+
+The decode correctness bar (ISSUE 7 acceptance): greedy decode of the
+tp∈{1,2} × vocab-parallel pipelined LM matches the single-device
+full-recompute reference token-for-token — including the ``V % tp != 0``
+padding edge, where padded vocab rows must never be sampled — and
+continuous-batching interleaving (requests joining/leaving mid-flight)
+yields exactly the tokens each request gets when run alone.  Plus the
+per-token telemetry contract (``kind="serve"`` records through the PR 4
+sink, schema-gated by ``tools/telemetry_report.py --check``) and the
+cost model's decode-latency objective.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import telemetry
+from autodist_tpu.models.pipeline_lm import (make_pipeline_lm_trainable,
+                                             sequential_logits)
+from autodist_tpu.models.transformer import TransformerConfig
+from autodist_tpu.serving import (ContinuousBatcher, ServingEngine,
+                                  init_cache, serve)
+from autodist_tpu.serving import kv_cache
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+V = 33          # odd: V % 2 != 0 exercises the vocab zero-pad path
+MAX_LEN = 24
+
+
+def make_cfg(vocab=V, max_len=MAX_LEN):
+    return TransformerConfig(
+        vocab_size=vocab, hidden_size=16, num_layers=2, num_heads=2,
+        mlp_dim=32, max_len=max_len, dtype=jnp.float32,
+        dropout_rate=0.0, attention_dropout_rate=0.0)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return make_cfg()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return make_pipeline_lm_trainable(
+        cfg, optax.sgd(0.1), jax.random.PRNGKey(0)).params
+
+
+def reference_greedy(cfg, params, prompt, n):
+    """Single-device reference: full-sequence recompute per emitted
+    token — no KV cache, no masking tricks, the training stack's own
+    layer/loss-head math (:func:`sequential_logits`)."""
+    toks = list(prompt)
+    for _ in range(n):
+        logits = sequential_logits(cfg, params,
+                                   jnp.asarray(toks)[None])
+        toks.append(int(jnp.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+def make_engine(cfg, params, tp=1, vocab_parallel=False, slots=2,
+                decode_steps=3, prefill_len=8):
+    return ServingEngine(cfg, params, tensor_parallel=tp,
+                         vocab_parallel=vocab_parallel, num_slots=slots,
+                         max_len=cfg.max_len, prefill_len=prefill_len,
+                         decode_steps=decode_steps)
+
+
+# --------------------------------------------------------------------- #
+# KV cache
+# --------------------------------------------------------------------- #
+def test_kv_cache_layout_and_token_writes():
+    c = init_cache(num_layers=2, num_slots=3, num_heads=4, head_dim=5,
+                   max_len=7)
+    assert c.k.shape == (2, 3, 4, 7, 5)       # [L, B, heads, T, dh]
+    kv = jnp.arange(3 * 1 * 4 * 5, dtype=jnp.float32).reshape(3, 1, 4, 5)
+    positions = jnp.array([0, 2, 6], jnp.int32)
+    k = kv_cache.write_token(c.k, 1, kv, positions)
+    for slot, pos in enumerate([0, 2, 6]):
+        np.testing.assert_array_equal(np.asarray(k[1, slot, :, pos, :]),
+                                      np.asarray(kv[slot, 0]))
+    assert float(jnp.abs(k[0]).sum()) == 0.0   # other layer untouched
+    # every non-written position stays zero
+    mask = np.ones((3, 4, 7, 5), bool)
+    for slot, pos in enumerate([0, 2, 6]):
+        mask[slot, :, pos, :] = False
+    assert float(jnp.abs(jnp.asarray(np.asarray(k[1])[mask])).sum()) == 0.0
+
+
+def test_kv_cache_prompt_writes_respect_admit_mask():
+    c = init_cache(num_layers=1, num_slots=2, num_heads=2, head_dim=3,
+                   max_len=6)
+    resident = c.k + 7.0        # slot state that must survive
+    kv = jnp.ones((2, 4, 2, 3), jnp.float32)       # [B, S, heads, dh]
+    admit = jnp.array([True, False])
+    k = kv_cache.write_prompt(resident, 0, kv, admit)
+    assert float(k[0, 0, :, :4, :].min()) == 1.0   # admitted: new rows
+    np.testing.assert_array_equal(np.asarray(k[0, 1]),
+                                  np.asarray(resident[0, 1]))
+
+
+def test_cached_attention_masks_beyond_length():
+    """Entries past a slot's occupancy are unreachable: garbage written
+    there must not change the attention output."""
+    B, H, T, D = 2, 2, 6, 4
+    q = jnp.asarray(np.random.RandomState(0).randn(B, 1, H, D), jnp.float32)
+    k = jnp.asarray(np.random.RandomState(1).randn(B, H, T, D), jnp.float32)
+    v = jnp.asarray(np.random.RandomState(2).randn(B, H, T, D), jnp.float32)
+    lengths = jnp.array([2, 4], jnp.int32)
+    out = kv_cache.cached_attention(q, k, v, lengths)
+    poison = jnp.where(
+        (jnp.arange(T) > lengths[:, None])[:, None, :, None], 1e9, 0.0)
+    out2 = kv_cache.cached_attention(q, k + poison, v + poison, lengths)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+# --------------------------------------------------------------------- #
+# greedy decode goldens (the acceptance bar)
+# --------------------------------------------------------------------- #
+PROMPT = [3, 1, 4, 1, 5]
+
+
+@pytest.mark.parametrize("tp,vocab_parallel", [(1, False), (2, False),
+                                               (2, True)])
+def test_greedy_decode_matches_sequential_reference(cfg, params, tp,
+                                                    vocab_parallel):
+    """Token-for-token parity of the KV-cache incremental decode vs the
+    full-recompute reference, across tp∈{1,2} × vocab-parallel — with
+    V=33 odd, so the vocab-parallel case runs the zero-pad edge and a
+    sampled padded row (id >= 33) would break equality immediately."""
+    want = reference_greedy(cfg, params, PROMPT, 9)
+    engine = make_engine(cfg, params, tp=tp, vocab_parallel=vocab_parallel)
+    b = ContinuousBatcher(engine)
+    rid = b.submit(PROMPT, max_new_tokens=9)
+    got = b.run()[rid].tokens
+    assert got == want
+    assert all(0 <= t < cfg.vocab_size for t in got)
+
+
+def test_padded_vocab_rows_never_win_greedy():
+    """Adversarial pad-row check: hidden states crafted so every REAL
+    vocab row scores negative while the zero-padded row would score 0
+    (the max) if it weren't masked."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from autodist_tpu.parallel.tensor import vocab_parallel_greedy_token
+
+    vocab, H, tp = 5, 8, 2                     # pads to 6 rows, 3/shard
+    rng = np.random.RandomState(0)
+    # all-positive rows + all-negative hidden state: every real row's
+    # logit is strictly negative, while the padded all-zero row would
+    # score exactly 0 (the max) if it weren't masked
+    emb = jnp.asarray(np.abs(rng.randn(vocab, H)) + 0.1, jnp.float32)
+    x = -jnp.ones((1, H), jnp.float32)
+    logits = np.asarray(x @ emb.T)[0]
+    assert (logits < 0).all(), "construction failed to go negative"
+    emb_pad = jnp.pad(emb, ((0, 1), (0, 0)))   # padded row -> logit 0
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("model",))
+
+    def run(xx, ee):
+        tok, _ = vocab_parallel_greedy_token(xx, ee, vocab_size=vocab,
+                                             model_axis="model")
+        return tok
+
+    tok = jax.jit(jax.shard_map(
+        run, mesh=mesh, in_specs=(P(), P("model", None)),
+        out_specs=P(), check_vma=False))(x, emb_pad)
+    assert int(tok[0]) == int(np.argmax(logits))
+    assert int(tok[0]) < vocab
+
+
+def test_continuous_batching_interleave_parity(cfg, params):
+    """Requests joining and leaving mid-flight (3 requests, 2 slots:
+    the third admits only when a slot frees) decode exactly the tokens
+    each gets when run alone."""
+    reqs = [([3, 1, 4], 10), ([2, 7], 4), ([5, 5, 5, 5, 9], 7)]
+    eng = make_engine(cfg, params)
+    b = ContinuousBatcher(eng)
+    rids = [b.submit(p, max_new_tokens=m) for p, m in reqs]
+    inter = b.run()
+    assert set(inter) == set(rids)
+    for (p, m), rid in zip(reqs, rids):
+        solo = ContinuousBatcher(make_engine(cfg, params))
+        srid = solo.submit(p, max_new_tokens=m)
+        assert inter[rid].tokens == solo.run()[srid].tokens
+        # ... and both match the sequential reference
+        assert inter[rid].tokens == reference_greedy(cfg, params, p, m)
+
+
+def test_batcher_queue_eviction_and_eos(cfg, params):
+    eng = make_engine(cfg, params, slots=1)
+    b = ContinuousBatcher(eng)
+    # discover this prompt's greedy stream, then stop at its 3rd token
+    probe = ContinuousBatcher(make_engine(cfg, params, slots=1))
+    probe_rid = probe.submit(PROMPT, max_new_tokens=8)
+    stream = probe.run()[probe_rid].tokens
+    eos = stream[2]
+    first_eos = stream.index(eos)
+    r1 = b.submit(PROMPT, max_new_tokens=8, eos_id=eos)
+    r2 = b.submit([2, 7, 1], max_new_tokens=5)    # queued behind r1
+    assert b.active_slots == 0 and len(b._queue) == 2
+    done = b.run()
+    assert done[r1].finish_reason == "eos"
+    assert done[r1].tokens == stream[:first_eos + 1]
+    assert done[r2].finish_reason == "max_tokens"
+    assert len(done[r2].tokens) == 5
+    assert done[r2].queue_wait_s >= 0.0
+    assert done[r1].ttft_s > 0 and done[r1].tokens_per_sec > 0
+
+
+def test_eos_beyond_budget_does_not_stretch_request(cfg, params):
+    """An EOS landing past max_new_tokens inside the same fused window
+    must not stretch the request: the budget caps first."""
+    probe = ContinuousBatcher(make_engine(cfg, params, slots=1))
+    probe_rid = probe.submit(PROMPT, max_new_tokens=8)
+    stream = probe.run()[probe_rid].tokens
+    late = next((t for t in stream[2:] if t not in stream[:2]), None)
+    assert late is not None, f"degenerate stream {stream}"
+    b = ContinuousBatcher(make_engine(cfg, params, slots=1))
+    rid = b.submit(PROMPT, max_new_tokens=2, eos_id=late)
+    out = b.run()[rid]
+    assert out.finish_reason == "max_tokens"
+    assert out.tokens == stream[:2]
+    assert len(out.inter_token_ms) <= 2   # discarded tokens not timed
+
+
+def test_run_returns_only_new_completions(cfg, params):
+    """A long-lived loop calling run() per admission round must not
+    re-receive old completions (the full history stays on
+    .completions)."""
+    b = ContinuousBatcher(make_engine(cfg, params))
+    r1 = b.submit(PROMPT, max_new_tokens=3)
+    first = b.run()
+    assert set(first) == {r1}
+    r2 = b.submit([2, 7], max_new_tokens=3)
+    second = b.run()
+    assert set(second) == {r2}
+    assert set(b.completions) == {r1, r2}
+
+
+def test_batcher_max_len_eviction(cfg, params):
+    """A request whose budget exceeds the cache capacity evicts at
+    max_len with the over-capacity tail truncated deterministically."""
+    eng = make_engine(cfg, params, slots=2)
+    b = ContinuousBatcher(eng)
+    rid = b.submit(PROMPT, max_new_tokens=200)
+    out = b.run()[rid]
+    assert out.finish_reason == "max_len"
+    assert len(out.tokens) == cfg.max_len - len(PROMPT)
+
+
+def test_batcher_validates_requests(cfg, params):
+    b = ContinuousBatcher(make_engine(cfg, params))
+    with pytest.raises(ValueError, match="empty prompt"):
+        b.submit([])
+    with pytest.raises(ValueError, match="prefill_len"):
+        b.submit(list(range(20)))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        b.submit([1], max_new_tokens=0)
+
+
+# --------------------------------------------------------------------- #
+# serve() entry + engine config validation
+# --------------------------------------------------------------------- #
+def test_serve_entry_point_reads_strategy_ir(cfg, params):
+    from autodist_tpu.strategy.ir import GraphConfig, Strategy
+
+    strategy = Strategy(node_configs=[], graph_config=GraphConfig(
+        replicas=1, lowering="pipeline",
+        parallel={"tensor_parallel": 2, "vocab_parallel": True}))
+    engine = serve(cfg, params=params, strategy=strategy, num_slots=2,
+                   prefill_len=8, decode_steps=2)
+    assert engine.tensor_parallel == 2 and engine.vocab_parallel
+    with pytest.raises(ValueError, match="exactly one"):
+        serve(cfg, params=params, artifact="/tmp/nope")
+    with pytest.raises(ValueError, match="exactly one"):
+        serve(cfg)
+
+
+def test_engine_validates_shapes(cfg, params):
+    with pytest.raises(ValueError, match="num_heads"):
+        ServingEngine(cfg, params, tensor_parallel=4)   # 2 heads % 4
+    with pytest.raises(ValueError, match="position table"):
+        ServingEngine(cfg, params, max_len=10 * cfg.max_len)
+    with pytest.raises(ValueError, match="prefill_len"):
+        ServingEngine(cfg, params, prefill_len=cfg.max_len + 1)
+
+
+# --------------------------------------------------------------------- #
+# per-token telemetry through the PR 4 sink
+# --------------------------------------------------------------------- #
+def test_serving_telemetry_records_and_report(cfg, params, tmp_path):
+    tel = telemetry.reset()
+    telemetry.configure(out_dir=str(tmp_path), enabled=True)
+    try:
+        b = ContinuousBatcher(make_engine(cfg, params))
+        rids = [b.submit([3, 1, 4], max_new_tokens=4),
+                b.submit([2, 7], max_new_tokens=3)]
+        b.run()
+        paths = telemetry.flush()
+    finally:
+        telemetry.reset()
+    with open(paths["metrics"]) as f:
+        recs = [json.loads(line) for line in f]
+    serves = {r["request"]: r for r in recs if r.get("kind") == "serve"}
+    assert set(serves) == set(rids)
+    for rid in rids:
+        rec = serves[rid]
+        assert rec["ttft_ms"] > 0 and rec["tokens"] >= 1
+        assert rec["tokens_per_sec"] > 0
+        assert rec["inter_token_p50_ms"] > 0
+    counters = {r["name"]: r["value"] for r in recs
+                if r.get("kind") == "counter"}
+    assert counters["serve/requests"] == 2
+    assert counters["serve/tokens"] >= 7
+    hists = {r["name"] for r in recs if r.get("kind") == "histogram"}
+    assert {"serve/ttft_ms", "serve/inter_token_ms"} <= hists
+
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+    assert telemetry_report.check_schema(str(tmp_path)) == []
+    md = telemetry_report.render(str(tmp_path))
+    assert "## serving" in md and "ttft" in md
+
+    # the schema gate rejects a serve record missing its latency facts
+    with open(os.path.join(tmp_path, "metrics.jsonl"), "a") as f:
+        f.write(json.dumps({"kind": "serve", "request": "x"}) + "\n")
+    problems = telemetry_report.check_schema(str(tmp_path))
+    assert any("serve record missing" in p for p in problems)
+
+
+def test_record_event_contract():
+    tel = telemetry.reset()
+    tel.enabled = True
+    assert tel.record_event("serve", request="r", tokens=3)
+    assert tel.step_records()[-1]["kind"] == "serve"
+    with pytest.raises(ValueError, match="record_step"):
+        tel.record_event("step", step=1)
+    tel.enabled = False
+    assert not tel.record_event("serve", request="r2")
+    telemetry.reset()
+
+
+# --------------------------------------------------------------------- #
+# the cost model's decode-latency objective
+# --------------------------------------------------------------------- #
+def test_decode_cost_ranks_tp_by_comm_vs_compute_win(cfg):
+    """tp=2 ranks above tp=1 exactly when the per-token comm cost is
+    under the compute win — both directions, by link profile."""
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.simulator import CostModel
+
+    trainable = make_pipeline_lm_trainable(
+        make_cfg(vocab=512, max_len=64), optax.sgd(0.1),
+        jax.random.PRNGKey(0))
+    rs = ResourceSpec({"topology": {"platform": "cpu", "num_devices": 8}})
+    fast = CostModel(rs, link_profile={"ici_gbps": 1e4,
+                                       "hop_alpha_s": 1e-9})
+    c1 = fast.decode_cost(trainable, {"tensor_parallel": 1})
+    c2 = fast.decode_cost(trainable, {"tensor_parallel": 2})
+    assert c1.comm_time_s == 0.0
+    assert c2.comm_time_s < c1.compute_time_s - c2.compute_time_s
+    assert c2.token_time_s < c1.token_time_s          # tp=2 elected
+    slow = CostModel(rs, link_profile={"ici_gbps": 1e-4,
+                                       "hop_alpha_s": 1e-2})
+    d1 = slow.decode_cost(trainable, {"tensor_parallel": 1})
+    d2 = slow.decode_cost(trainable, {"tensor_parallel": 2})
+    assert d2.comm_time_s > d1.compute_time_s - d2.compute_time_s
+    assert d1.token_time_s < d2.token_time_s          # tp=1 elected
+    # the KV cache and params shard with tp
+    assert c2.kv_bytes_per_device == pytest.approx(
+        c1.kv_bytes_per_device / 2)
+    assert c2.mem_bytes_per_device < c1.mem_bytes_per_device
+
+
+def test_decode_cost_layer_fallback_ignores_embedding_tables():
+    """A trainable without num_stages must not mistake a [V, H]
+    embedding's vocab dim for a layer count (it would inflate every
+    decode term by orders of magnitude)."""
+    from autodist_tpu import Trainable
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.simulator import CostModel
+
+    params = {
+        "embedding": jnp.zeros((5000, 8), jnp.float32),
+        "blocks": {"qkv": jnp.zeros((4, 8, 24), jnp.float32),
+                   "wo": jnp.zeros((4, 16, 8), jnp.float32)},
+    }
+    t = Trainable.from_loss_fn(
+        lambda p, b: jnp.sum(p["embedding"]) * 0.0, params,
+        optax.sgd(0.1))
+    rs = ResourceSpec({"topology": {"platform": "cpu", "num_devices": 2}})
+    cost = CostModel(rs).decode_cost(t, {"tensor_parallel": 1},
+                                     max_len=64)
+    # kv term built from layers=4 (the stacked blocks), not 5000
+    assert cost.kv_bytes_per_device < 5000 * 8 * 64
+    hidden = CostModel._hidden_dim(t)
+    assert cost.kv_bytes_per_device == pytest.approx(
+        2.0 * 4 * hidden * 64 * 2.0)
+
+
+def test_rank_serving_orders_and_reads_strategy(cfg):
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.simulator import rank_serving
+
+    trainable = make_pipeline_lm_trainable(
+        make_cfg(vocab=512, max_len=64), optax.sgd(0.1),
+        jax.random.PRNGKey(0))
+    rs = ResourceSpec({"topology": {"platform": "cpu", "num_devices": 4}})
+    ranked = rank_serving(trainable, rs,
+                          link_profile={"ici_gbps": 1e4,
+                                        "hop_alpha_s": 1e-9})
+    assert len(ranked) >= 4          # tp1 + tp{2,4} x vocab{off,on}
+    scores = [cost.score for _, cost in ranked]
+    assert scores == sorted(scores)
+    assert ranked[0][1].tensor_parallel > 1       # fast link: tp wins
+
+
+# --------------------------------------------------------------------- #
+# acceptance: examples/serve.py --smoke + telemetry --check (CI smoke)
+# --------------------------------------------------------------------- #
+@pytest.fixture(scope="module")
+def serve_smoke_run(tmp_path_factory):
+    out = tmp_path_factory.mktemp("serve_tel")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "PYTHONPATH": REPO,
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples/serve.py"),
+         "--smoke", "--telemetry-dir", str(out)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    return out, proc.stdout
+
+
+def test_serve_smoke_subprocess(serve_smoke_run):
+    out, stdout = serve_smoke_run
+    assert "serve smoke ok" in stdout
+    assert "tokens/s aggregate" in stdout
+    assert "serving configs by predicted token latency" in stdout
+    with open(out / "metrics.jsonl") as f:
+        recs = [json.loads(line) for line in f]
+    serves = [r for r in recs if r.get("kind") == "serve"]
+    assert len(serves) == 4
+    assert all(r["ttft_ms"] > 0 and r["tokens"] >= 1 for r in serves)
+
+
+def test_serve_smoke_report_check(serve_smoke_run):
+    out, _ = serve_smoke_run
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import telemetry_report
+    finally:
+        sys.path.pop(0)
+    assert telemetry_report.main([str(out), "--check"]) == 0
+    md = telemetry_report.render(str(out))
+    assert "## serving" in md

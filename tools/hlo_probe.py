@@ -30,6 +30,12 @@ asserts collective *counts and kinds* in the optimized HLO text:
   anywhere (distinctive-dimension shape scan), vs. the replicated
   baseline which carries the ``[V, H]`` table and ``[.., V]`` logits —
   a silent re-replication of the loss head fails CI on CPU.
+* ``probe_decode`` — the serving engine's fused decode step
+  (``autodist_tpu/serving/``): the vocab-parallel tp=2 program carries
+  zero full-vocab buffers, no ``[T, T]`` attention-score square, KV
+  writes via in-place ``dynamic-update-slice`` on donated (aliased)
+  cache buffers with no full-cache copy, and one fused ``while`` loop
+  per K-token window.
 * ``probe_zero3`` — ZeRO-2/3 on the tp×dp mesh
   (``Pipeline(zero_stage=...)``): the stage-3 program's *step boundary*
   (the ENTRY signature: donated-in state + returned state) carries ZERO
@@ -95,6 +101,46 @@ def buffers_with_dim(hlo_text: str, dim: int) -> int:
     for m in _SHAPE_RE.finditer(hlo_text):
         dims = [int(d) for d in m.group(1).split(",") if d]
         if dim in dims:
+            hits += 1
+    return hits
+
+
+def buffers_with_dim_repeated(hlo_text: str, dim: int,
+                              times: int = 2) -> int:
+    """Count array shapes carrying ``dim`` at least ``times`` times —
+    e.g. a ``[.., T, T]`` attention-score square at a distinctive
+    sequence extent, which a single-token decode step must never
+    build."""
+    hits = 0
+    for m in _SHAPE_RE.finditer(hlo_text):
+        dims = [int(d) for d in m.group(1).split(",") if d]
+        if dims.count(dim) >= times:
+            hits += 1
+    return hits
+
+
+_DUS_RE = re.compile(r"dynamic-update-slice(?:-start)?\(")
+_COPY_RE = re.compile(r"=\s*(?:\([^)]*\)|\S+?\[([0-9,]*)\]\S*)\s*copy\(")
+
+
+def dynamic_update_slices(hlo_text: str) -> int:
+    """Count dynamic-update-slice ops (fused or top-level)."""
+    return len(_DUS_RE.findall(hlo_text))
+
+
+def large_copies_with_dim(hlo_text: str, dim: int, min_volume: int) -> int:
+    """Count ``copy`` ops whose result shape carries ``dim`` AND at
+    least ``min_volume`` elements — the signature of a full-cache
+    round-trip (small layout copies of token-shaped slices pass)."""
+    hits = 0
+    for m in _COPY_RE.finditer(hlo_text):
+        if m.group(1) is None:
+            continue
+        dims = [int(d) for d in m.group(1).split(",") if d]
+        vol = 1
+        for d in dims:
+            vol *= d
+        if dim in dims and vol >= min_volume:
             hits += 1
     return hits
 
@@ -465,6 +511,103 @@ def probe_zero3() -> dict:
             "collectives_stage3": c3}
 
 
+# Decode-probe geometry: T (cache max_len) and V (vocab) are chosen
+# distinctive — no other tensor dimension equals either, so a shape scan
+# hit IS the buffer the claim forbids.
+_DEC_T = 57
+_DEC_V = 93
+_DEC_LAYERS = 2
+_DEC_SLOTS = 3
+
+
+@functools.lru_cache(maxsize=None)
+def _decode_step_text(tensor_parallel: int, vocab_parallel: bool) -> str:
+    """Optimized HLO of one fused-decode dispatch of the serving
+    engine (memoized like the pipeline texts)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+    from autodist_tpu.serving import ServingEngine
+
+    cfg = TransformerConfig(vocab_size=_DEC_V, hidden_size=16,
+                            num_layers=_DEC_LAYERS, num_heads=2,
+                            mlp_dim=32, max_len=_DEC_T, dtype=jnp.float32,
+                            dropout_rate=0.0, attention_dropout_rate=0.0)
+    params = make_pipeline_lm_trainable(
+        cfg, optax.sgd(0.1), jax.random.PRNGKey(0)).params
+    engine = ServingEngine(cfg, params, tensor_parallel=tensor_parallel,
+                           vocab_parallel=vocab_parallel,
+                           num_slots=_DEC_SLOTS, max_len=_DEC_T,
+                           prefill_len=8, decode_steps=4)
+    return engine.compiled_decode_text()
+
+
+def probe_decode() -> dict:
+    """The serving engine's decode-step memory/dispatch claims,
+    structurally: the vocab-parallel tp=2 program carries ZERO
+    full-vocab buffers (vs the tp=1 baseline, which carries the ``[V,H]``
+    table and ``[B,V]`` logits — the scan-validity control); neither
+    program builds a ``[T, T]`` attention-score square (decode scores
+    live at ``[B, heads, 1, T]``); the KV cache updates via in-place
+    ``dynamic-update-slice`` (>= 2 per layer: k and v) with the cache
+    buffers donated/aliased and no full-cache-sized copy anywhere; and
+    the K-token window is ONE module with a fused ``while`` loop — one
+    dispatch per K tokens, the ``run_steps`` property at decode time."""
+    tp = 2
+    base = _decode_step_text(1, False)
+    vp = _decode_step_text(tp, True)
+    V_pad = _DEC_V + (-_DEC_V) % tp
+    base_full = buffers_with_dim(base, _DEC_V)
+    assert base_full > 0, (
+        "tp=1 baseline decode shows no full-vocab buffer — the probe's "
+        "distinctive-dim scan is broken, not proving anything")
+    leaks = buffers_with_dim(vp, _DEC_V) + buffers_with_dim(vp, V_pad)
+    assert leaks == 0, (
+        f"vocab-parallel decode materializes {leaks} full-vocab-sized "
+        f"buffer(s) (dim {_DEC_V}/{V_pad}) — the greedy epilogue "
+        "re-replicated (or a vocab-axis all-gather assembled the logits)")
+    report = {"vocab_size": _DEC_V, "max_len": _DEC_T,
+              "baseline_full_vocab_buffers": base_full,
+              "vocab_parallel_full_vocab_buffers": leaks}
+    # one layer's cache lane [slots, heads_local, T, head_dim] is the
+    # smallest buffer a "full-cache copy" could round-trip
+    cfg_head_dim = 8
+    for name, text, heads_local in (("tp1", base, 2), ("vp", vp, 1)):
+        squares = buffers_with_dim_repeated(text, _DEC_T)
+        assert squares == 0, (
+            f"{name} decode builds {squares} [{_DEC_T}, {_DEC_T}]-extent "
+            "buffer(s) — a full-sequence attention-score square in a "
+            "single-token step")
+        dus = dynamic_update_slices(text)
+        assert dus >= 2 * _DEC_LAYERS, (
+            f"{name} decode emits only {dus} dynamic-update-slice(s); "
+            f"expected >= {2 * _DEC_LAYERS} (k and v per layer) — the "
+            "KV write lowered to something else (scatter/concat)")
+        lane_n = _DEC_SLOTS * heads_local * _DEC_T * cfg_head_dim
+        cache_copies = large_copies_with_dim(text, _DEC_T, lane_n)
+        assert cache_copies == 0, (
+            f"{name} decode copies {cache_copies} cache-lane-sized "
+            f"buffer(s) per dispatch — the in-place update regressed "
+            "to copy-on-write")
+        assert " while(" in text or "while (" in text, (
+            f"{name} decode lowered without a fused loop — K token "
+            "steps are dispatching separately")
+        assert "input_output_alias" in text, (
+            f"{name} decode carries no input/output aliasing — the "
+            "donated KV cache is being re-allocated every dispatch")
+        report[f"dynamic_update_slices_{name}"] = dus
+        report[f"collectives_{name}"] = collective_counts(text)
+    assert report["collectives_vp"]["all-reduce"] >= 2 * _DEC_LAYERS, (
+        "vocab-parallel tp=2 decode misses the per-layer Megatron "
+        f"boundary all-reduces: {report['collectives_vp']}")
+    assert sum(report["collectives_tp1"].values()) == 0, (
+        f"tp=1 decode carries collectives: {report['collectives_tp1']}")
+    return report
+
+
 PROBES = {
     "steps_per_loop": probe_steps_per_loop,
     "single_replica": probe_single_replica,
@@ -472,6 +615,7 @@ PROBES = {
     "collective_matmul": probe_collective_matmul,
     "vocab_parallel": probe_vocab_parallel,
     "zero3": probe_zero3,
+    "decode": probe_decode,
 }
 
 

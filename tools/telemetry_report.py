@@ -21,7 +21,10 @@ import sys
 import numpy as np
 
 _STEP_KEYS = {"kind", "step", "duration_ms"}
-_KINDS = ("step", "counter", "gauge", "histogram")
+# Per-request serving records (autodist_tpu/serving/batcher.py): the
+# latency facts the serving section aggregates.
+_SERVE_KEYS = {"kind", "request", "tokens", "ttft_ms", "tokens_per_sec"}
+_KINDS = ("step", "serve", "counter", "gauge", "histogram")
 
 
 def load_jsonl(path: str) -> list[dict]:
@@ -60,6 +63,12 @@ def check_schema(run_dir: str) -> list[str]:
             if missing:
                 problems.append(
                     f"metrics.jsonl:{i + 1}: step record missing "
+                    f"{sorted(missing)}")
+        elif kind == "serve":
+            missing = _SERVE_KEYS - set(rec)
+            if missing:
+                problems.append(
+                    f"metrics.jsonl:{i + 1}: serve record missing "
                     f"{sorted(missing)}")
         elif "name" not in rec:
             problems.append(f"metrics.jsonl:{i + 1}: {kind} without name")
@@ -114,6 +123,7 @@ def render(run_dir: str) -> str:
     """The markdown report for one flushed run directory."""
     records = load_jsonl(os.path.join(run_dir, "metrics.jsonl"))
     steps = [r for r in records if r.get("kind") == "step"]
+    serves = [r for r in records if r.get("kind") == "serve"]
     counters = [r for r in records if r.get("kind") == "counter"]
     gauges = [r for r in records if r.get("kind") == "gauge"]
     hists = [r for r in records if r.get("kind") == "histogram"]
@@ -156,6 +166,32 @@ def render(run_dir: str) -> str:
                   f"| {_fmt(rate)} |", ""]
     else:
         lines += ["(no per-step records)", ""]
+
+    if serves:
+        # A serving run: per-request TTFT + the fused-window-attributed
+        # inter-token latencies (autodist_tpu/serving/batcher.py), with
+        # the histogram instruments carrying the exact per-token
+        # distributions when present.
+        ttft = np.asarray([r["ttft_ms"] for r in serves], float)
+        tokens = sum(int(r.get("tokens", 0)) for r in serves)
+        itl = next((h for h in hists
+                    if h["name"] == "serve/inter_token_ms"), None)
+        rates = [r["tokens_per_sec"] for r in serves
+                 if r.get("tokens_per_sec")]
+        depth = next((g["value"] for g in gauges
+                      if g["name"] == "serve/queue_depth"), None)
+        lines += ["## serving", "",
+                  "| requests | tokens | ttft p50 ms | ttft p99 ms | "
+                  "inter-token p50 ms | inter-token p99 ms | tokens/s "
+                  "(per-request p50) | queue depth |",
+                  "|---|---|---|---|---|---|---|---|",
+                  f"| {len(serves)} | {tokens} "
+                  f"| {_fmt(float(np.percentile(ttft, 50)))} "
+                  f"| {_fmt(float(np.percentile(ttft, 99)))} "
+                  f"| {_fmt(itl['p50'] if itl else None)} "
+                  f"| {_fmt(itl['p99'] if itl else None)} "
+                  f"| {_fmt(float(np.percentile(rates, 50)) if rates else None)} "
+                  f"| {_fmt(depth)} |", ""]
 
     if counters or gauges:
         lines += ["## counters / gauges", "", "| name | value |", "|---|---|"]
