@@ -118,8 +118,30 @@ def local_axis_shard(x, axis_name: str, n: int, axis: int):
 # state — the structural property ``tools/hlo_probe.py probe_zero3``
 # asserts on CPU.
 # --------------------------------------------------------------------------- #
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
-def zero3_gather(shard, axis_entry, n: int, shape: tuple):
+def _zero3_gather_impl(shard, axis_entry, shape, precision: str):
+    if precision == "fp32":
+        return all_gather_flat(shard, axis_entry, shape)
+    from autodist_tpu.kernel import quantize as qz
+
+    full = qz.quantized_all_gather_flat(shard, axis_entry, precision)
+    size = math.prod(shape) if shape else 1
+    return full[:size].reshape(shape).astype(shard.dtype)
+
+
+def _zero3_scatter_impl(ct, axis_entry, n: int, precision: str):
+    if precision == "fp32":
+        return reduce_scatter_flat(ct, axis_entry, n, mean=False)
+    from autodist_tpu.kernel import quantize as qz
+
+    flat = ct.reshape(-1)
+    flat = pad_axis_to(flat, 0, padded_flat_size(flat.size, n))
+    return qz.quantized_psum_scatter_flat(
+        flat, axis_entry, precision).astype(ct.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def zero3_gather(shard, axis_entry, n: int, shape: tuple,
+                 precision: str = "fp32"):
     """Materialize one full parameter from its flat ZeRO-3 shard.
 
     ``shard``: the local ``[padded/n]`` flat chunk (``local_flat_shard``
@@ -128,16 +150,22 @@ def zero3_gather(shard, axis_entry, n: int, shape: tuple):
     shape.  Backward: the cotangent reduce-scatters (sum — divide by the
     data-replica count where a mean is wanted) into shard form, so the
     gradient of a sharded-stored parameter is born sharded.
+
+    ``precision`` (the Strategy IR policy's ``zero3_gather`` slot)
+    narrows both directions: the forward gather carries a TRUE ``s8``
+    (or ``bf16``) wire — a gather never sums, so each source shard's
+    scale rides alongside — and the backward cotangent reduce-scatter
+    sums int8 levels on an fp16 wire (``kernel/quantize.py``).
     """
-    return all_gather_flat(shard, axis_entry, shape)
+    return _zero3_gather_impl(shard, axis_entry, shape, precision)
 
 
-def _zero3_gather_fwd(shard, axis_entry, n, shape):
-    return all_gather_flat(shard, axis_entry, shape), None
+def _zero3_gather_fwd(shard, axis_entry, n, shape, precision):
+    return _zero3_gather_impl(shard, axis_entry, shape, precision), None
 
 
-def _zero3_gather_bwd(axis_entry, n, shape, _, ct):
-    return (reduce_scatter_flat(ct, axis_entry, n, mean=False),)
+def _zero3_gather_bwd(axis_entry, n, shape, precision, _, ct):
+    return (_zero3_scatter_impl(ct, axis_entry, n, precision),)
 
 
 zero3_gather.defvjp(_zero3_gather_fwd, _zero3_gather_bwd)
@@ -177,7 +205,7 @@ def gather_sentinel(full):
     return lax.slice(full.reshape(-1), (0,), (1,))
 
 
-def make_chained_gather():
+def make_chained_gather(precision: str = "fp32"):
     """ONE implementation of the layer-ordered ZeRO-3 gather chain (both
     the replicated-SPMD and pipeline lowerings materialize shards with
     it): returns ``gather(shard, axis_entry, n, shape)`` whose
@@ -186,13 +214,15 @@ def make_chained_gather():
     :func:`chain_gathers`, so XLA can neither combine the per-layer
     gathers into one bulk materialization nor reorder them, and the
     next layer's gather can prefetch under the current layer's compute.
-    Call in layer order; make a fresh chain per traced function."""
+    Call in layer order; make a fresh chain per traced function.
+    ``precision`` is the Strategy IR policy's ``zero3_gather`` slot,
+    applied to every gather in the chain (:func:`zero3_gather`)."""
     token = [None]
 
     def gather(shard, axis_entry, n: int, shape):
         s = shard if token[0] is None else chain_gathers(shard, token[0])
         full = zero3_gather(s, axis_entry, n,
-                            tuple(int(d) for d in shape))
+                            tuple(int(d) for d in shape), precision)
         token[0] = gather_sentinel(full)
         return full
 

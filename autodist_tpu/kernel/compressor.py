@@ -15,6 +15,12 @@ returns the averaged gradient and new per-device compressor state (error
 residual for EF variants).  State leaves live in the TrainState so the
 residual persists across steps (≙ the reference's error-feedback mixin
 instance state).
+
+The int8 pack/unpack and error-feedback arithmetic live in
+:mod:`autodist_tpu.kernel.quantize` — ONE implementation shared with the
+per-boundary precision policy's quantized collectives (PR 8), so a fix
+to the scale/rounding rules lands on both the dp-grad path and the
+boundary path at once.
 """
 from __future__ import annotations
 
@@ -24,6 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+from autodist_tpu.kernel import quantize as qz
 
 
 class Compressor:
@@ -107,9 +115,9 @@ class _ErrorFeedback(Compressor):
         raise NotImplementedError
 
     def allreduce(self, grad, state, axis_name):
-        corrected = grad.astype(jnp.float32) + state
+        corrected = qz.ef_correct(grad, state)
         wire = self._wire(corrected)
-        new_state = corrected - wire.astype(jnp.float32)
+        new_state = qz.ef_residual(corrected, wire)
         summed = lax.psum(wire, axis_name)  # collective at wire width
         n = lax.psum(jnp.ones((), jnp.float32), axis_name)
         return (summed.astype(jnp.float32) / n).astype(grad.dtype), new_state
@@ -236,11 +244,9 @@ class Int8RingCompressor(Compressor):
         # allreduce adds the residual to the *flattened* gradient.
         return jnp.zeros(max(int(np.prod(leaf.shape)), 1), jnp.float32)
 
-    @staticmethod
-    def _quant(x):
-        scale = jnp.maximum(jnp.max(jnp.abs(x)) / 127.0, 1e-20)
-        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
-        return q, scale
+    # One shared-module implementation of the per-chunk int8 pack
+    # (kernel/quantize.py): the ring's wire IS quantize_int8's (q, scale).
+    _quant = staticmethod(qz.quantize_int8)
 
     def allreduce(self, grad, state, axis_name):
         p = lax.axis_size(axis_name)
@@ -319,11 +325,10 @@ class Int8EFCompressor(_ErrorFeedback):
     name = "int8_ef"
 
     def allreduce(self, grad, state, axis_name):
-        corrected = grad.astype(jnp.float32) + state
-        scale = lax.pmax(jnp.max(jnp.abs(corrected)), axis_name) / 127.0
-        scale = jnp.maximum(scale, 1e-20)
-        q = jnp.clip(jnp.round(corrected / scale), -127, 127)
-        new_state = corrected - q * scale
+        corrected = qz.ef_correct(grad, state)
+        scale = qz.shared_scale(corrected, axis_name)
+        q = qz.quantize_levels(corrected, scale)
+        new_state = qz.ef_residual(corrected, q * scale)
         summed = lax.psum(q.astype(jnp.float16), axis_name).astype(jnp.float32) * scale
         n = lax.psum(jnp.ones((), jnp.float32), axis_name)
         return (summed / n).astype(grad.dtype), new_state

@@ -69,6 +69,21 @@ class VarPolicy:
     scale: float = 1.0
 
 
+def emit_precision_gauges(precision: dict):
+    """Per-boundary ``precision/<boundary>_bits`` gauges — emitted by
+    EVERY lowering that applies a precision policy (pipeline and the
+    replicated-SPMD builder alike), so ``tools/telemetry_report.py
+    --check`` can gate a run's declared policy against what actually
+    lowered regardless of which lowering ran."""
+    if not precision:
+        return
+    from autodist_tpu import telemetry
+    from autodist_tpu.strategy.ir import PRECISION_BITS
+
+    for b, p in precision.items():
+        telemetry.get().gauge(f"precision/{b}_bits").set(PRECISION_BITS[p])
+
+
 def ssp_staleness_from(strategy) -> int:
     """Max PS ``staleness`` over the strategy's node configs — the
     bound the runner's host-side SSP gate enforces (the gate is
@@ -226,8 +241,8 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
                           grad_sync: Optional[Callable] = None,
                           accum: int = 1,
                           policies: Optional[dict] = None,
-                          zero_degraded: Optional[dict] = None
-                          ) -> SimpleLowered:
+                          zero_degraded: Optional[dict] = None,
+                          precision=None) -> SimpleLowered:
     """Compile a train/eval step for a (mostly) replicated-parameter
     strategy.
 
@@ -245,9 +260,29 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
       accum: gradient-accumulation microbatch count.
       policies: per-variable :class:`VarPolicy` map (ZeRO-1 /
         compressors) — see :func:`policies_from_node_configs`.
+      precision: the Strategy IR's per-collective precision policy
+        (normalized dict).  The ``zero3_gather`` slot narrows the
+        on-demand parameter gathers (and their backward cotangent
+        reduce-scatters); the ``grad`` slot elects the matching EF
+        compressor on every plain-synced variable without an explicit
+        compressor or ZeRO policy.
     """
+    from autodist_tpu.strategy.ir import normalize_precision
+
     opt = trainable.optimizer
-    policies = policies or {}
+    policies = dict(policies or {})
+    precision = normalize_precision(precision)
+    emit_precision_gauges(precision)
+    zero3_precision = precision.get("zero3_gather", "fp32")
+    grad_prec = precision.get("grad", "fp32")
+    if grad_prec != "fp32" and grad_sync is None:
+        # Only where the default pmean-over-sync_axes sync applies: a
+        # custom grad_sync (the expert lowering's scaled per-variable
+        # rule) encodes semantics a blanket compressor would break.
+        comp = {"bf16": "bf16_ef", "int8": "int8_ef"}[grad_prec]
+        for info in trainable.var_infos():
+            if info.name not in policies:
+                policies[info.name] = VarPolicy(compressor=comp)
     if param_spec_fn is None:
         param_spec_fn = lambda name, leaf: P()  # noqa: E731
     if grad_sync is None:
@@ -340,8 +375,9 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
         """Materialize ZeRO-3 shards into full parameters for the loss
         (per-variable gathers, chained layer-order so XLA cannot merge
         them into one bulk materialization; the custom VJP makes their
-        gradients born sharded)."""
-        gather = common.make_chained_gather()
+        gradients born sharded).  The policy's ``zero3_gather`` slot
+        narrows every gather in the chain."""
+        gather = common.make_chained_gather(zero3_precision)
 
         def one(name, p):
             if not zero3(name):

@@ -183,7 +183,8 @@ def lower_expert_ir(trainable, strategy, mesh):
         batch_spec=batch_spec, param_spec_fn=param_spec,
         grad_sync=sync_grad,
         accum=max(strategy.graph_config.accum_steps, 1),
-        policies=policies, zero_degraded=degraded)
+        policies=policies, zero_degraded=degraded,
+        precision=strategy.graph_config.precision)
 
 
 def dense_moe_reference(tokens, gate_w, expert_wi, expert_wo,

@@ -272,6 +272,11 @@ class _PipelineLowered(SimpleLowered):
     # (tp-sharded stage vars, stage-3 on the vocab-sharded table): the
     # plan record that replaced the old warn-and-degrade logging.
     zero_degraded: Any = None
+    # The resolved per-collective precision policy this program lowered
+    # with (normalized boundary -> precision dict; {} = fp32
+    # everywhere) — the plan record a caller can audit without
+    # re-deriving the graph/per-variable adoption rules.
+    precision: Any = None
 
     def unpad_params(self, params):
         if self.perm_inv is None:
@@ -328,7 +333,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                     remat: bool = False, tp_specs=None,
                     model_axis: str = const.MODEL_AXIS,
                     comm_overlap=None, shared_specs=None,
-                    zero_degraded=None):
+                    zero_degraded=None, precision=None):
     """Shared construction for the direct API and the Strategy-IR entry;
     returns a Lowered-contract container.
 
@@ -440,6 +445,14 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     tp_specs = dict(tp_specs or {})
     shared_specs = dict(shared_specs or {})
     comm_overlap = normalize_comm_overlap(comm_overlap)
+    # Per-collective precision policy (Strategy IR, normalized dict):
+    # tp_psum / vocab_stats apply through a trace-time scope around the
+    # step body (stage code keeps its signature); zero3_gather binds
+    # into the gather chain; the grad slot was already resolved into
+    # compressor configs by the builder / lower_pipeline_ir.
+    from autodist_tpu.strategy.ir import normalize_precision
+    precision = normalize_precision(precision)
+    zero3_precision = precision.get("zero3_gather", "fp32")
     tp = mesh.shape.get(model_axis, 1) if tp_specs else 1
     if (tp_specs or shared_specs) and model_axis not in mesh.shape:
         raise ValueError(
@@ -859,7 +872,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         differentiated state."""
         if not any_zero3:
             return vp
-        chained = common.make_chained_gather()
+        chained = common.make_chained_gather(zero3_precision)
 
         def gather(shard, pol, shape):
             return chained(shard, common.axes_entry(pol.zero_axes),
@@ -973,6 +986,16 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         return out
 
     def _local_step(state, batch, rng):
+        # The precision scope is opened INSIDE the traced function (jit
+        # traces at first call, not at build), so every tp/vocab
+        # boundary primitive — including the custom-VJP backwards
+        # linearized within value_and_grad below — resolves the policy
+        # at trace time.
+        from autodist_tpu.parallel.tensor import precision_scope
+        with precision_scope(precision):
+            return _local_step_impl(state, batch, rng)
+
+    def _local_step_impl(state, batch, rng):
         vparams = state["params"]  # local [V, ...] chunks
 
         def micro_grads(mb, rng_, extra_in, idx=0):
@@ -1084,8 +1107,10 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
 
     def _local_eval(state, batch, rng):
         # Eval is deterministic: no rng reaches the stages (dropout off).
-        _, metrics = _forward_loss(state["params"], batch, None)
-        return _broadcast_metrics(metrics)
+        from autodist_tpu.parallel.tensor import precision_scope
+        with precision_scope(precision):
+            _, metrics = _forward_loss(state["params"], batch, None)
+            return _broadcast_metrics(metrics)
 
     def _eval(state, batch, rng):
         return jax.shard_map(
@@ -1111,7 +1136,8 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                             perm_inv=perm_inv, has_shared=has_shared,
                             shared_orig_shapes=shared_orig_shapes,
                             zero3_shapes=zero3_shapes,
-                            zero_degraded=zero_degraded)
+                            zero_degraded=zero_degraded,
+                            precision=dict(precision))
 
 
 def lower_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
@@ -1205,6 +1231,44 @@ def lower_pipeline_ir(trainable, strategy, mesh):
                 "mode — set graph_config.parallel['comm_overlap']")
         overlap = var_overlaps.pop()
 
+    # Per-collective precision: the graph-level policy is canonical
+    # (normalize rejects hand-edited unknown boundaries/values with the
+    # named UnknownPrecisionError); per-variable partitioner fields are
+    # the cost model's record and may fill in a hand-edited strategy's
+    # missing tp_psum slot — the stage body lowers with ONE precision,
+    # so disagreeing per-variable values are rejected like comm_overlap.
+    from autodist_tpu.strategy.ir import normalize_precision
+    precision = dict(normalize_precision(cfg.precision))
+
+    def _var_precisions(stage_vars: bool) -> set:
+        """Per-variable partitioner precision records, split by slot:
+        tp-sharded STAGE variables carry the tp_psum slot, the
+        vocab-sharded SHARED table the vocab_stats slot — adopting one
+        into the other would silently narrow boundaries the policy
+        left at fp32."""
+        out = set()
+        for nc in strategy.node_configs:
+            part = nc.partitioner
+            if part is None or getattr(part, "precision", None) \
+                    in (None, "fp32"):
+                continue
+            is_stage = not trainable.has_shared \
+                or nc.var_name.startswith("stages/")
+            if is_stage == stage_vars:
+                out.add(part.precision)
+        return out
+
+    for slot, vps in (("tp_psum", _var_precisions(True)),
+                      ("vocab_stats", _var_precisions(False))):
+        if slot not in precision and vps:
+            if len(vps) > 1:
+                raise ValueError(
+                    f"per-variable collective precisions for the {slot} "
+                    f"boundary disagree ({sorted(vps)}); the stage body "
+                    "lowers with one policy — set graph_config.precision")
+            precision[slot] = vps.pop()
+    precision = normalize_precision(precision)
+
     # Per-variable synchronizer configs (PS -> ZeRO stages, compressors)
     # compose with the pipeline: stage variables zero/compress over the
     # data axes (they are pipe-sharded already), shared variables zero
@@ -1229,6 +1293,27 @@ def lower_pipeline_ir(trainable, strategy, mesh):
     policies = policies_from_node_configs(
         strategy, mesh, replicated_axes=shared_axes, axes_for=axes_for,
         sharded_vars=set(tp_specs), degraded=degraded)
+    # The grad slot resolves onto the compressor machinery (the one
+    # boundary whose reduction semantics need error-feedback state): a
+    # bf16/int8 grad policy elects the EF compressor on every AllReduce-
+    # synced variable that doesn't already carry an explicit compressor
+    # or a ZeRO policy — so a hand-edited strategy JSON with only
+    # graph_config.precision narrows its gradient sync too.
+    grad_prec = precision.get("grad", "fp32")
+    if grad_prec != "fp32":
+        from autodist_tpu.parallel._spmd import VarPolicy
+        from autodist_tpu.strategy.ir import AllReduceSynchronizer
+        comp = {"bf16": "bf16_ef", "int8": "int8_ef"}[grad_prec]
+        for nc in strategy.node_configs:
+            if (isinstance(nc.synchronizer, AllReduceSynchronizer)
+                    and (nc.synchronizer.compressor or "none") == "none"
+                    and nc.var_name not in policies):
+                policies[nc.var_name] = VarPolicy(compressor=comp)
+    # Per-boundary precision gauges: a lowering that silently dropped
+    # the policy would miss these, and `tools/telemetry_report.py
+    # --check` schema-gates them against the run's annotation.
+    from autodist_tpu.parallel._spmd import emit_precision_gauges
+    emit_precision_gauges(precision)
     if not d_axes:
         dropped = sorted(nm for nm, p in policies.items()
                          if p.compressor != "none")
@@ -1249,4 +1334,5 @@ def lower_pipeline_ir(trainable, strategy, mesh):
         policies=policies, stage_rng=trainable.stage_rng,
         remat=bool(cfg.parallel.get("remat", False)),
         tp_specs=tp_specs, comm_overlap=overlap,
-        shared_specs=shared_specs, zero_degraded=degraded)
+        shared_specs=shared_specs, zero_degraded=degraded,
+        precision=precision)

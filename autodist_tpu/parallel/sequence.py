@@ -49,7 +49,7 @@ def global_positions(local_len: int, *, seq_axis: str = const.SEQ_AXIS,
 
 def _build_sequence(trainable, mesh, *, seq_leaves: Sequence[str],
                     seq_axis: str, data_axis: str, accum: int = 1,
-                    policies=None):
+                    policies=None, precision=None):
     """Shared construction for both the direct API and the Strategy-IR
     lowering; returns a :class:`~autodist_tpu.kernel.lowering.SimpleLowered`.
 
@@ -94,7 +94,7 @@ def _build_sequence(trainable, mesh, *, seq_leaves: Sequence[str],
     return build_replicated_spmd(
         trainable, mesh, sync_axes=sync_axes,
         batch_spec_fn=batch_spec_fn, batch_spec=base_spec, accum=accum,
-        policies=policies)
+        policies=policies, precision=precision)
 
 
 def lower_sequence_parallel(trainable, mesh, *,
@@ -137,4 +137,5 @@ def lower_sequence_ir(trainable, strategy, mesh):
     return _build_sequence(
         trainable, mesh, seq_leaves=seq_leaves,
         seq_axis=seq_axis, data_axis=const.DATA_AXIS,
-        accum=max(cfg.accum_steps, 1), policies=policies)
+        accum=max(cfg.accum_steps, 1), policies=policies,
+        precision=cfg.precision)

@@ -55,16 +55,54 @@ parity within tolerance).
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from autodist_tpu.kernel import quantize as qz
+
+
+# --------------------------------------------------------------------------- #
+# Per-collective precision scope (the Strategy IR policy, PR 8)
+# --------------------------------------------------------------------------- #
+# The active wire precision per boundary slot, read by the primitives
+# below at TRACE time.  A scope (not a per-call kwarg) so the policy
+# reaches every boundary inside an arbitrary stage_fn/prologue/loss_head
+# without changing their signatures: the lowering opens the scope inside
+# its traced step body (tracing is single-threaded), stage code keeps
+# calling the primitives unchanged, and code outside any scope — the
+# sequential reference, the parity goldens — stays exactly fp32.
+_FP32_SLOTS = {"tp_psum": "fp32", "vocab_stats": "fp32"}
+_active_slots = dict(_FP32_SLOTS)
+
+
+@contextlib.contextmanager
+def precision_scope(policy):
+    """Activate a per-boundary precision policy (``{"tp_psum": ...,
+    "vocab_stats": ...}``; missing slots stay fp32) for the primitives
+    traced inside the ``with`` body."""
+    global _active_slots
+    prev = _active_slots
+    slots = dict(_FP32_SLOTS)
+    for k, v in (policy or {}).items():
+        if k in slots:
+            slots[k] = qz.check_precision(v, where=k)
+    _active_slots = slots
+    try:
+        yield
+    finally:
+        _active_slots = prev
+
+
+def active_precision(slot: str) -> str:
+    return _active_slots.get(slot, "fp32")
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def gather_grads(x, model_axis):
-    """Identity forward / psum-over-``model_axis`` backward (Megatron f)."""
+def _gather_grads_fp32(x, model_axis):
     return x
 
 
@@ -76,12 +114,40 @@ def _gather_grads_bwd(model_axis, _, ct):
     return (lax.psum(ct, model_axis),)
 
 
-gather_grads.defvjp(_gather_grads_fwd, _gather_grads_bwd)
+_gather_grads_fp32.defvjp(_gather_grads_fwd, _gather_grads_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _gather_grads_q(x, model_axis, precision):
+    return x
+
+
+def _gather_grads_q_fwd(x, model_axis, precision):
+    return x, None
+
+
+def _gather_grads_q_bwd(model_axis, precision, _, ct):
+    return (qz.quantized_psum(ct, model_axis, precision),)
+
+
+_gather_grads_q.defvjp(_gather_grads_q_fwd, _gather_grads_q_bwd)
+
+
+def gather_grads(x, model_axis):
+    """Identity forward / psum-over-``model_axis`` backward (Megatron f).
+
+    Under a non-fp32 ``tp_psum`` precision scope the backward cotangent
+    reduction narrows (:func:`~autodist_tpu.kernel.quantize
+    .quantized_psum`) — the custom-VJP wrapper is what lets a *backward*
+    boundary carry the policy too."""
+    prec = active_precision("tp_psum")
+    if prec == "fp32":
+        return _gather_grads_fp32(x, model_axis)
+    return _gather_grads_q(x, model_axis, prec)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def sum_partials(x, model_axis):
-    """psum-over-``model_axis`` forward / identity backward (Megatron g)."""
+def _sum_partials_fp32(x, model_axis):
     return lax.psum(x, model_axis)
 
 
@@ -93,7 +159,34 @@ def _sum_partials_bwd(model_axis, _, ct):
     return (ct,)
 
 
-sum_partials.defvjp(_sum_partials_fwd, _sum_partials_bwd)
+_sum_partials_fp32.defvjp(_sum_partials_fwd, _sum_partials_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _sum_partials_q(x, model_axis, precision):
+    return qz.quantized_psum(x, model_axis, precision)
+
+
+def _sum_partials_q_fwd(x, model_axis, precision):
+    return qz.quantized_psum(x, model_axis, precision), None
+
+
+def _sum_partials_q_bwd(model_axis, precision, _, ct):
+    return (ct,)
+
+
+_sum_partials_q.defvjp(_sum_partials_q_fwd, _sum_partials_q_bwd)
+
+
+def sum_partials(x, model_axis):
+    """psum-over-``model_axis`` forward / identity backward (Megatron g).
+
+    The forward reduction narrows to the active ``tp_psum`` precision
+    (fp32 outside any scope — the exact psum)."""
+    prec = active_precision("tp_psum")
+    if prec == "fp32":
+        return _sum_partials_fp32(x, model_axis)
+    return _sum_partials_q(x, model_axis, prec)
 
 
 # --------------------------------------------------------------------------- #
@@ -114,7 +207,7 @@ def normalize_comm_overlap(mode):
         f"got {mode!r}")
 
 
-def psum_decomposed(x, axis_name):
+def psum_decomposed(x, axis_name, precision: str = "fp32"):
     """All-reduce as an explicit reduce-scatter + all-gather pair.
 
     Mathematically ``lax.psum(x, axis_name)`` at ring-equivalent wire
@@ -125,7 +218,15 @@ def psum_decomposed(x, axis_name):
     the monolithic collective this exists to avoid (the HLO probe
     asserts it stays split).  Shapes need not divide the axis size —
     the flattened payload is zero-padded to divisibility.
+
+    ``precision`` narrows each half independently: the rs half sums
+    int8 levels on an fp16 wire, the ag half re-quantizes the fp32
+    shard onto a TRUE s8 wire (a gather never sums) — the per-hop
+    requantization trade of the EQuARX ring, bounded by the goldens'
+    tolerance.  The barrier stays between the halves, so the narrowed
+    pair is exactly as re-fusion-proof as the fp32 one.
     """
+    precision = qz.check_precision(precision)
     n = lax.axis_size(axis_name)
     if n == 1:
         return x
@@ -134,53 +235,68 @@ def psum_decomposed(x, axis_name):
     pad = (-size) % n
     if pad:
         flat = jnp.pad(flat, (0, pad))
-    shard = lax.psum_scatter(flat, axis_name, scatter_dimension=0,
-                             tiled=True)
-    shard = lax.optimization_barrier(shard)
-    full = lax.all_gather(shard, axis_name, axis=0, tiled=True)
+    if precision == "fp32":
+        shard = lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+                                 tiled=True)
+        shard = lax.optimization_barrier(shard)
+        full = lax.all_gather(shard, axis_name, axis=0, tiled=True)
+    else:
+        shard = qz.quantized_psum_scatter_flat(flat, axis_name, precision)
+        shard = lax.optimization_barrier(shard)
+        full = qz.quantized_all_gather_flat(shard, axis_name, precision)
+        full = full.astype(x.dtype)
     if pad:
         full = lax.slice_in_dim(full, 0, size)
     return full.reshape(x.shape)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _gather_grads_dec(x, model_axis, precision):
+    return x
+
+
+def _gather_grads_dec_fwd(x, model_axis, precision):
+    return x, None
+
+
+def _gather_grads_dec_bwd(model_axis, precision, _, ct):
+    return (psum_decomposed(ct, model_axis, precision),)
+
+
+_gather_grads_dec.defvjp(_gather_grads_dec_fwd, _gather_grads_dec_bwd)
+
+
 def gather_grads_decomposed(x, model_axis):
     """Identity forward / decomposed (rs+ag) psum backward — the
     ``comm_overlap`` form of :func:`gather_grads` for column-parallel
     inputs: the backward cotangent reduction stops being a monolithic
-    all-reduce."""
-    return x
+    all-reduce (and narrows to the active ``tp_psum`` precision)."""
+    return _gather_grads_dec(x, model_axis, active_precision("tp_psum"))
 
 
-def _gather_grads_dec_fwd(x, model_axis):
-    return x, None
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _sum_partials_dec(x, model_axis, precision):
+    return psum_decomposed(x, model_axis, precision)
 
 
-def _gather_grads_dec_bwd(model_axis, _, ct):
-    return (psum_decomposed(ct, model_axis),)
+def _sum_partials_dec_fwd(x, model_axis, precision):
+    return psum_decomposed(x, model_axis, precision), None
 
 
-gather_grads_decomposed.defvjp(_gather_grads_dec_fwd, _gather_grads_dec_bwd)
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def sum_partials_decomposed(x, model_axis):
-    """Decomposed (rs+ag) psum forward / identity backward — the
-    ``comm_overlap="rsag"`` form of :func:`sum_partials` for
-    row-parallel outputs."""
-    return psum_decomposed(x, model_axis)
-
-
-def _sum_partials_dec_fwd(x, model_axis):
-    return psum_decomposed(x, model_axis), None
-
-
-def _sum_partials_dec_bwd(model_axis, _, ct):
+def _sum_partials_dec_bwd(model_axis, precision, _, ct):
     return (ct,)
 
 
-sum_partials_decomposed.defvjp(_sum_partials_dec_fwd,
-                               _sum_partials_dec_bwd)
+_sum_partials_dec.defvjp(_sum_partials_dec_fwd,
+                         _sum_partials_dec_bwd)
+
+
+def sum_partials_decomposed(x, model_axis):
+    """Decomposed (rs+ag) psum forward / identity backward — the
+    ``comm_overlap="rsag"`` form of :func:`sum_partials` for
+    row-parallel outputs (narrowed to the active ``tp_psum``
+    precision)."""
+    return _sum_partials_dec(x, model_axis, active_precision("tp_psum"))
 
 
 def _ring_matmul_fwd_impl(x, kernel, model_axis, axes):
@@ -335,12 +451,16 @@ def vocab_parallel_cross_entropy(x, embedding, targets, *, vocab_size: int,
     n_chunks = L // chunk
     rows = embedding.shape[0]
     neg_inf = jnp.finfo(jnp.float32).min
+    # The epilogue's statistics boundaries (sum-exp / target-logit /
+    # backward hidden-cotangent psums, the stabilizing pmax) narrow to
+    # the active vocab_stats precision; fp32 outside any scope.
+    stats_prec = active_precision("vocab_stats")
 
     def _psum(v):
         if model_axis is None:
             return v
-        return (psum_decomposed(v, model_axis) if overlap
-                else lax.psum(v, model_axis))
+        return (psum_decomposed(v, model_axis, stats_prec) if overlap
+                else qz.quantized_psum(v, model_axis, stats_prec))
 
     def shard_start():
         if model_axis is None:
@@ -369,7 +489,15 @@ def vocab_parallel_cross_entropy(x, embedding, targets, *, vocab_size: int,
             xc, tc = args
             logits = chunk_logits(xc, emb)
             m_loc = jnp.max(logits, axis=-1)
-            m = m_loc if model_axis is None else lax.pmax(m_loc, model_axis)
+            # Under a narrowed policy the argmax election must compare in
+            # the *rounded* domain: the winner's bf16-rounded max equals
+            # the pmax result exactly, while its fp32 value might sit
+            # below a rounded-up group max (every shard would then
+            # propose vocab_size — an invalid prediction).
+            if model_axis is not None and stats_prec != "fp32":
+                m_loc = m_loc.astype(jnp.bfloat16).astype(jnp.float32)
+            m = m_loc if model_axis is None \
+                else qz.quantized_pmax(m_loc, model_axis, stats_prec)
             s = _psum(jnp.sum(jnp.exp(logits - m[..., None]), axis=-1))
             loc = tc - start
             in_shard = (loc >= 0) & (loc < rows)

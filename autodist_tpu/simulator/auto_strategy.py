@@ -86,6 +86,19 @@ def default_candidates() -> list[StrategyBuilder]:
         parallel_builders.Pipeline(num_microbatches=4, zero_stage=3),
         parallel_builders.Pipeline(num_microbatches=4, tensor_parallel=2,
                                    zero_stage=3),
+        # Quantized-collective variants (the per-collective precision
+        # policy, EQuARX-style): the same dp×pp×tp composition with
+        # every boundary narrowed.  The cost model halves/quarters each
+        # policied boundary's wire bytes and charges the calibrated
+        # quantize/dequantize compute against it, so these rank above
+        # their fp32 siblings exactly when the plan is comm-bound —
+        # bytes saved > q/dq passes — and below them on compute-bound
+        # links where narrowing buys nothing.
+        parallel_builders.Pipeline(num_microbatches=4, tensor_parallel=2,
+                                   collective_precision="int8"),
+        parallel_builders.Pipeline(num_microbatches=4, tensor_parallel=2,
+                                   vocab_parallel=True,
+                                   collective_precision="int8"),
         parallel_builders.ExpertParallel(),
     ]
 

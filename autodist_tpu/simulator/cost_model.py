@@ -72,6 +72,38 @@ LINK_PROFILE: dict = {}
 # ranking overlapped vs blocking plans.
 _DEFAULT_MXU_EFFICIENCY = 0.4
 
+# Per-collective precision pricing (the Strategy IR policy, PR 8).
+# Wire factors per boundary mechanism: a *summing* collective carries
+# int8 levels on an fp16 wire (kernel/quantize.py), so int8 and bf16
+# both halve psum bytes; a *gather* never sums and rides a TRUE s8
+# wire — the full 4x.
+PSUM_WIRE_FACTOR = {"fp32": 1.0, "bf16": 0.5, "int8": 0.5}
+GATHER_WIRE_FACTOR = {"fp32": 1.0, "bf16": 0.5, "int8": 0.25}
+
+# Quantize/dequantize compute per payload element (seconds) — the term
+# byte counts miss: narrowing only wins when the bytes saved outweigh
+# these passes.  Analytic defaults (a cast is one memory-bound pass;
+# int8 adds the abs-max reduction and round/clip); a ``"quant"`` section
+# in calibration.json (written by ``tools/calibrate_compressors.py``)
+# replaces them with measured values, exactly like the ``"link"``
+# constants.
+QUANT_PROFILE: dict = {
+    "bf16_s_per_elem": 2e-11,
+    "int8_s_per_elem": 1e-10,
+}
+
+# The grad slot's realization: which EF compressor a bf16/int8 gradient
+# policy elects (mirrors lower_pipeline_ir / build_replicated_spmd).
+_GRAD_PRECISION_COMPRESSOR = {"bf16": "bf16_ef", "int8": "int8_ef"}
+
+
+def _qdq_s_per_elem(profile: dict, precision: str) -> float:
+    if precision == "fp32":
+        return 0.0
+    return float(profile.get(f"{precision}_s_per_elem",
+                             QUANT_PROFILE.get(f"{precision}_s_per_elem",
+                                               0.0)))
+
 
 def load_calibration(path: Optional[str] = None) -> dict:
     """Merge measured compressor factors into :data:`COMPRESSOR_FACTOR`.
@@ -114,6 +146,10 @@ def load_calibration(path: Optional[str] = None) -> dict:
             factors = dict(data.get("compressor_factor", {}))
             COMPRESSOR_FACTOR.update(factors)
             LINK_PROFILE.update(dict(data.get("link", {})))
+            # Measured quantize/dequantize per-element costs (the
+            # ``"quant"`` section ``tools/calibrate_compressors.py``
+            # emits) replace the analytic q/dq defaults the same way.
+            QUANT_PROFILE.update(dict(data.get("quant", {})))
             return factors
     return {}
 
@@ -165,6 +201,15 @@ class StrategyCost:
     # stages to the right term.
     param_shard_bytes: float = 0.0
     grad_shard_bytes: float = 0.0
+    # Per-collective precision policy terms: bytes the narrowed wire
+    # saves vs the same plan at fp32 (already reflected in comm_bytes/
+    # comm_time_s — broken out so the drift report can show the
+    # predicted bytes-on-wire delta), and the quantize/dequantize
+    # compute charged against it (also already inside comm_time_s): a
+    # narrowed candidate outranks fp32 exactly when saved wire time
+    # outweighs this term.
+    wire_bytes_saved: float = 0.0
+    quant_dq_time_s: float = 0.0
 
     @property
     def score(self) -> float:
@@ -205,7 +250,8 @@ class CostModel:
                  hbm_headroom: float = 0.6,
                  tokens_per_step: Optional[int] = None,
                  act_bytes_per_token: Optional[float] = None,
-                 link_profile: Optional[dict] = None):
+                 link_profile: Optional[dict] = None,
+                 quant_profile: Optional[dict] = None):
         """``sparsity_fraction``: expected fraction of embedding rows
         touched per step (drives the sparse gather/scatter volume).
         ``opt_state_multiplier``: optimizer slots per parameter byte
@@ -218,7 +264,11 @@ class CostModel:
         ``link_profile``: per-link constants for the overlap-aware
         pricing (keys ``ici_gbps``/``hop_alpha_s``/``mxu_efficiency``);
         overrides the calibration-file :data:`LINK_PROFILE`, which
-        overrides the chip-table defaults."""
+        overrides the chip-table defaults.
+        ``quant_profile``: quantize/dequantize per-element costs for the
+        precision-policy pricing (keys ``bf16_s_per_elem`` /
+        ``int8_s_per_elem``); same override chain as ``link_profile``
+        against :data:`QUANT_PROFILE`."""
         _ensure_calibration()
         self.spec = resource_spec
         self.chip = resource_spec.chip
@@ -230,6 +280,9 @@ class CostModel:
         self.link_profile = dict(LINK_PROFILE)
         if link_profile:
             self.link_profile.update(link_profile)
+        self.quant_profile = dict(QUANT_PROFILE)
+        if quant_profile:
+            self.quant_profile.update(quant_profile)
 
     # ------------------------------------------------------------------ #
     def _hints(self, trainable) -> tuple[Optional[int], Optional[float]]:
@@ -420,6 +473,22 @@ class CostModel:
         extra_colls = 0
         peak_logits = 0.0
 
+        # Per-collective precision policy (PR 8): wire factors shrink
+        # each policied boundary's bytes; the q/dq compute term charges
+        # the quantize/dequantize passes against the saving — a narrowed
+        # plan outranks fp32 exactly when the saved wire time exceeds it.
+        from autodist_tpu.strategy.ir import normalize_precision
+        policy = normalize_precision(strategy.graph_config.precision)
+        tp_prec = policy.get("tp_psum", "fp32")
+        stats_prec = policy.get("vocab_stats", "fp32")
+        z3_prec = policy.get("zero3_gather", "fp32")
+        grad_prec = policy.get("grad", "fp32")
+        qdq_s = 0.0
+        saved_bytes = 0.0
+
+        def qdq(elems: float, prec: str) -> float:
+            return elems * _qdq_s_per_elem(self.quant_profile, prec)
+
         def ring(k: int) -> float:
             return 2.0 * (k - 1) / k if k > 1 else 0.0
 
@@ -431,13 +500,32 @@ class CostModel:
 
         def node_factor(node) -> float:
             """Compressor wire factor (AllReduce nodes only; PS reduces
-            at full precision)."""
+            at full precision).  A non-fp32 ``grad`` precision slot
+            elects the matching EF compressor on every AllReduce node
+            without an explicit one — exactly what the lowerings do."""
             sync = getattr(node, "synchronizer", None)
             if sync is None or getattr(sync, "kind", "allreduce") == "ps":
                 return 1.0
-            return COMPRESSOR_FACTOR.get(
-                (getattr(sync, "compressor", "none") or "none")
-                .partition(":")[0], 1.0)
+            comp = (getattr(sync, "compressor", "none") or "none") \
+                .partition(":")[0]
+            if comp == "none" and grad_prec != "fp32":
+                comp = _GRAD_PRECISION_COMPRESSOR[grad_prec]
+            return COMPRESSOR_FACTOR.get(comp, 1.0)
+
+        def grad_bytes(node, full_bytes: float) -> float:
+            """Grad-sync bytes after the compressor/grad-policy factor,
+            recording the policy's saving (not an explicit compressor's
+            — that narrowing predates the policy and has no fp32
+            sibling to diff against)."""
+            nonlocal saved_bytes
+            scaled = full_bytes * node_factor(node)
+            sync = getattr(node, "synchronizer", None)
+            if (grad_prec != "fp32" and sync is not None
+                    and getattr(sync, "kind", "allreduce") != "ps"
+                    and (getattr(sync, "compressor", "none") or "none")
+                    == "none"):
+                saved_bytes += full_bytes - scaled
+            return scaled
 
         def node_is_ps(node) -> bool:
             return getattr(getattr(node, "synchronizer", None),
@@ -474,8 +562,8 @@ class CostModel:
                 grad_b += bytes_ / g_div
                 mem += bytes_ / p_div + bytes_ / g_div \
                     + bytes_ * opt_mult / opt_div
-                comm += (accum if stage >= 3 else 1) \
-                    * ring(n_sync) * bytes_ * node_factor(node)
+                comm += grad_bytes(node, (accum if stage >= 3 else 1)
+                                   * ring(n_sync) * bytes_)
                 colls += (2 * accum if stage >= 3
                           else 2 if opt_div > 1 else 1)
             if tokens:
@@ -554,9 +642,25 @@ class CostModel:
                         # through the memory gate alone (the
                         # auto_strategy zoo contract, pinned by
                         # test_zero_stage_ladder_memory_and_election).
+                        # The zero3_gather precision slot narrows both
+                        # directions: the forward gathers ride the
+                        # gather wire (true s8 at int8 — 4x), the
+                        # backward cotangent reduce-scatter the summing
+                        # wire (fp16 levels — 2x); q/dq passes charge
+                        # against the saving.  The stage-1 floor below
+                        # stays at fp32 on purpose: stage 1 is PS sync
+                        # (full precision), so z3 narrowing is a wire-
+                        # volume lever for the drift report, not a step-
+                        # time lever past the floor.
                         half = ring(n_data) / 2.0
-                        rs_bytes = accum * half * per_dev
-                        ag_bytes = accum * half * per_dev
+                        rs_bytes = accum * half * per_dev \
+                            * PSUM_WIRE_FACTOR[z3_prec]
+                        ag_bytes = accum * half * per_dev \
+                            * GATHER_WIRE_FACTOR[z3_prec]
+                        saved_bytes += 2.0 * accum * half * per_dev \
+                            - rs_bytes - ag_bytes
+                        qdq_s += qdq(2.0 * accum * half * per_dev / 4.0,
+                                     z3_prec)
                         comm += rs_bytes
                         colls += accum   # backward grad reduce-scatters
                         t_ag = ag_bytes / bw_link
@@ -578,7 +682,7 @@ class CostModel:
                         hidden_bytes += ag_bytes
                         extra_colls += accum * 2 * V
                     else:
-                        comm += ring(n_data) * per_dev * node_factor(node)
+                        comm += grad_bytes(node, ring(n_data) * per_dev)
                         colls += 2 if opt_div > 1 else 1
                     # rank >= 2 gates out the column-parallel biases
                     # (spec tail ['model']), which shard but never
@@ -591,8 +695,22 @@ class CostModel:
                             * width * _ACT_BYTES
                         mode = overlap_cfg or normalize_comm_overlap(
                             getattr(part, "comm_overlap", None))
+                        # Boundary precision: the graph policy's tp_psum
+                        # slot, or the per-variable partitioner record a
+                        # hand-edited strategy carries (the adoption
+                        # rule lower_pipeline_ir applies).
+                        prec_b = tp_prec if tp_prec != "fp32" else \
+                            (getattr(part, "precision", None) or "fp32")
+                        act_factor = PSUM_WIRE_FACTOR[prec_b]
+                        if prec_b != "fp32":
+                            # fwd + bwd payload elements per step, each
+                            # quantized before / dequantized after its
+                            # collective.
+                            qdq_s += qdq(2.0 * V * tokens_local * width,
+                                         prec_b)
                         if mode is None:
-                            comm += act_bytes
+                            comm += act_bytes * act_factor
+                            saved_bytes += act_bytes * (1.0 - act_factor)
                             colls += 2 * M * V
                         else:
                             # Latency-hiding decomposition: price the
@@ -623,7 +741,7 @@ class CostModel:
                             t_chunk = 2.0 * tok_e * (contract / tp) \
                                 * (width / tp) / flops_rate
                             t_wire = tok_e * (width / tp) * _ACT_BYTES \
-                                / bw_link
+                                * act_factor / bw_link
                             t_hop = t_wire + hop_alpha
                             t_blk = 2.0 * (tp - 1) * t_wire + hop_alpha
                             t_rsag = max(hop_alpha,
@@ -640,7 +758,8 @@ class CostModel:
                             # model charges its 2x on the row var.
                             bwd_t = min(t_rsag, t_blk)
                             overlap_s += execs * (fwd_t + bwd_t)
-                            hidden_bytes += act_bytes
+                            hidden_bytes += act_bytes * act_factor
+                            saved_bytes += act_bytes * (1.0 - act_factor)
                             extra_colls += execs * (
                                 (tp + 1 if mode == "matmul" else 2) + 2)
                 else:
@@ -669,14 +788,22 @@ class CostModel:
                         + per_dev * opt_mult / opt_div
                     if stage >= 3 and not v_sharded:
                         half = ring(n_pd) / 2.0
-                        comm += accum * half * per_dev
+                        rs_sh = accum * half * per_dev \
+                            * PSUM_WIRE_FACTOR[z3_prec]
+                        ag_sh = accum * half * per_dev \
+                            * GATHER_WIRE_FACTOR[z3_prec]
+                        saved_bytes += 2.0 * accum * half * per_dev \
+                            - rs_sh - ag_sh
+                        qdq_s += qdq(2.0 * accum * half * per_dev / 4.0,
+                                     z3_prec)
+                        comm += rs_sh
                         colls += accum   # backward grad reduce-scatters
-                        t_ag = accum * half * per_dev / bw_link
+                        t_ag = ag_sh / bw_link
                         overlap_s += t_ag + hop_alpha * accum
-                        hidden_bytes += accum * half * per_dev
+                        hidden_bytes += ag_sh
                         extra_colls += accum * 2
                     else:
-                        comm += ring(n_pd) * per_dev * node_factor(node)
+                        comm += grad_bytes(node, ring(n_pd) * per_dev)
                         colls += 2 if opt_div > 1 else 1
                     # Track the unembedding for the loss-head epilogue
                     # pricing below.  Identification priority: a
@@ -714,8 +841,20 @@ class CostModel:
                 peak_logits = tokens_local * V_dim * 4.0 / vsh
                 mem += peak_logits
                 if vsh > 1:
-                    comm += ring(tp) * tokens_local \
-                        * (2.0 * width + 3.0) * 4.0
+                    # The prologue lookup psum rides the tp_psum slot
+                    # (it IS a sum_partials boundary); the stat psums
+                    # and backward hidden-cotangent psum ride
+                    # vocab_stats.
+                    lk_bytes = ring(tp) * tokens_local * width * 4.0
+                    st_bytes = ring(tp) * tokens_local \
+                        * (width + 3.0) * 4.0
+                    lk_f = PSUM_WIRE_FACTOR[tp_prec]
+                    st_f = PSUM_WIRE_FACTOR[stats_prec]
+                    comm += lk_bytes * lk_f + st_bytes * st_f
+                    saved_bytes += lk_bytes * (1.0 - lk_f) \
+                        + st_bytes * (1.0 - st_f)
+                    qdq_s += qdq(tokens_local * width, tp_prec) \
+                        + qdq(tokens_local * (width + 3.0), stats_prec)
                     colls += 6
             if tokens:
                 # activation hop per schedule tick (ppermute ring), fwd +
@@ -762,7 +901,7 @@ class CostModel:
                     mem += bytes_ * (2.0 + opt_mult) / E
                     param_b += bytes_ / E
                     grad_b += bytes_ / E
-                    comm += ring(n_data) * (bytes_ / E) * node_factor(node)
+                    comm += grad_bytes(node, ring(n_data) * (bytes_ / E))
                     colls += 1
                 else:
                     n_sync = n_data * E
@@ -772,8 +911,9 @@ class CostModel:
                     grad_b += bytes_ / g_div
                     mem += bytes_ / p_div + bytes_ / g_div \
                         + bytes_ * opt_mult / opt_div
-                    comm += (accum if stage >= 3 else 1) \
-                        * ring(n_sync) * bytes_ * node_factor(node)
+                    comm += grad_bytes(
+                        node, (accum if stage >= 3 else 1)
+                        * ring(n_sync) * bytes_)
                     colls += (2 * accum if stage >= 3
                               else 2 if opt_div > 1 else 1)
             if tokens:
@@ -784,7 +924,8 @@ class CostModel:
                 colls += 4
             if tokens and act_hint:
                 mem += act_hint * tokens_per_dev
-        comm_time = ((comm / bw_link + hop_alpha * colls + overlap_s)
+        comm_time = ((comm / bw_link + hop_alpha * colls + overlap_s
+                      + qdq_s)
                      if total_devices > 1 else 0.0)
         hbm = self.chip.hbm_gb * 1e9 * self.hbm_headroom
         return StrategyCost(comm_bytes=comm + hidden_bytes,
@@ -798,7 +939,10 @@ class CostModel:
                                                if kind == "pipeline"
                                                else 0.0),
                             param_shard_bytes=param_b,
-                            grad_shard_bytes=grad_b)
+                            grad_shard_bytes=grad_b,
+                            wire_bytes_saved=saved_bytes,
+                            quant_dq_time_s=(qdq_s if total_devices > 1
+                                             else 0.0))
 
     # ------------------------------------------------------------------ #
     # Serving: per-token decode latency

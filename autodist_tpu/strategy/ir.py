@@ -31,6 +31,77 @@ from autodist_tpu import const
 
 
 # --------------------------------------------------------------------------- #
+# Per-collective precision policy (PR 8, EQuARX-style: PAPERS.md
+# 2506.17615).  Every collective *boundary class* a lowering emits gets
+# one policy slot; the slot's value is the wire precision the boundary's
+# payload narrows to (summing collectives carry int8 levels on an fp16
+# wire; gathers carry a true s8 wire — kernel/quantize.py).  An absent
+# policy (the empty dict — what every pre-PR-8 strategy JSON
+# deserializes to) is fp32 everywhere: today's exact behavior.
+# --------------------------------------------------------------------------- #
+from autodist_tpu.kernel.quantize import (PRECISIONS,  # noqa: E402
+                                          UnknownPrecisionError)
+
+PRECISION_BOUNDARIES = (
+    # dp gradient sync (all-reduce / reduce-scatter).  Realized through
+    # the compressor machinery — the one boundary with persistent error-
+    # feedback state — so "bf16"/"int8" here elect the EF compressors.
+    "grad",
+    # TP activation psums (Megatron row/column boundaries, forward AND
+    # their custom-VJP backward), including the decomposed rs+ag halves
+    # and the vocab-parallel prologue lookup psum.
+    "tp_psum",
+    # Vocab-parallel epilogue statistics: the pmax/psum token-shaped
+    # stats and the backward hidden-state cotangent psum.
+    "vocab_stats",
+    # ZeRO-3 on-demand parameter gathers (forward all-gather) and their
+    # custom-VJP backward cotangent reduce-scatter.
+    "zero3_gather",
+)
+
+# Wire bits per precision (telemetry gauges / the report schema gate).
+PRECISION_BITS = {"fp32": 32, "bf16": 16, "int8": 8}
+
+
+def normalize_precision(policy) -> dict:
+    """Canonicalize a per-collective precision request.
+
+    ``None``/``{}``/``"fp32"`` -> ``{}`` (fp32 everywhere — the
+    pre-PR-8 behavior); a bare string applies one precision to every
+    boundary class; a dict maps boundary -> precision (unnamed
+    boundaries stay fp32).  Explicit ``"fp32"`` entries are dropped so
+    the canonical form is minimal and pre-PR-8 JSON round-trips
+    byte-stable.  Unknown boundaries/values raise
+    :class:`UnknownPrecisionError`.
+    """
+    if policy in (None, "", "fp32"):
+        return {}
+    if isinstance(policy, str):
+        if policy not in PRECISIONS:
+            raise UnknownPrecisionError(
+                f"unknown collective precision {policy!r}; expected one "
+                f"of {list(PRECISIONS)}")
+        return {b: policy for b in PRECISION_BOUNDARIES}
+    if not isinstance(policy, dict):
+        raise UnknownPrecisionError(
+            f"collective precision must be a string or a per-boundary "
+            f"dict, got {type(policy).__name__}")
+    out = {}
+    for boundary, value in policy.items():
+        if boundary not in PRECISION_BOUNDARIES:
+            raise UnknownPrecisionError(
+                f"unknown collective boundary {boundary!r}; expected one "
+                f"of {list(PRECISION_BOUNDARIES)}")
+        if value not in PRECISIONS:
+            raise UnknownPrecisionError(
+                f"{boundary}: unknown precision {value!r}; expected one "
+                f"of {list(PRECISIONS)}")
+        if value != "fp32":
+            out[boundary] = value
+    return out
+
+
+# --------------------------------------------------------------------------- #
 # Synchronizer configs (≙ reference synchronizers.proto:25-57)
 # --------------------------------------------------------------------------- #
 @dataclasses.dataclass
@@ -141,6 +212,13 @@ class PartitionerConfig:
     # comm + compute, and so a hand-edited strategy can convert layers
     # selectively.
     comm_overlap: Optional[str] = None
+    # Wire precision of this variable's model-axis activation collective
+    # (tensor-parallel layers / the vocab-sharded table) — the per-
+    # variable record of the graph-level precision policy's tp_psum /
+    # vocab_stats slot, mirroring comm_overlap: the cost model prices
+    # each boundary from it, and a hand-edited strategy stays readable.
+    # None = fp32 (today's exact psum).
+    precision: Optional[str] = None
 
     @property
     def partition_list(self) -> list[int]:
@@ -168,6 +246,11 @@ class PartitionerConfig:
 
     @classmethod
     def from_dict(cls, d):
+        prec = d.get("precision")
+        if prec is not None and prec not in PRECISIONS:
+            raise UnknownPrecisionError(
+                f"partitioner precision {prec!r}: expected one of "
+                f"{list(PRECISIONS)} (or null)")
         return cls(**d)
 
 
@@ -236,6 +319,12 @@ class GraphConfig:
     #   expert:   {} (no lowering knobs; routing capacity lives at the
     #   model's expert_parallel_ffn call)
     parallel: dict = dataclasses.field(default_factory=dict)
+    # Per-collective precision policy: boundary class -> wire precision
+    # (see PRECISION_BOUNDARIES / normalize_precision above).  Empty —
+    # what every pre-PR-8 strategy JSON deserializes to — is fp32
+    # everywhere; hand-edited unknown boundaries/values are rejected
+    # with UnknownPrecisionError at deserialization.
+    precision: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -246,7 +335,8 @@ class GraphConfig:
                    mesh_axes=dict(d.get("mesh_axes", {})),
                    lowering=d.get("lowering", "collective"),
                    accum_steps=d.get("accum_steps", 1),
-                   parallel=dict(d.get("parallel", {})))
+                   parallel=dict(d.get("parallel", {})),
+                   precision=normalize_precision(d.get("precision")))
 
 
 @dataclasses.dataclass
@@ -312,6 +402,8 @@ class Strategy:
             head += f", lowering={gc.lowering}"
         if gc.parallel:
             head += f", parallel={gc.parallel}"
+        if gc.precision:
+            head += f", precision={gc.precision}"
         if gc.accum_steps > 1:
             head += f", accum_steps={gc.accum_steps}"
         lines = [head + ")"]

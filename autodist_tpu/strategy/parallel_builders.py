@@ -25,7 +25,7 @@ from autodist_tpu.strategy.gspmd_builders import TRANSFORMER_TP_RULES
 from autodist_tpu.utils import logging
 from autodist_tpu.strategy.ir import (AllReduceSynchronizer, NodeConfig,
                                       PartitionerConfig, PSSynchronizer,
-                                      Strategy)
+                                      Strategy, normalize_precision)
 
 # Megatron-style model-axis rules for tensor parallelism *inside* pipeline
 # stages, matched against the per-stage variable (the stacked leaf minus
@@ -71,6 +71,18 @@ def _resolve_zero_stage(zero_stage, zero1) -> int:
         raise ValueError(
             f"zero_stage must be 0 (off), 1, 2 or 3; got {zero_stage!r}")
     return int(zero_stage)
+
+
+def _check_grad_precision(precision: dict, compressor: str):
+    """The precision policy's grad slot elects an EF compressor, so it
+    conflicts with an explicit ``compressor=`` exactly like
+    ``zero_stage`` does — and a silent drop would leave the user
+    believing narrowed gradient sync is active."""
+    if precision.get("grad") and (compressor or "none") != "none":
+        raise ValueError(
+            "collective_precision's 'grad' slot elects an error-"
+            "feedback compressor; pass either it or compressor=, "
+            "not both")
 
 
 def _default_sync(zero_stage: int, compressor: str,
@@ -123,9 +135,12 @@ class SequenceParallel(StrategyBuilder):
 
     def __init__(self, seq_leaves: Sequence[str] = ("x", "y"), *,
                  zero_stage: int = None, zero1: bool = None,
-                 compressor: str = "none", zero_min_bytes=None):
+                 compressor: str = "none", zero_min_bytes=None,
+                 collective_precision=None):
         self.seq_leaves = tuple(seq_leaves)
         self.zero_stage = _resolve_zero_stage(zero_stage, zero1)
+        self.precision = normalize_precision(collective_precision)
+        _check_grad_precision(self.precision, compressor)
         self.make_sync = _default_sync(self.zero_stage, compressor,
                                        zero_min_bytes)
 
@@ -143,6 +158,7 @@ class SequenceParallel(StrategyBuilder):
         cfg = self._graph_config(resource_spec)
         cfg.lowering = "sequence"
         cfg.parallel = {"seq_leaves": list(self.seq_leaves)}
+        cfg.precision = dict(self.precision)
         return Strategy(node_configs=nodes, graph_config=cfg)
 
 
@@ -198,7 +214,8 @@ class Pipeline(StrategyBuilder):
                  tensor_parallel: int = 1,
                  tp_rules: Sequence[tuple[str, list]] = None,
                  comm_overlap=None, vocab_parallel: bool = False,
-                 vocab_rules: Sequence[tuple[str, list]] = None):
+                 vocab_rules: Sequence[tuple[str, list]] = None,
+                 collective_precision=None):
         if num_microbatches < 1:
             raise ValueError("num_microbatches must be >= 1")
         if virtual_stages < 1:
@@ -234,6 +251,12 @@ class Pipeline(StrategyBuilder):
                              else PIPELINE_VOCAB_RULES)]
         from autodist_tpu.parallel.tensor import normalize_comm_overlap
         self.comm_overlap = normalize_comm_overlap(comm_overlap)
+        # Per-collective precision policy (PR 8): a bare string narrows
+        # every boundary class, a dict picks slots ({"tp_psum": "int8",
+        # ...}).  The grad slot resolves onto the EF compressors, so it
+        # conflicts with an explicit compressor= the same way zero does.
+        self.precision = normalize_precision(collective_precision)
+        _check_grad_precision(self.precision, compressor)
         # ZeRO stage over the data axes (stage vars) / pipe x data
         # (shared vars): 1 shards optimizer state, 2 additionally
         # accounts the gradients sharded (same U_FLAT program), 3 stores
@@ -348,21 +371,25 @@ class Pipeline(StrategyBuilder):
             if not has_shared or i.name.startswith("stages/"):
                 tail = [None] * (max(len(i.shape), 1) - 1)
                 overlap = None
+                tp_prec = None
                 if tp > 1:
                     tp_tail = self._tp_spec_for(i.name, tuple(i.shape[1:]),
                                                 tp)
                     if tp_tail is not None:
                         tail = tp_tail
                         tp_matched.append(i.name)
-                        # The overlap choice rides every tp-sharded
-                        # variable: row-parallel ones decompose their
-                        # forward output reduction, column-parallel ones
-                        # their backward cotangent reduction.
+                        # The overlap and wire-precision choices ride
+                        # every tp-sharded variable: row-parallel ones
+                        # decompose/narrow their forward output
+                        # reduction, column-parallel ones their backward
+                        # cotangent reduction (the cost model prices
+                        # each boundary from these records).
                         overlap = self.comm_overlap
+                        tp_prec = self.precision.get("tp_psum")
                 node.partitioner = PartitionerConfig(
                     mesh_axis=const.PIPE_AXIS,
                     spec=[const.PIPE_AXIS] + tail,
-                    comm_overlap=overlap)
+                    comm_overlap=overlap, precision=tp_prec)
             elif self.vocab_parallel and tp > 1:
                 # Shared-group variable: vocab rules shard dim 0 over the
                 # model axis (the lowering zero-pads non-divisible
@@ -373,7 +400,8 @@ class Pipeline(StrategyBuilder):
                     if pat.search(i.name) and len(spec) == len(i.shape):
                         node.partitioner = PartitionerConfig(
                             mesh_axis=const.MODEL_AXIS, spec=list(spec),
-                            comm_overlap=self.comm_overlap)
+                            comm_overlap=self.comm_overlap,
+                            precision=self.precision.get("vocab_stats"))
                         vocab_matched.append(i.name)
                         break
             nodes.append(node)
@@ -403,6 +431,7 @@ class Pipeline(StrategyBuilder):
                         # authoritative per-variable stage lives in each
                         # PSSynchronizer.zero_stage node config.
                         "zero_stage": self.zero_stage}
+        cfg.precision = dict(self.precision)
         return Strategy(node_configs=nodes, graph_config=cfg)
 
 
@@ -426,10 +455,13 @@ class ExpertParallel(StrategyBuilder):
     def __init__(self, expert_params: Sequence[str] = (),
                  detect: bool = True, *, zero_stage: int = None,
                  zero1: bool = None,
-                 compressor: str = "none", zero_min_bytes=None):
+                 compressor: str = "none", zero_min_bytes=None,
+                 collective_precision=None):
         self.expert_params = tuple(expert_params)
         self.detect = detect
         self.zero_stage = _resolve_zero_stage(zero_stage, zero1)
+        self.precision = normalize_precision(collective_precision)
+        _check_grad_precision(self.precision, compressor)
         self.make_sync = _default_sync(self.zero_stage, compressor,
                                        zero_min_bytes)
 
@@ -483,4 +515,5 @@ class ExpertParallel(StrategyBuilder):
         cfg = self._graph_config(resource_spec)
         cfg.lowering = "expert"
         cfg.parallel = {}
+        cfg.precision = dict(self.precision)
         return Strategy(node_configs=nodes, graph_config=cfg)

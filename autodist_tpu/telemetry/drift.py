@@ -108,10 +108,16 @@ def drift_report(strategy=None, cost_model=None,
                                          0.0),
             "grad_shard_bytes": getattr(predicted, "grad_shard_bytes",
                                         0.0),
+            "wire_bytes_saved": getattr(predicted, "wire_bytes_saved",
+                                        0.0),
+            "quant_dq_time_s": getattr(predicted, "quant_dq_time_s",
+                                       0.0),
         }
 
     comm_s = float(predicted.get("comm_time_s") or 0.0)
     overlap_s = float(predicted.get("overlap_time_s") or 0.0)
+    pred_wire_saved = float(predicted.get("wire_bytes_saved") or 0.0)
+    pred_qdq_s = float(predicted.get("quant_dq_time_s") or 0.0)
     pred_mem = float(predicted.get("mem_bytes_per_device") or 0.0)
     pred_logits = float(predicted.get("peak_logits_bytes") or 0.0)
     pred_param_shard = float(predicted.get("param_shard_bytes") or 0.0)
@@ -150,6 +156,12 @@ def drift_report(strategy=None, cost_model=None,
         # so an HBM delta between stages attributes to the right term.
         "param_shard_bytes": pred_param_shard or None,
         "grad_shard_bytes": pred_grad_shard or None,
+        # Predicted bytes-on-wire delta of the per-collective precision
+        # policy (and the q/dq compute charged against it): the terms a
+        # hardware window joins against measured step time to check the
+        # quantized-collectives win.
+        "wire_bytes_saved": pred_wire_saved or None,
+        "quant_dq_time_s": pred_qdq_s or None,
         "comm_bytes": predicted.get("comm_bytes"),
         "num_collectives": predicted.get("num_collectives"),
         "feasible": predicted.get("feasible"),
@@ -253,6 +265,8 @@ def drift_report(strategy=None, cost_model=None,
         tel.gauge("memory/param_shard_bytes").set(pred_param_shard)
     if pred_grad_shard > 0:
         tel.gauge("memory/grad_shard_bytes").set(pred_grad_shard)
+    if pred_wire_saved > 0:
+        tel.gauge("comm/wire_bytes_saved").set(pred_wire_saved)
 
     out_dir = out_dir or tel.out_dir
     if out_dir and tel.enabled:

@@ -254,9 +254,11 @@ def main():
     # silence.  (jax.default_backend() alone can hang: the tunnel client
     # initializes even under JAX_PLATFORMS=cpu.)
     # `bench.py serve` measures the serving engine's decode throughput
-    # instead of training MFU; the UNAVAILABLE fresh-process retry
-    # carries the mode through sys.argv.
-    run = _bench_serve if "serve" in sys.argv[1:] else _bench
+    # instead of training MFU; `bench.py quant` compares the dp×pp×tp
+    # pipeline step at fp32 vs int8 collective precision.  The
+    # UNAVAILABLE fresh-process retry carries the mode through sys.argv.
+    run = (_bench_serve if "serve" in sys.argv[1:]
+           else _bench_quant if "quant" in sys.argv[1:] else _bench)
     dog = _Watchdog(2400, "backend init").arm()
     try:
         run(dog)
@@ -272,6 +274,112 @@ def main():
         _unavailable_exit(str(e))
     finally:
         dog.disarm()   # every exit path reaps the monitor + stage file
+
+
+def _bench_quant(dog):
+    """`bench.py quant`: step-time ratio of the dp×pp×tp pipeline at
+    fp32 vs int8 per-collective precision — the measured half of the
+    quantized-collectives claim (the HLO probe proves the narrowed wire
+    structurally; this puts a wall-clock number on it).  Same one-line
+    provenance-stamped record shape as the other modes; UNAVAILABLE
+    backends take the same fresh-process backoff via main()."""
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist, telemetry
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+    from autodist_tpu.resource import ResourceSpec, factor_3d
+    from autodist_tpu.simulator.cost_model import CostModel
+
+    on_accel = jax.default_backend() != "cpu"
+    rs = ResourceSpec({})
+    n = rs.num_devices()
+    tp = 2 if n >= 4 else 1
+    pp = 2 if n // tp >= 2 else 1
+    dp = n // (tp * pp)
+    if on_accel:
+        cfg = TransformerConfig(vocab_size=32768, hidden_size=1024,
+                                num_layers=2 * pp, num_heads=16,
+                                mlp_dim=4096, max_len=512,
+                                dtype=jnp.bfloat16, dropout_rate=0.0,
+                                attention_dropout_rate=0.0)
+        batch, steps = 8 * dp * 2, 20
+    else:  # CPU dev smoke: same code path, toy size
+        cfg = TransformerConfig(vocab_size=128, hidden_size=32,
+                                num_layers=2 * pp, num_heads=2,
+                                mlp_dim=64, max_len=32,
+                                dtype=jnp.float32, dropout_rate=0.0,
+                                attention_dropout_rate=0.0)
+        batch, steps = 4 * max(dp, 1) * 2, 3
+    mesh = factor_3d(n, pipe=pp, model=tp, data=dp)
+    spec = {"topology": {"num_devices": n}, "mesh": mesh}
+    telemetry.annotate(bench="quantized_collectives_speedup", devices=n,
+                       chip=rs.chip.name)
+    r = np.random.RandomState(0)
+    b = {"x": r.randint(0, cfg.vocab_size, (batch, cfg.max_len))
+         .astype(np.int32),
+         "y": r.randint(0, cfg.vocab_size, (batch, cfg.max_len))
+         .astype(np.int32)}
+
+    def timed(precision):
+        trainable = make_pipeline_lm_trainable(
+            cfg, optax.adam(1e-3), jax.random.PRNGKey(0))
+        # activation-shape hint so the cost model prices the policied
+        # activation boundaries (and their q/dq term) for the record
+        trainable.tokens_per_step = batch * cfg.max_len
+        ad = AutoDist(spec, "Pipeline", num_microbatches=2,
+                      virtual_stages=cfg.num_layers // pp,
+                      tensor_parallel=tp,
+                      vocab_parallel=tp > 1,
+                      collective_precision=precision)
+        strategy = ad.build_or_load_strategy(trainable)
+        runner = ad.build(trainable, strategy)
+        try:
+            float(np.asarray(runner.step(b)["loss"]))     # compile+warm
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                metrics = runner.step(b)
+            float(np.asarray(metrics["loss"]))
+            dt = (time.perf_counter() - t0) / steps
+        finally:
+            runner.close()
+        cost = CostModel(ResourceSpec(spec)).strategy_cost(trainable,
+                                                           strategy)
+        return dt, cost
+
+    dog.stage = f"quant bench fp32 (tp{tp}/pp{pp}: build+compile+steps)"
+    try:
+        dt_fp32, _ = timed(None)
+        dog.stage = f"quant bench int8 (tp{tp}/pp{pp}: build+compile+steps)"
+        dt_int8, cost_q = timed("int8")
+    except Exception as e:
+        dog.disarm()
+        if "UNAVAILABLE" in str(e) or "Connection" in str(e):
+            _unavailable_exit(f"transport: {e}")
+        print(json.dumps({
+            "metric": "quantized_collectives_speedup", "value": 0.0,
+            "unit": "ratio", "vs_baseline": 0.0,
+            "error": f"quant bench failed: {e}",
+            "provenance": _provenance()}))
+        sys.exit(4)
+    ratio = dt_fp32 / dt_int8 if dt_int8 > 0 else 0.0
+    record = {
+        "metric": "quantized_collectives_speedup",
+        "value": round(ratio, 4), "unit": "ratio",
+        "vs_baseline": round(ratio, 4), "devices": n,
+        "chip": rs.chip.name, "tensor_parallel": tp, "pipe": pp,
+        "batch": batch, "steps": steps,
+        "step_ms_fp32": round(dt_fp32 * 1e3, 3),
+        "step_ms_int8": round(dt_int8 * 1e3, 3),
+        "predicted_wire_bytes_saved": round(cost_q.wire_bytes_saved, 1),
+        "predicted_qdq_ms": round(cost_q.quant_dq_time_s * 1e3, 4),
+        "scored": True, "provenance": _provenance(),
+    }
+    dog.disarm()
+    print(json.dumps(record), flush=True)
+    telemetry.gauge("bench/quantized_speedup").set(ratio)
+    telemetry.flush()
 
 
 def _bench_serve(dog):

@@ -58,6 +58,15 @@ def main():
                          "values exercise the zero-pad path)")
     ap.add_argument("--seq", type=int, default=16,
                     help="LM sequence length (with --vocab-parallel)")
+    ap.add_argument("--collective-precision", default="off",
+                    choices=["off", "bf16", "int8"],
+                    help="per-collective precision policy: narrow every "
+                         "policied boundary (TP activation psums, "
+                         "decomposed rs/ag halves, vocab-epilogue "
+                         "stats, ZeRO-3 gathers, dp grad sync via the "
+                         "EF compressors) to this wire precision; the "
+                         "drift report breaks out the predicted "
+                         "bytes-on-wire delta")
     ap.add_argument("--zero-stage", type=int, default=0,
                     choices=[0, 1, 2, 3],
                     help="ZeRO stage over the data axes (stage vars) / "
@@ -171,12 +180,15 @@ def main():
             x = r.randn(args.batch, HID).astype(np.float32)
             return {"x": x, "y": x @ target}
     overlap = None if args.comm_overlap == "off" else args.comm_overlap
+    precision = None if args.collective_precision == "off" \
+        else args.collective_precision
     zero_stage = max(args.zero_stage, 1 if args.zero1 else 0)
     builder = Pipeline(num_microbatches=args.microbatches,
                        virtual_stages=args.virtual_stages,
                        tensor_parallel=tp, comm_overlap=overlap,
                        vocab_parallel=args.vocab_parallel,
-                       zero_stage=zero_stage, remat=args.remat)
+                       zero_stage=zero_stage, remat=args.remat,
+                       collective_precision=precision)
     if args.accum_steps > 1:
         builder = GradAccumulation(builder, steps=args.accum_steps)
 
@@ -196,7 +208,8 @@ def main():
     print(f"pipe={pp} x virtual={args.virtual_stages} "
           f"(C={C} chunks), dp={dp}, tp={tp}, M={args.microbatches}, "
           f"comm_overlap={overlap}, vocab_parallel={args.vocab_parallel}, "
-          f"zero_stage={zero_stage}; "
+          f"zero_stage={zero_stage}, "
+          f"collective_precision={precision or 'fp32'}; "
           f"schedule bubble = "
           f"{bubble_fraction(args.microbatches, pp, args.virtual_stages):.3f}")
 
@@ -264,6 +277,12 @@ def main():
                            comm_overlap=overlap, batch=args.batch,
                            tensor_parallel=tp, zero_stage=zero_stage,
                            vocab_parallel=args.vocab_parallel,
+                           # The normalized per-boundary dict, so
+                           # `tools/telemetry_report.py --check` can
+                           # gate the precision/<boundary>_bits gauges
+                           # the lowering emitted against it.
+                           collective_precision=dict(
+                               strategy.graph_config.precision),
                            peak_logits_bytes=peak_logits,
                            param_shard_bytes=cost.param_shard_bytes,
                            grad_shard_bytes=cost.grad_shard_bytes,
@@ -278,6 +297,11 @@ def main():
               f"{sorted(os.path.basename(p) for p in paths.values())}")
         ratios = {k: round(v, 3) for k, v in report["ratios"].items()}
         print(f"drift (measured/predicted): {ratios}")
+        if cost.wire_bytes_saved:
+            print(f"precision policy: predicted "
+                  f"{cost.wire_bytes_saved / 1e6:.3f} MB/step saved on "
+                  f"the wire vs fp32 (q/dq compute charged: "
+                  f"{cost.quant_dq_time_s * 1e6:.1f} us/step)")
     mean = summary["mean_ms"]
     if args.profile_dir and mean is not None:
         print(f"xplane trace in {args.profile_dir} "

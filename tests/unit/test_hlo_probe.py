@@ -10,12 +10,15 @@ window."""
 import json
 
 from tools.hlo_probe import (buffers_with_dim, buffers_with_dim_repeated,
-                             collective_counts, dynamic_update_slices,
+                             collective_counts, collective_wire,
+                             convert_counts, dynamic_update_slices,
                              entry_signature, large_copies_with_dim, main,
+                             narrowed_collective_counts,
+                             nonscalar_all_reduces,
                              probe_collective_matmul, probe_decode,
-                             probe_pipeline_tp, probe_single_replica,
-                             probe_steps_per_loop, probe_vocab_parallel,
-                             probe_zero3)
+                             probe_pipeline_tp, probe_quantized,
+                             probe_single_replica, probe_steps_per_loop,
+                             probe_vocab_parallel, probe_zero3)
 
 
 def test_collective_counts_parses_hlo_idioms():
@@ -146,6 +149,49 @@ def test_decode_step_is_buffer_clean_and_in_place():
     assert report["dynamic_update_slices_vp"] >= 4    # k+v x 2 layers
     assert report["collectives_vp"]["all-reduce"] >= 4
     assert sum(report["collectives_tp1"].values()) == 0
+
+
+def test_narrowed_collective_helpers_parse_hlo_idioms():
+    text = """
+  %ar = f16[8]{0} all-reduce(f16[8]{0} %p), replica_groups={{0,1}}
+  %mx = f32[] all-reduce(f32[] %s), to_apply=%max
+  %big = f32[64]{0} all-reduce(f32[64]{0} %g)
+  %ag = (s8[4]{0}, s8[8]{0}) all-gather-start(s8[4]{0} %x), dimensions={0}
+  %rs = bf16[16]{0} reduce-scatter(bf16[32]{0} %y), dimensions={0}
+  %c1 = f16[8]{0} convert(f32[8]{0} %a)
+  %c2 = f32[8]{0} convert(f16[8]{0} %b)
+"""
+    n = narrowed_collective_counts(text)
+    assert n["all-reduce"] == 1
+    assert n["all-gather"] == 1
+    assert n["reduce-scatter"] == 1
+    # the scalar pmax is an all-reduce but not a payload one
+    assert nonscalar_all_reduces(text) == 2
+    wire = collective_wire(text)
+    assert ("all-reduce", "f16", 8) in wire
+    assert ("all-gather", "s8", 8) in wire
+    conv = convert_counts(text)
+    assert conv["f16"] == 1 and conv["f32"] == 1
+
+
+def test_quantized_policy_narrows_the_wire():
+    """The PR 8 acceptance probe, tier-1 on CPU: the int8-policy tp=2
+    program carries the narrowed element type on every policied
+    collective operand (convert pairs included), the fp32-policy
+    program carries ZERO narrowed collectives, the quantized rs+ag
+    pair stays un-re-fused, and the int8 ZeRO-3 gathers narrow per
+    (virtual stage, leaf)."""
+    report = probe_quantized()
+    assert sum(report["narrowed_fp32_policy"].values()) == 0
+    assert report["narrowed_tp_psum_int8"]["all-reduce"] >= 4
+    assert report["converts_tp_psum_int8"]["f16"] >= 4
+    assert report["payload_f32_all_reduces_tp_psum_int8"] >= 1
+    assert (report["payload_all_reduces_rsag_int8"]
+            == report["payload_all_reduces_tp1"])
+    assert report["s8_all_gathers_rsag_int8"] >= 1
+    assert (report["narrowed_zero3_int8"]["all-gather"]
+            >= report["min_per_layer_gathers"])
+    assert report["narrowed_zero3_int8"]["reduce-scatter"] >= 1
 
 
 def test_zero3_shards_step_boundary_and_gathers_per_layer():

@@ -1,0 +1,558 @@
+"""Per-collective precision policy (PR 8): the Strategy IR slot per
+collective boundary (grad / tp_psum / vocab_stats / zero3_gather),
+EQuARX-style quantization *inside* the collectives.
+
+Pinned here:
+
+* **Goldens** — int8/bf16 policies on the TP activation psums, the
+  vocab epilogue, and the ZeRO-3 gathers stay within a pinned
+  per-boundary-class tolerance of the fp32 trajectory across
+  tp ∈ {1, 2} × vocab_parallel × zero_stage ∈ {1, 3}; a policy whose
+  slots touch no boundary of the program (tp_psum at tp=1) reproduces
+  the fp32 trajectory *bit-exactly* — narrowing is per-boundary, never
+  ambient.
+* **Backward compat** — a pre-PR-8 strategy JSON (no precision fields)
+  round-trips byte-stably through the IR and lowers with
+  fp32-everywhere semantics; hand-edited unknown precision values are
+  rejected with the named ``UnknownPrecisionError``.
+* **Cost model** — a quantized candidate outranks its fp32 sibling
+  exactly when the bytes saved outweigh the calibrated q/dq compute
+  (pinned in BOTH directions), and the ``"quant"`` calibration section
+  merges like the ``"link"`` constants.
+* **Telemetry schema gate** — a run annotated with a precision policy
+  but missing the per-boundary ``precision/<boundary>_bits`` gauges
+  fails ``tools/telemetry_report.py --check``.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import AutoDist, PipelineTrainable
+from autodist_tpu.kernel.quantize import UnknownPrecisionError
+from autodist_tpu.parallel.tensor import column_parallel, row_parallel
+from autodist_tpu.strategy.ir import (PRECISION_BOUNDARIES, Strategy,
+                                      normalize_precision)
+
+SPEC_3D = {"topology": {"platform": "cpu", "num_devices": 8},
+           "mesh": {"data": 2, "pipe": 2, "model": 2}}
+SPEC_DP = {"topology": {"platform": "cpu", "num_devices": 8},
+           "mesh": {"data": 4, "pipe": 2}}
+
+HID, FF, C = 8, 16, 4
+
+
+def _mlp_trainable():
+    r = np.random.RandomState(0)
+    stacked = {
+        "wi": {"kernel": jnp.asarray(r.randn(C, HID, FF) * 0.3,
+                                     jnp.float32),
+               "bias": jnp.zeros((C, FF), jnp.float32)},
+        "wo": {"kernel": jnp.asarray(r.randn(C, FF, HID) * 0.3,
+                                     jnp.float32),
+               "bias": jnp.zeros((C, HID), jnp.float32)},
+    }
+
+    def stage(p, x, model_axis=None, comm_overlap=None):
+        h = jax.nn.relu(column_parallel(x, p["wi"]["kernel"],
+                                        p["wi"]["bias"],
+                                        model_axis=model_axis,
+                                        comm_overlap=comm_overlap))
+        return row_parallel(h, p["wo"]["kernel"], p["wo"]["bias"],
+                            model_axis=model_axis,
+                            comm_overlap=comm_overlap)
+
+    def head(outputs, batch):
+        return jnp.mean((outputs - batch["y"]) ** 2), {}
+
+    return PipelineTrainable(stage, stacked, head, optax.sgd(0.05),
+                             num_stages=C)
+
+
+def _mlp_batches(n=3):
+    r = np.random.RandomState(7)
+    return [{"x": r.randn(8, HID).astype(np.float32),
+             "y": r.randn(8, HID).astype(np.float32)} for _ in range(n)]
+
+
+_trajectories: dict = {}
+
+
+def _mlp_trajectory(tp, zero_stage, precision, strategy_json=None):
+    """Losses + final params of 3 steps; memoized per config so every
+    quantized run diffs against one shared fp32 baseline."""
+    key = (tp, zero_stage, json.dumps(precision, sort_keys=True)
+           if isinstance(precision, dict) else precision,
+           strategy_json is not None)
+    if key in _trajectories:
+        return _trajectories[key]
+    spec = SPEC_3D if tp > 1 else SPEC_DP
+    trainable = _mlp_trainable()
+    ad = AutoDist(spec, "Pipeline", num_microbatches=2, virtual_stages=2,
+                  tensor_parallel=tp, zero_stage=zero_stage,
+                  collective_precision=precision)
+    strategy = (Strategy.from_json(strategy_json) if strategy_json
+                else ad.build_or_load_strategy(trainable))
+    runner = ad.build(trainable, strategy)
+    try:
+        losses = [float(np.asarray(
+            runner.step(b, rng=jax.random.PRNGKey(0))["loss"]))
+            for b in _mlp_batches()]
+        params = jax.device_get(runner.get_params())
+    finally:
+        runner.close()
+    _trajectories[key] = (losses, params, strategy)
+    return _trajectories[key]
+
+
+def _assert_close_trajectory(base, quant, loss_atol, param_atol):
+    for lb, lq in zip(base[0], quant[0]):
+        assert abs(lb - lq) <= loss_atol, (base[0], quant[0])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=param_atol, rtol=0),
+        base[1], quant[1])
+
+
+# Pinned convergence-neutral tolerances per boundary class, against the
+# fp32 trajectory of the SAME config (3 sgd steps of the toy MLP /
+# LM).  bf16 carries ~3 decimal digits, int8 ~2; the zero3 gather
+# quantizes parameters themselves, hence the wider pin.
+TP_PSUM_TOL = {"bf16": (5e-3, 5e-3), "int8": (2e-2, 2e-2)}
+ZERO3_TOL = (3e-2, 3e-2)
+VOCAB_TOL = {"bf16": (3e-2, 2e-2), "int8": (6e-2, 2e-2)}
+
+
+@pytest.mark.parametrize("tp,zero_stage", [(2, 1), (2, 3), (1, 1), (1, 3)])
+@pytest.mark.parametrize("prec", ["bf16", "int8"])
+def test_policy_goldens_vs_fp32_trajectory(tp, zero_stage, prec):
+    """tp × zero_stage × precision: the narrowed trajectory stays within
+    the pinned tolerance of fp32 — and moves AT ALL only when the
+    policy's slots touch a boundary the program emits."""
+    base = _mlp_trajectory(tp, zero_stage, None)
+    quant = _mlp_trajectory(tp, zero_stage, prec)
+    loss_atol = max(TP_PSUM_TOL[prec][0],
+                    ZERO3_TOL[0] if zero_stage >= 3 else 0.0)
+    param_atol = max(TP_PSUM_TOL[prec][1],
+                     ZERO3_TOL[1] if zero_stage >= 3 else 0.0)
+    _assert_close_trajectory(base, quant, loss_atol, param_atol)
+
+
+def test_policy_without_matching_boundary_is_bit_exact():
+    """tp_psum/vocab_stats at tp=1: no model axis, no policied
+    collective — the trajectory must be IDENTICAL to fp32 (the
+    'defaults to today's behavior' contract at slot granularity)."""
+    base = _mlp_trajectory(1, 1, None)
+    scoped = _mlp_trajectory(1, 1, {"tp_psum": "int8",
+                                    "vocab_stats": "int8"})
+    assert base[0] == scoped[0]
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), base[1], scoped[1])
+
+
+def test_zero3_gather_slot_narrows_dp_pipeline():
+    """zero3_gather alone on the dp×pp mesh (every stage leaf genuinely
+    flat-sharded): quantized parameter gathers + cotangent scatters stay
+    within the pinned zero3 tolerance."""
+    base = _mlp_trajectory(1, 3, None)
+    quant = _mlp_trajectory(1, 3, {"zero3_gather": "int8"})
+    _assert_close_trajectory(base, quant, *ZERO3_TOL)
+
+
+# ---------------------------------------------------------------------- #
+# Vocab epilogue goldens (the pipelined transformer LM, tp=2)
+# ---------------------------------------------------------------------- #
+_lm_runs: dict = {}
+
+
+def _lm_trajectory(precision, zero_stage=0):
+    key = (precision, zero_stage)
+    if key in _lm_runs:
+        return _lm_runs[key]
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=33, hidden_size=16, num_layers=2,
+                            num_heads=2, mlp_dim=32, max_len=8,
+                            dtype=jnp.float32, dropout_rate=0.0,
+                            attention_dropout_rate=0.0)
+    trainable = make_pipeline_lm_trainable(cfg, optax.sgd(0.05),
+                                           jax.random.PRNGKey(0))
+    runner = AutoDist(SPEC_3D, "Pipeline", num_microbatches=2,
+                      tensor_parallel=2, vocab_parallel=True,
+                      zero_stage=zero_stage,
+                      collective_precision=precision).build(trainable)
+    r = np.random.RandomState(5)
+    try:
+        losses = []
+        for _ in range(3):
+            b = {"x": r.randint(0, 33, (8, 8)).astype(np.int32),
+                 "y": r.randint(0, 33, (8, 8)).astype(np.int32)}
+            losses.append(float(np.asarray(
+                runner.step(b, rng=jax.random.PRNGKey(0))["loss"])))
+    finally:
+        runner.close()
+    _lm_runs[key] = losses
+    return losses
+
+
+@pytest.mark.parametrize("prec", ["bf16", "int8"])
+def test_vocab_epilogue_goldens(prec):
+    """int8/bf16 on the vocab-parallel epilogue (stat psums, pmax,
+    backward hidden-cotangent psum — odd vocab 33 exercises the padded
+    shard): losses track the fp32-policy trajectory within the pin."""
+    base = _lm_trajectory(None)
+    quant = _lm_trajectory(prec)
+    for lb, lq in zip(base, quant):
+        assert abs(lb - lq) <= VOCAB_TOL[prec][0], (prec, base, quant)
+
+
+@pytest.mark.parametrize("zero_stage", [1, 3])
+def test_vocab_epilogue_zero_stage_golden(zero_stage):
+    """The composition cells vocab_parallel × zero_stage ∈ {1, 3} ×
+    int8 (stage 3 on the model-sharded table degrades to state sharding
+    while the non-tp stage leaves gather quantized)."""
+    base = _lm_trajectory(None, zero_stage=zero_stage)
+    quant = _lm_trajectory("int8", zero_stage=zero_stage)
+    tol = max(VOCAB_TOL["int8"][0],
+              ZERO3_TOL[0] if zero_stage >= 3 else 0.0)
+    for lb, lq in zip(base, quant):
+        assert abs(lb - lq) <= tol, (zero_stage, base, quant)
+
+
+# ---------------------------------------------------------------------- #
+# IR: normalization, serialization, backward compat
+# ---------------------------------------------------------------------- #
+def test_normalize_precision_forms():
+    assert normalize_precision(None) == {}
+    assert normalize_precision("fp32") == {}
+    assert normalize_precision("int8") == {
+        b: "int8" for b in PRECISION_BOUNDARIES}
+    assert normalize_precision({"tp_psum": "bf16", "grad": "fp32"}) == {
+        "tp_psum": "bf16"}
+    with pytest.raises(UnknownPrecisionError):
+        normalize_precision("int4")
+    with pytest.raises(UnknownPrecisionError):
+        normalize_precision({"tp_psum": "fp8"})
+    with pytest.raises(UnknownPrecisionError):
+        normalize_precision({"activations": "int8"})
+    with pytest.raises(UnknownPrecisionError):
+        normalize_precision(["int8"])
+
+
+def test_pre_pr8_strategy_json_roundtrips_and_lowers_fp32():
+    """A strategy JSON written before the precision fields existed (no
+    'precision' keys anywhere) deserializes to the empty policy,
+    re-serializes with the canonical empty dict, and lowers to the
+    bit-exact fp32 program."""
+    base_losses, base_params, strategy = _mlp_trajectory(1, 1, None)
+    d = json.loads(strategy.to_json())
+    # strip every PR-8 field — the on-disk shape of a pre-PR-8 strategy
+    d["graph_config"].pop("precision", None)
+    for nc in d["node_configs"]:
+        if nc.get("partitioner"):
+            nc["partitioner"].pop("precision", None)
+    legacy_json = json.dumps(d)
+    loaded = Strategy.from_json(legacy_json)
+    assert loaded.graph_config.precision == {}
+    assert json.loads(loaded.to_json())["graph_config"]["precision"] == {}
+    losses, params, _ = _mlp_trajectory(1, 1, None,
+                                        strategy_json=legacy_json)
+    assert losses == base_losses
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), params, base_params)
+
+
+def test_hand_edited_unknown_precision_rejected_by_name():
+    _, _, strategy = _mlp_trajectory(1, 1, None)
+    d = json.loads(strategy.to_json())
+    d["graph_config"]["precision"] = {"tp_psum": "int4"}
+    with pytest.raises(UnknownPrecisionError):
+        Strategy.from_json(json.dumps(d))
+    d["graph_config"]["precision"] = {"wormhole": "int8"}
+    with pytest.raises(UnknownPrecisionError):
+        Strategy.from_json(json.dumps(d))
+    d["graph_config"]["precision"] = {}
+    for nc in d["node_configs"]:
+        if nc.get("partitioner"):
+            nc["partitioner"]["precision"] = "fp8"
+            break
+    with pytest.raises(UnknownPrecisionError):
+        Strategy.from_json(json.dumps(d))
+
+
+def test_policy_roundtrips_through_json():
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.strategy.parallel_builders import Pipeline
+
+    t = _mlp_trainable()
+    rs = ResourceSpec(SPEC_3D)
+    s = Pipeline(num_microbatches=2, virtual_stages=2, tensor_parallel=2,
+                 collective_precision={"tp_psum": "int8",
+                                       "grad": "bf16"}).build(t, rs)
+    back = Strategy.from_json(s.to_json())
+    assert back.graph_config.precision == {"tp_psum": "int8",
+                                           "grad": "bf16"}
+    tp_parts = [nc.partitioner for nc in back.node_configs
+                if nc.partitioner and nc.partitioner.spec
+                and "model" in nc.partitioner.spec]
+    assert tp_parts and all(p.precision == "int8" for p in tp_parts)
+
+
+def test_grad_slot_conflicts_with_explicit_compressor():
+    from autodist_tpu.strategy.parallel_builders import (ExpertParallel,
+                                                         Pipeline,
+                                                         SequenceParallel)
+
+    for builder in (Pipeline, SequenceParallel, ExpertParallel):
+        kw = {"num_microbatches": 2} if builder is Pipeline else {}
+        with pytest.raises(ValueError, match="compressor"):
+            builder(compressor="bf16_ef", collective_precision="int8",
+                    **kw)
+
+
+def test_vocab_stats_only_policy_does_not_narrow_tp_psums():
+    """Slot hygiene (review regression): a vocab_stats-only policy
+    records precision on the vocab-sharded SHARED table's partitioner;
+    the lowering must not adopt that record into the tp_psum slot —
+    the Megatron psums the user left at fp32 stay fp32."""
+    import optax as _optax
+    from jax.sharding import Mesh
+
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+    from autodist_tpu.parallel.pipeline import lower_pipeline_ir
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.strategy.parallel_builders import Pipeline
+
+    cfg = TransformerConfig(vocab_size=32, hidden_size=16, num_layers=2,
+                            num_heads=2, mlp_dim=32, max_len=8,
+                            dtype=jnp.float32, dropout_rate=0.0,
+                            attention_dropout_rate=0.0)
+    t = make_pipeline_lm_trainable(cfg, _optax.sgd(0.05),
+                                   jax.random.PRNGKey(0))
+    s = Pipeline(num_microbatches=2, tensor_parallel=2,
+                 vocab_parallel=True,
+                 collective_precision={"vocab_stats": "int8"}).build(
+                     t, ResourceSpec(SPEC_3D))
+    # per-variable records land on the right variables only
+    for nc in s.node_configs:
+        part = nc.partitioner
+        if part is None:
+            continue
+        if nc.var_name.startswith("shared/") and part.spec \
+                and "model" in part.spec:
+            assert part.precision == "int8", nc.var_name
+        else:
+            assert part.precision is None, nc.var_name
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                ("data", "pipe", "model"))
+    lowered = lower_pipeline_ir(t, s, mesh)   # jits untraced: cheap
+    assert lowered.precision == {"vocab_stats": "int8"}
+
+    # ...and a hand-edited strategy carrying ONLY the per-variable
+    # records still resolves each into its own slot.
+    s.graph_config.precision = {}
+    lowered2 = lower_pipeline_ir(t, s, mesh)
+    assert lowered2.precision == {"vocab_stats": "int8"}
+
+
+def test_sequence_lowering_emits_precision_gauges(tmp_path):
+    """The replicated-SPMD builder (sequence/expert lowerings) emits
+    the same per-boundary gauges the pipeline does — the --check gate
+    covers every lowering family (review regression)."""
+    import optax as _optax
+    from jax.sharding import Mesh
+
+    from autodist_tpu import Trainable, telemetry
+    from autodist_tpu.parallel.sequence import lower_sequence_ir
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.strategy.parallel_builders import SequenceParallel
+
+    params = {"w": jnp.zeros((16, 4), jnp.float32)}
+
+    def loss_fn(p, batch):
+        return jnp.mean((batch["x"] @ p["w"] - batch["y"]) ** 2)
+
+    t = Trainable.from_loss_fn(loss_fn, params, _optax.sgd(0.1))
+    spec = {"topology": {"platform": "cpu", "num_devices": 8},
+            "mesh": {"data": 2, "seq": 4}}
+    s = SequenceParallel(collective_precision="int8").build(
+        t, ResourceSpec(spec))
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("data", "seq"))
+    telemetry.reset()
+    telemetry.configure(out_dir=str(tmp_path / "tel"))
+    try:
+        lower_sequence_ir(t, s, mesh)
+        assert telemetry.get().gauge("precision/grad_bits").value == 8
+        assert telemetry.get().gauge(
+            "precision/zero3_gather_bits").value == 8
+    finally:
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------- #
+# Cost model: election pinned both directions; calibration merge
+# ---------------------------------------------------------------------- #
+def _lm_cost_fixture():
+    import optax as _optax
+
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.strategy.parallel_builders import Pipeline
+
+    cfg = TransformerConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                            num_heads=2, mlp_dim=128, max_len=16,
+                            dtype=jnp.float32, dropout_rate=0.0,
+                            attention_dropout_rate=0.0)
+    t = make_pipeline_lm_trainable(cfg, _optax.sgd(0.1),
+                                   jax.random.PRNGKey(0))
+    t.tokens_per_step = 32 * 16
+    rs = ResourceSpec(SPEC_3D)
+    fp32 = Pipeline(num_microbatches=2, tensor_parallel=2).build(t, rs)
+    quant = Pipeline(num_microbatches=2, tensor_parallel=2,
+                     collective_precision="int8").build(t, rs)
+    return t, rs, fp32, quant
+
+
+def test_quantized_candidate_wins_exactly_when_comm_bound():
+    from autodist_tpu.simulator.cost_model import CostModel
+
+    t, rs, fp32, quant = _lm_cost_fixture()
+    # comm-bound link: bytes dominate, q/dq is noise -> quantized wins
+    cm = CostModel(rs, link_profile={"ici_gbps": 0.001})
+    c_f, c_q = cm.strategy_cost(t, fp32), cm.strategy_cost(t, quant)
+    assert c_q.score < c_f.score
+    assert c_q.wire_bytes_saved > 0
+    assert c_q.comm_bytes < c_f.comm_bytes
+    assert c_q.quant_dq_time_s > 0
+    assert c_f.wire_bytes_saved == 0
+    # compute-bound: infinite wire, calibrated q/dq cost -> fp32 wins
+    cm2 = CostModel(rs, link_profile={"ici_gbps": 1e6},
+                    quant_profile={"int8_s_per_elem": 1e-3})
+    assert cm2.strategy_cost(t, fp32).score \
+        < cm2.strategy_cost(t, quant).score
+
+
+def test_auto_strategy_zoo_carries_quantized_candidates():
+    from autodist_tpu.simulator.auto_strategy import default_candidates
+    from autodist_tpu.strategy.parallel_builders import Pipeline
+
+    quantized = [b for b in default_candidates()
+                 if isinstance(b, Pipeline) and b.precision]
+    assert quantized, "no quantized-collectives candidate in the zoo"
+    assert any(b.precision.get("tp_psum") == "int8" for b in quantized)
+
+
+def test_quant_calibration_section_merges(tmp_path, monkeypatch):
+    from autodist_tpu.simulator import cost_model as cm
+
+    path = tmp_path / "measured.json"
+    path.write_text(json.dumps(
+        {"meta": {"backend": "v5e"},
+         "compressor_factor": {},
+         "quant": {"int8_s_per_elem": 3.25e-9}}))
+    monkeypatch.setitem(cm.QUANT_PROFILE, "int8_s_per_elem", 1e-10)
+    cm.load_calibration(str(path))
+    assert cm.QUANT_PROFILE["int8_s_per_elem"] == 3.25e-9
+    # the model instance picks it up
+    from autodist_tpu.resource import ResourceSpec
+    model = cm.CostModel(ResourceSpec(SPEC_3D))
+    assert model.quant_profile["int8_s_per_elem"] == 3.25e-9
+
+
+def test_repo_calibration_quant_defaults_match_in_code_table():
+    import os
+
+    from autodist_tpu.simulator import cost_model as cm
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    with open(os.path.join(repo, "calibration.json")) as f:
+        data = json.load(f)
+    assert data["quant"] == {
+        k: cm.QUANT_PROFILE[k]
+        for k in ("bf16_s_per_elem", "int8_s_per_elem")}
+
+
+# ---------------------------------------------------------------------- #
+# Telemetry: the per-boundary gauge schema gate
+# ---------------------------------------------------------------------- #
+def _write_run(tmp_path, gauges, declared):
+    run = tmp_path / "run"
+    run.mkdir(parents=True)
+    lines = [json.dumps({"kind": "gauge", "name": n, "value": v})
+             for n, v in gauges.items()]
+    (run / "metrics.jsonl").write_text("\n".join(lines) + "\n")
+    (run / "manifest.json").write_text(json.dumps(
+        {"kind": "manifest", "provenance": {},
+         "run": {"collective_precision": declared}}))
+    return str(run)
+
+
+def test_report_check_gates_precision_gauges(tmp_path):
+    from tools.telemetry_report import check_schema
+
+    declared = {"tp_psum": "int8", "vocab_stats": "bf16"}
+    ok = _write_run(tmp_path, {"precision/tp_psum_bits": 8,
+                               "precision/vocab_stats_bits": 16},
+                    declared)
+    assert check_schema(ok) == []
+    missing = _write_run(tmp_path / "m", {"precision/tp_psum_bits": 8},
+                         declared)
+    problems = check_schema(missing)
+    assert any("vocab_stats" in p for p in problems)
+    wrong = _write_run(tmp_path / "w", {"precision/tp_psum_bits": 16,
+                                        "precision/vocab_stats_bits": 16},
+                       declared)
+    problems = check_schema(wrong)
+    assert any("tp_psum" in p and "disagrees" in p for p in problems)
+    bad_bits = _write_run(tmp_path / "b", {"precision/tp_psum_bits": 7,
+                                           "precision/vocab_stats_bits": 16},
+                          declared)
+    assert any("wire width" in p for p in check_schema(bad_bits))
+
+
+def test_lowering_emits_precision_gauges(tmp_path):
+    """Lowering a bf16-policy pipeline strategy leaves the per-boundary
+    gauges in the registry — the signal --check gates on."""
+    from jax.sharding import Mesh
+
+    from autodist_tpu import telemetry
+    from autodist_tpu.parallel.pipeline import lower_pipeline_ir
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.strategy.parallel_builders import Pipeline
+
+    t = _mlp_trainable()
+    strategy = Pipeline(num_microbatches=2, virtual_stages=2,
+                        tensor_parallel=2,
+                        collective_precision="bf16").build(
+                            t, ResourceSpec(SPEC_3D))
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2),
+                ("data", "pipe", "model"))
+    telemetry.reset()
+    telemetry.configure(out_dir=str(tmp_path / "tel"))
+    try:
+        lower_pipeline_ir(t, strategy, mesh)  # jits stay untraced: cheap
+        assert telemetry.get().gauge("precision/tp_psum_bits").value == 16
+        assert telemetry.get().gauge("precision/grad_bits").value == 16
+        assert telemetry.get().gauge(
+            "precision/zero3_gather_bits").value == 16
+    finally:
+        telemetry.reset()
+
+
+def test_drift_report_breaks_out_wire_bytes_saved():
+    from autodist_tpu.simulator.cost_model import CostModel
+    from autodist_tpu.telemetry.drift import drift_report
+
+    t, rs, _, quant = _lm_cost_fixture()
+    cm = CostModel(rs)
+    report = drift_report(quant, cm, {"step": {"p50_ms": 5.0}},
+                          trainable=t)
+    assert report["predicted"]["wire_bytes_saved"] > 0
+    assert report["predicted"]["quant_dq_time_s"] > 0

@@ -1,0 +1,142 @@
+"""The shared quantize/dequantize layer (kernel/quantize.py): ONE
+implementation of the int8 pack/unpack + error-feedback arithmetic used
+by both the dp-grad compressors and the per-boundary precision policy.
+
+Edge cases pinned directly (the PR 8 satellite): the all-zero block, the
+single-element tensor, and non-divisible lanes through the padded
+decomposed pair — each of which a naive scale/round would get wrong
+(divide-by-zero, degenerate max, mis-sliced padding).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from autodist_tpu.kernel import quantize as qz
+
+
+def test_quantize_int8_roundtrip_error_bounded():
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(257).astype(np.float32) * 3.0)
+    q, scale = qz.quantize_int8(x)
+    assert q.dtype == jnp.int8
+    deq = qz.dequantize_int8(q, scale)
+    # symmetric rounding: error per element <= scale/2
+    assert float(jnp.max(jnp.abs(deq - x))) <= float(scale) / 2 + 1e-7
+
+
+def test_all_zero_block_quantizes_to_exact_zeros():
+    x = jnp.zeros(33, jnp.float32)
+    q, scale = qz.quantize_int8(x)
+    assert float(scale) > 0.0          # floored, not a divide-by-zero
+    np.testing.assert_array_equal(np.asarray(q), 0)
+    np.testing.assert_array_equal(np.asarray(qz.dequantize_int8(q, scale)),
+                                  0.0)
+
+
+def test_single_element_block():
+    for v in (0.0, -3.25, 1e-10, 1e20):
+        x = jnp.asarray([v], jnp.float32)
+        q, scale = qz.quantize_int8(x)
+        deq = qz.dequantize_int8(q, scale)
+        if v == 0.0:
+            assert float(deq[0]) == 0.0
+        else:
+            # a single element is its own abs-max: q = ±127 exactly,
+            # so the roundtrip is exact up to fp rounding
+            assert abs(int(np.asarray(q)[0])) == 127
+            np.testing.assert_allclose(float(deq[0]), v, rtol=1e-5)
+
+
+def test_error_feedback_identities():
+    r = np.random.RandomState(1)
+    g = jnp.asarray(r.randn(64).astype(np.float32))
+    res = jnp.asarray(r.randn(64).astype(np.float32) * 0.01)
+    corrected = qz.ef_correct(g, res)
+    np.testing.assert_allclose(np.asarray(corrected),
+                               np.asarray(g) + np.asarray(res), rtol=1e-6)
+    q, scale = qz.quantize_int8(corrected)
+    new_res = qz.ef_residual(corrected, qz.dequantize_int8(q, scale))
+    # the residual IS what the wire lost
+    np.testing.assert_allclose(
+        np.asarray(new_res) + np.asarray(qz.dequantize_int8(q, scale)),
+        np.asarray(corrected), rtol=1e-6)
+
+
+def test_check_precision_rejects_unknown_values():
+    assert qz.check_precision(None) == "fp32"
+    assert qz.check_precision("bf16") == "bf16"
+    with pytest.raises(qz.UnknownPrecisionError):
+        qz.check_precision("int4")
+    with pytest.raises(qz.UnknownPrecisionError):
+        qz.check_precision("fp16", where="tp_psum")
+
+
+def _mesh(n=8):
+    return Mesh(np.array(jax.devices()[:n]), ("data",))
+
+
+def _shard_map(fn, mesh, n_out=1):
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))
+
+
+def test_quantized_psum_matches_psum_within_scale():
+    r = np.random.RandomState(2)
+    x = jnp.asarray(r.randn(4, 37).astype(np.float32))
+    mesh = _mesh()
+    exact = _shard_map(lambda v: jax.lax.psum(v, "data"), mesh)(x)
+    # 8 replicated summands of ~N(0,1): bf16's ~0.4% relative rounding
+    # and int8's scale/2 per-summand rounding both bound well under
+    # 0.25 absolute on a sum of magnitude ~8.
+    for prec, tol in (("fp32", 0.0), ("bf16", 0.25), ("int8", 0.25)):
+        out = _shard_map(
+            lambda v, p=prec: qz.quantized_psum(v, "data", p), mesh)(x)
+        err = float(jnp.max(jnp.abs(out - exact)))
+        if prec == "fp32":
+            assert err == 0.0
+        else:
+            assert err <= tol, (prec, err)
+
+
+def test_quantized_all_gather_true_int8_wire_nondivisible_lanes():
+    """The gather wire: 8 devices each contribute a 13-element shard
+    (13 ∤ 8 lanes of anything — the padding/scale bookkeeping must not
+    assume divisibility); per-shard scales dequantize independently."""
+    r = np.random.RandomState(3)
+    mesh = _mesh()
+    shard = jnp.asarray(r.randn(13).astype(np.float32))
+
+    def gathered(v, prec):
+        return qz.quantized_all_gather_flat(v, "data", prec)
+
+    exact = _shard_map(lambda v: gathered(v, "fp32"), mesh)(shard)
+    for prec, tol in (("bf16", 0.02), ("int8", 0.02)):
+        out = _shard_map(lambda v, p=prec: gathered(v, p), mesh)(shard)
+        assert out.shape == exact.shape == (8 * 13,)
+        assert float(jnp.max(jnp.abs(out - exact))) <= tol, prec
+
+
+def test_quantized_psum_scatter_matches_reduce_scatter():
+    r = np.random.RandomState(4)
+    mesh = _mesh()
+    flat = jnp.asarray(r.randn(40).astype(np.float32))  # 40 = 8 * 5
+    exact = _shard_map(
+        lambda v: qz.quantized_psum_scatter_flat(v, "data", "fp32"),
+        mesh)(flat)
+    for prec, tol in (("bf16", 0.2), ("int8", 0.3)):
+        out = _shard_map(
+            lambda v, p=prec: qz.quantized_psum_scatter_flat(v, "data", p),
+            mesh)(flat)
+        assert out.shape == exact.shape
+        assert float(jnp.max(jnp.abs(out - exact))) <= tol, prec
+
+
+def test_compressors_use_shared_helpers():
+    """The dedup satellite's wiring check: the ring compressor's pack is
+    literally the shared module's, and the EF compressors route through
+    ef_correct/ef_residual (one implementation, two paths)."""
+    from autodist_tpu.kernel.compressor import Int8RingCompressor
+
+    assert Int8RingCompressor._quant is qz.quantize_int8

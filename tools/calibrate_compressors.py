@@ -66,6 +66,52 @@ def time_compressor(name: str, mesh, x, steps: int = 10) -> float:
     return (time.perf_counter() - t0) / steps
 
 
+def time_quantize(precision: str, x, steps: int = 10) -> float:
+    """Wall-clock of one quantize -> dequantize roundtrip (no
+    collective): exactly the compute term the per-boundary precision
+    policy's cost model charges against its byte savings
+    (``simulator/cost_model.py QUANT_PROFILE``)."""
+    from autodist_tpu.kernel import quantize as qz
+
+    if precision == "bf16":
+        def roundtrip(v):
+            return v.astype(jnp.bfloat16).astype(jnp.float32)
+    else:
+        def roundtrip(v):
+            q, scale = qz.quantize_int8(v)
+            return qz.dequantize_int8(q, scale)
+
+    fn = jax.jit(roundtrip)
+    out = fn(x)                      # compile
+    float(np.asarray(out[0]))        # fence
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(out)
+    float(np.asarray(out[0]))
+    return (time.perf_counter() - t0) / steps
+
+
+def measure_quant(size: int, steps: int) -> dict:
+    """The ``"quant"`` calibration section: measured quantize/dequantize
+    seconds per element, per precision, timed at two boundary shapes (a
+    TP-activation-sized payload and the full grad-bucket payload) with
+    the larger shape setting the per-element constant — fixed overheads
+    amortize there, which is the regime the cost model prices."""
+    shapes = sorted({max(size // 64, 1), size})
+    section: dict = {}
+    shape_ms: dict = {}
+    for prec in ("bf16", "int8"):
+        per_elem = None
+        for n in shapes:
+            x = jnp.asarray(np.random.RandomState(1).randn(n)
+                            .astype(np.float32))
+            dt = time_quantize(prec, x, steps)
+            shape_ms[f"{prec}_{n}"] = round(dt * 1e3, 4)
+            per_elem = dt / n
+        section[f"{prec}_s_per_elem"] = float(f"{per_elem:.4g}")
+    return section, shape_ms
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--size", type=int, default=26_214_400,
@@ -82,6 +128,13 @@ def main():
     x = jnp.asarray(np.random.RandomState(0).randn(args.size)
                     .astype(np.float32))
 
+    # q/dq compute per boundary shape FIRST (seconds of work, and the
+    # term the per-boundary precision policy's pricing needs even if a
+    # later compressor compile dies mid-run).
+    quant, quant_shape_ms = measure_quant(args.size, args.steps)
+    for k, v in quant.items():
+        print(f"quant {k:18s} {v:.3e} s/elem", flush=True)
+
     names = ["none", "bf16", "bf16_ef", "int8_ef", "int8_ring",
              "powersgd:4"]
     times = {}
@@ -96,12 +149,17 @@ def main():
                    for n, t in times.items() if n != "none"}
         record = {
             "compressor_factor": factors,
+            # q/dq compute per element (the precision-policy pricing
+            # term, simulator/cost_model.py QUANT_PROFILE) — loaded by
+            # load_calibration like the "link" constants.
+            "quant": quant,
             "meta": {
                 "backend": jax.default_backend(),
                 "device_kind": devs.flat[0].device_kind,
                 "num_devices": int(devs.size),
                 "buffer_elements": args.size,
                 "baseline_ms": round(base * 1e3, 3),
+                "quant_shape_ms": quant_shape_ms,
                 "note": "wall-clock ratio vs uncompressed allreduce; on "
                         "one device this is compute overhead only (no "
                         "wire)",

@@ -30,6 +30,13 @@ asserts collective *counts and kinds* in the optimized HLO text:
   anywhere (distinctive-dimension shape scan), vs. the replicated
   baseline which carries the ``[V, H]`` table and ``[.., V]`` logits —
   a silent re-replication of the loss head fails CI on CPU.
+* ``probe_quantized`` — the per-collective precision policy
+  (``Pipeline(collective_precision=...)``): an int8-policy tp=2 program
+  carries the narrowed element type on every policied collective
+  operand (fp16 levels wire on psums, TRUE s8 on gathers, with the
+  convert pairs), un-policied fp32 boundaries stay untouched, the
+  quantized decomposed rs+ag pair stays un-re-fused, and the int8
+  ZeRO-3 gathers narrow per layer.
 * ``probe_decode`` — the serving engine's fused decode step
   (``autodist_tpu/serving/``): the vocab-parallel tp=2 program carries
   zero full-vocab buffers, no ``[T, T]`` attention-score square, KV
@@ -82,6 +89,23 @@ _SHAPE_RE = re.compile(
     r"\b(?:pred|s4|u4|s8|u8|s16|u16|s32|u32|s64|u64|"
     r"f8\w*|bf16|f16|f32|f64|c64|c128)\[([0-9,]*)\]")
 
+# Same scan keeping the element type — the quantized-collectives probe
+# asserts the *dtype* on the wire, not just the op kind.
+_TYPED_SHAPE_RE = re.compile(
+    r"\b(pred|s4|u4|s8|u8|s16|u16|s32|u32|s64|u64|"
+    r"f8\w*|bf16|f16|f32|f64|c64|c128)\[([0-9,]*)\]")
+
+# Result-type prefix + collective kind: `%x = f16[8]{0} all-reduce(...)`
+# or the tuple/async forms `= (s8[4], s8[4]) all-gather-start(...)`.
+_COLLECTIVE_TYPED_RE = re.compile(
+    r"=\s*(\([^)]*\)|\S+)\s*"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?:-start)?\(")
+
+# Wire dtypes a narrowed boundary may carry: bf16 casts, f16 int8-level
+# sums, true-s8 gathers (and any future fp8 wire).
+_NARROW_DTYPES = ("bf16", "f16", "s8", "u8", "f8")
+
 
 def collective_counts(hlo_text: str) -> dict[str, int]:
     """Count collective ops by kind in optimized HLO text."""
@@ -89,6 +113,60 @@ def collective_counts(hlo_text: str) -> dict[str, int]:
     return {k: counts.get(k, 0)
             for k in ("all-reduce", "all-gather", "reduce-scatter",
                       "collective-permute", "all-to-all")}
+
+
+def collective_wire(hlo_text: str) -> list[tuple[str, str, int]]:
+    """Every collective op's ``(kind, element_type, result_elements)``
+    from optimized HLO text — the wire-dtype analog of
+    :func:`collective_counts` (async ``-start`` forms count once; for
+    tuple results the widest element drives the entry)."""
+    out = []
+    for m in _COLLECTIVE_TYPED_RE.finditer(hlo_text):
+        prefix, kind = m.group(1), m.group(2)
+        best = None
+        for dt, dims in _TYPED_SHAPE_RE.findall(prefix):
+            elems = 1
+            for d in dims.split(","):
+                if d:
+                    elems *= int(d)
+            if best is None or elems > best[1]:
+                best = (dt, elems)
+        if best is None:
+            best = ("", 0)
+        out.append((kind, best[0], best[1]))
+    return out
+
+
+def narrowed_collective_counts(hlo_text: str) -> dict[str, int]:
+    """Collectives whose wire element type is narrower than fp32, by
+    kind — zero everywhere for an fp32-policy program; the policied
+    boundaries for a narrowed one."""
+    counts: dict[str, int] = {
+        k: 0 for k in ("all-reduce", "all-gather", "reduce-scatter",
+                       "collective-permute", "all-to-all")}
+    for kind, dtype, _ in collective_wire(hlo_text):
+        if any(dtype.startswith(n) for n in _NARROW_DTYPES):
+            counts[kind] += 1
+    return counts
+
+
+def nonscalar_all_reduces(hlo_text: str) -> int:
+    """All-reduce ops with a result of more than one element: the
+    shared-scale pmaxes a quantized boundary adds are scalars, so this
+    count isolates the payload-carrying reductions — a monolithic
+    model-axis all-reduce surviving (or re-fusing after) a decomposition
+    shows up here."""
+    return sum(1 for kind, _, elems in collective_wire(hlo_text)
+               if kind == "all-reduce" and elems > 1)
+
+
+_CONVERT_RE = re.compile(r"=\s*(\w+)\[[0-9,]*\][^ ]*\s*convert\(")
+
+
+def convert_counts(hlo_text: str) -> dict[str, int]:
+    """Count ``convert`` ops by result element type — the
+    convert-before/convert-after halves of a narrowed boundary."""
+    return dict(collections.Counter(_CONVERT_RE.findall(hlo_text)))
 
 
 def buffers_with_dim(hlo_text: str, dim: int) -> int:
@@ -250,7 +328,8 @@ def probe_single_replica() -> dict:
 
 
 def _pipeline_runner(tensor_parallel: int, comm_overlap=None,
-                     vocab_parallel: bool = False, vocab_size: int = 32):
+                     vocab_parallel: bool = False, vocab_size: int = 32,
+                     collective_precision=None):
     import jax
     import jax.numpy as jnp
     import optax
@@ -270,10 +349,16 @@ def _pipeline_runner(tensor_parallel: int, comm_overlap=None,
             "mesh": mesh}
     trainable = make_pipeline_lm_trainable(cfg, optax.sgd(0.05),
                                            jax.random.PRNGKey(0))
+    # Hashable policy form (lru_cache): a ("slot", "prec") tuple-of-
+    # pairs stands in for the per-boundary dict.
+    if isinstance(collective_precision, tuple):
+        collective_precision = dict(collective_precision)
     return AutoDist(spec, "Pipeline", num_microbatches=2,
                     tensor_parallel=tensor_parallel,
                     comm_overlap=comm_overlap,
-                    vocab_parallel=vocab_parallel).build(trainable)
+                    vocab_parallel=vocab_parallel,
+                    collective_precision=collective_precision
+                    ).build(trainable)
 
 
 import functools
@@ -282,7 +367,8 @@ import functools
 @functools.lru_cache(maxsize=None)
 def _pipeline_step_text(tensor_parallel: int, comm_overlap=None,
                         vocab_parallel: bool = False,
-                        vocab_size: int = 32) -> str:
+                        vocab_size: int = 32,
+                        collective_precision=None) -> str:
     """Optimized HLO of one pipeline train step (memoized: the tp=1 and
     blocking tp=2 programs serve both probe_pipeline_tp and
     probe_collective_matmul — each 8-device compile costs tens of
@@ -294,7 +380,8 @@ def _pipeline_step_text(tensor_parallel: int, comm_overlap=None,
     batch = {"x": r.randint(0, vocab_size, (8, 8)).astype(np.int32),
              "y": r.randint(0, vocab_size, (8, 8)).astype(np.int32)}
     runner = _pipeline_runner(tensor_parallel, comm_overlap,
-                              vocab_parallel, vocab_size)
+                              vocab_parallel, vocab_size,
+                              collective_precision)
     try:
         return compiled_text(runner.lowered.step_fn, runner.state,
                              runner._place_batch(batch),
@@ -400,7 +487,7 @@ _Z3_V = 2          # virtual stages = per-device layers
 _Z3_LEAVES = 3     # ZeRO-3 stage leaves: mix_in, mix_out, wo/bias
 
 
-def _zero_runner(zero_stage: int):
+def _zero_runner(zero_stage: int, collective_precision=None):
     """dp×pp×tp pipeline (mesh {data:2, pipe:2, model:2}, V=2) whose
     stage has Megatron wi/wo (tp-sharded; their ZeRO requests degrade,
     state shards with the parameter) plus a non-tp ``mix`` pair carrying
@@ -442,20 +529,24 @@ def _zero_runner(zero_stage: int):
                                   num_stages=C)
     spec = {"topology": {"platform": "cpu", "num_devices": 8},
             "mesh": {"data": 2, "pipe": 2, "model": 2}}
+    if isinstance(collective_precision, tuple):
+        collective_precision = dict(collective_precision)
     return AutoDist(spec, "Pipeline", num_microbatches=2,
                     virtual_stages=_Z3_V, tensor_parallel=2,
-                    zero_stage=zero_stage).build(trainable)
+                    zero_stage=zero_stage,
+                    collective_precision=collective_precision
+                    ).build(trainable)
 
 
 @functools.lru_cache(maxsize=None)
-def _zero_step_text(zero_stage: int) -> str:
+def _zero_step_text(zero_stage: int, collective_precision=None) -> str:
     import jax
     import numpy as np
 
     r = np.random.RandomState(0)
     batch = {"x": r.randn(8, 8).astype(np.float32),
              "y": r.randn(8, 8).astype(np.float32)}
-    runner = _zero_runner(zero_stage)
+    runner = _zero_runner(zero_stage, collective_precision)
     try:
         return compiled_text(runner.lowered.step_fn, runner.state,
                              runner._place_batch(batch),
@@ -608,6 +699,98 @@ def probe_decode() -> dict:
     return report
 
 
+def probe_quantized() -> dict:
+    """The per-collective precision policy, structurally: quantization
+    happens *inside* the program — convert-before, narrowed collective
+    operand dtype, convert-after — exactly at the policied boundaries.
+
+    * fp32 policy (the default) carries ZERO narrowed collectives — a
+      lowering that silently narrows an un-policied boundary fails.
+    * ``tp_psum=int8`` at blocking tp=2 carries >= 4 narrowed
+      all-reduces (the Megatron out/wo forward psums and qkv/wi backward
+      cotangent psums, on an fp16 levels wire) with the matching
+      f16-in/f32-out convert pairs — while the dp grad sync, NOT
+      policied in this program, keeps its payload-carrying fp32
+      all-reduces (narrowing is per-boundary, not per-program).
+    * ``tp_psum=int8`` + ``comm_overlap=rsag``: the decomposed pair
+      stays un-re-fused (payload-carrying all-reduce count equals the
+      tp=1 baseline's — the shared-scale pmaxes a quantized boundary
+      adds are scalar and counted separately) and both halves narrow:
+      the rs sums int8 levels on fp16, the ag rides a TRUE s8 wire.
+    * full ``int8`` policy at zero_stage=3: the per-layer on-demand
+      gathers carry narrowed payloads (>= one per (virtual stage,
+      leaf)) and the backward cotangent reduce-scatter narrows too.
+    """
+    tp = 2
+    fp32_text = _pipeline_step_text(tp)
+    n_fp32 = narrowed_collective_counts(fp32_text)
+    assert sum(n_fp32.values()) == 0, (
+        f"fp32-policy tp={tp} program carries narrowed collectives: "
+        f"{n_fp32} — an un-policied boundary silently narrowed")
+
+    tp_only = (("tp_psum", "int8"),)
+    q_text = _pipeline_step_text(tp, collective_precision=tp_only)
+    n_q = narrowed_collective_counts(q_text)
+    assert n_q["all-reduce"] >= 4, (
+        f"tp_psum=int8 narrowed only {n_q['all-reduce']} all-reduce "
+        "op(s); expected >= 4 (out/wo forward + qkv/wi backward psums "
+        "on the fp16 levels wire)")
+    conv = convert_counts(q_text)
+    assert conv.get("f16", 0) >= n_q["all-reduce"], (
+        f"missing convert-before halves: {conv} vs {n_q['all-reduce']} "
+        "narrowed all-reduces")
+    assert conv.get("f32", 0) >= 1, (
+        f"missing convert-after halves (back to f32): {conv}")
+    big_f32_ars = sum(1 for kind, dt, elems in collective_wire(q_text)
+                      if kind == "all-reduce" and dt == "f32"
+                      and elems > 1)
+    assert big_f32_ars >= 1, (
+        "tp_psum-only int8 policy narrowed the (un-policied) dp grad "
+        "sync too — fp32 boundaries must stay untouched")
+
+    c1_payload = nonscalar_all_reduces(_pipeline_step_text(1))
+    rsag_text = _pipeline_step_text(tp, comm_overlap="rsag",
+                                    collective_precision=tp_only)
+    n_rsag = narrowed_collective_counts(rsag_text)
+    rsag_payload = nonscalar_all_reduces(rsag_text)
+    assert rsag_payload == c1_payload, (
+        f"quantized rs+ag program carries {rsag_payload} payload "
+        f"all-reduce(s) vs the tp=1 baseline's {c1_payload} — a "
+        "monolithic model-axis all-reduce survived or the pair re-fused")
+    assert n_rsag["reduce-scatter"] >= 1, (
+        f"no narrowed reduce-scatter in the quantized rs+ag program: "
+        f"{n_rsag}")
+    assert n_rsag["all-gather"] >= 1, (
+        f"no narrowed all-gather in the quantized rs+ag program: "
+        f"{n_rsag}")
+    s8_ags = sum(1 for kind, dt, _ in collective_wire(rsag_text)
+                 if kind == "all-gather" and dt == "s8")
+    assert s8_ags >= 1, (
+        "the ag half of the quantized pair is not on a true s8 wire")
+
+    z3_text = _zero_step_text(3, "int8")
+    n_z3 = narrowed_collective_counts(z3_text)
+    min_gathers = _Z3_V * _Z3_LEAVES
+    assert n_z3["all-gather"] >= min_gathers, (
+        f"int8 zero_stage=3 program narrows only {n_z3['all-gather']} "
+        f"all-gather(s); expected >= {min_gathers} (one per (virtual "
+        "stage, leaf))")
+    assert n_z3["reduce-scatter"] >= 1, (
+        f"int8 zero3 backward cotangent reduce-scatter not narrowed: "
+        f"{n_z3}")
+    return {"narrowed_fp32_policy": n_fp32,
+            "narrowed_tp_psum_int8": n_q,
+            "converts_tp_psum_int8": {k: conv[k] for k in ("f16", "f32")
+                                      if k in conv},
+            "payload_f32_all_reduces_tp_psum_int8": big_f32_ars,
+            "payload_all_reduces_tp1": c1_payload,
+            "payload_all_reduces_rsag_int8": rsag_payload,
+            "narrowed_rsag_int8": n_rsag,
+            "s8_all_gathers_rsag_int8": s8_ags,
+            "narrowed_zero3_int8": n_z3,
+            "min_per_layer_gathers": min_gathers}
+
+
 PROBES = {
     "steps_per_loop": probe_steps_per_loop,
     "single_replica": probe_single_replica,
@@ -615,6 +798,7 @@ PROBES = {
     "collective_matmul": probe_collective_matmul,
     "vocab_parallel": probe_vocab_parallel,
     "zero3": probe_zero3,
+    "quantized": probe_quantized,
     "decode": probe_decode,
 }
 

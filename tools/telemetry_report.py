@@ -21,6 +21,12 @@ import sys
 import numpy as np
 
 _STEP_KEYS = {"kind", "step", "duration_ms"}
+# Per-boundary precision gauges (the Strategy IR policy): the lowering
+# emits `precision/<boundary>_bits` for every narrowed boundary, so a
+# run whose manifest declares a collective_precision annotation but
+# whose metrics lack the gauges means a lowering silently dropped the
+# policy — a schema break, caught by --check in CI.
+_PRECISION_BITS = {"fp32": 32, "bf16": 16, "int8": 8}
 # Per-request serving records (autodist_tpu/serving/batcher.py): the
 # latency facts the serving section aggregates.
 _SERVE_KEYS = {"kind", "request", "tokens", "ttft_ms", "tokens_per_sec"}
@@ -88,6 +94,16 @@ def check_schema(run_dir: str) -> list[str]:
         except (ValueError, KeyError, TypeError) as e:
             problems.append(f"trace.json: invalid chrome trace ({e})")
 
+    # Any precision gauge must carry a legal wire width.
+    gauges = {r.get("name"): r for r in records if r.get("kind") == "gauge"}
+    for name, rec in gauges.items():
+        if isinstance(name, str) and name.startswith("precision/") \
+                and name.endswith("_bits") \
+                and rec.get("value") not in _PRECISION_BITS.values():
+            problems.append(
+                f"metrics.jsonl: {name} = {rec.get('value')!r} is not a "
+                f"wire width in {sorted(_PRECISION_BITS.values())}")
+
     manifest = os.path.join(run_dir, "manifest.json")
     if os.path.exists(manifest):
         try:
@@ -95,6 +111,27 @@ def check_schema(run_dir: str) -> list[str]:
                 m = json.load(f)
             if m.get("kind") != "manifest" or "provenance" not in m:
                 problems.append("manifest.json: kind/provenance missing")
+            declared = (m.get("run") or {}).get("collective_precision")
+            if isinstance(declared, dict):
+                # A run annotated with a precision policy must carry the
+                # per-boundary gauges the lowering emits — their absence
+                # means the policy was silently dropped.
+                for boundary, prec in declared.items():
+                    if prec in (None, "fp32"):
+                        continue
+                    gname = f"precision/{boundary}_bits"
+                    rec = gauges.get(gname)
+                    if rec is None:
+                        problems.append(
+                            f"manifest run.collective_precision declares "
+                            f"{boundary}={prec} but metrics.jsonl has no "
+                            f"{gname} gauge — the lowering dropped the "
+                            "policy")
+                    elif rec.get("value") != _PRECISION_BITS.get(prec):
+                        problems.append(
+                            f"{gname} = {rec.get('value')!r} disagrees "
+                            f"with the declared {boundary}={prec} "
+                            f"({_PRECISION_BITS.get(prec)} bits)")
         except ValueError as e:
             problems.append(f"manifest.json: invalid ({e})")
 
