@@ -1,0 +1,40 @@
+"""Static analysis over plans and programs (the ``ADTxxx`` linter).
+
+Two levels, one diagnostic vocabulary
+(:mod:`~autodist_tpu.analysis.diagnostics`):
+
+* **Plan lint** — :func:`lint_plan` checks a Strategy IR *before*
+  lowering: mesh/shape consistency, precision-slot ↔ boundary
+  agreement, zero_stage × sharding compatibility, comm_overlap
+  disagreements, and every silent warn-and-degrade path promoted to a
+  visible diagnostic.
+* **Program lint** — :func:`lint_program` evaluates declarative
+  :class:`~autodist_tpu.analysis.program_rules.Rule` objects over a
+  parsed-HLO facts layer (:class:`ProgramFacts`), so any lowered
+  program — training step, decode window, any AutoStrategy zoo
+  candidate — is checked by the same engine.
+
+``tools/lint_strategy.py`` sweeps the whole AutoStrategy zoo through
+both levels (and runs the mutation harness proving every rule fires);
+``tools/hlo_probe.py`` remains the back-compat probe CLI on top of the
+same rules.  See ``docs/usage/static_analysis.md``.
+"""
+from autodist_tpu.analysis.diagnostics import (CODES, ERROR, INFO,  # noqa: F401
+                                               WARNING, Diagnostic,
+                                               LintReport)
+from autodist_tpu.analysis.facts import ProgramFacts  # noqa: F401
+from autodist_tpu.analysis.plan_rules import (PLAN_RULES,  # noqa: F401
+                                              degraded_diagnostics,
+                                              lint_plan)
+from autodist_tpu.analysis.program_rules import (Rule,  # noqa: F401
+                                                 check_program,
+                                                 lint_program,
+                                                 rules_for_decode,
+                                                 rules_for_strategy)
+
+__all__ = [
+    "CODES", "ERROR", "WARNING", "INFO", "Diagnostic", "LintReport",
+    "ProgramFacts", "PLAN_RULES", "degraded_diagnostics", "lint_plan",
+    "Rule", "check_program", "lint_program", "rules_for_decode",
+    "rules_for_strategy",
+]

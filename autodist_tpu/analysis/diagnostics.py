@@ -1,0 +1,190 @@
+"""Structured diagnostics for the static-analysis subsystem.
+
+Every finding the plan linter (:mod:`autodist_tpu.analysis.plan_rules`),
+the program linter (:mod:`autodist_tpu.analysis.program_rules`), or the
+source linter (``tools/lint_source.py``) emits is a :class:`Diagnostic`:
+a stable ``ADTxxx`` code, a severity, a source location (variable name,
+boundary, program, or ``file:line``), a one-line message, and a
+suggested fix.  Stable codes are the contract CI and humans key on —
+a rule may sharpen its message freely, but its code never changes
+meaning, and retired codes are never reused.
+
+Code ranges:
+
+* ``ADT0xx`` — plan lint (Strategy IR, before lowering)
+* ``ADT1xx`` — program lint (parsed optimized HLO, after lowering)
+* ``ADT2xx`` — source lint (repo AST rules)
+
+The full table renders in ``docs/usage/static_analysis.md`` and is
+generated from :data:`CODES` — adding a rule without registering its
+code is a :class:`KeyError` at import, not a silent doc drift.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+
+_SEVERITY_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+# code -> (default severity, one-line summary).  The registry is the
+# single source of truth for the docs table and the JSON schema;
+# Diagnostic() rejects unregistered codes.
+CODES: dict[str, tuple[str, str]] = {
+    # --- plan lint (Strategy IR) ------------------------------------- #
+    "ADT001": (ERROR, "mesh axis product does not match the device count"),
+    "ADT002": (ERROR, "graph replicas disagree with the mesh data axes"),
+    "ADT003": (ERROR, "unknown lowering kind"),
+    "ADT004": (ERROR, "lowering requires a mesh axis the spec lacks"),
+    "ADT005": (ERROR, "parallel knob disagrees with the mesh shape"),
+    "ADT006": (ERROR, "sharded dimension does not divide its mesh axis"),
+    "ADT007": (ERROR, "invalid pipeline schedule knob"),
+    "ADT020": (WARNING, "precision policy slot has no matching boundary "
+                        "(quantization is a silent no-op)"),
+    "ADT021": (ERROR, "per-variable precision records disagree within "
+                      "one boundary slot"),
+    "ADT022": (WARNING, "per-variable precision record contradicts the "
+                        "graph policy slot"),
+    "ADT023": (ERROR, "grad precision slot conflicts with an explicit "
+                      "compressor"),
+    "ADT030": (WARNING, "ZeRO on a tensor-parallel-sharded variable "
+                        "degrades (state shards with the parameter)"),
+    "ADT031": (WARNING, "zero_stage=3 on a model-sharded table degrades "
+                        "to optimizer-state sharding"),
+    "ADT032": (ERROR, "invalid ZeRO stage"),
+    "ADT033": (ERROR, "ZeRO stage > 1 under the gspmd lowering"),
+    "ADT034": (WARNING, "lowering degraded a ZeRO request"),
+    "ADT040": (ERROR, "per-variable comm_overlap modes disagree"),
+    "ADT041": (WARNING, "per-variable comm_overlap contradicts the "
+                        "graph knob"),
+    "ADT042": (WARNING, "comm_overlap is a no-op at tensor_parallel=1"),
+    "ADT043": (WARNING, "vocab_parallel is a no-op at tensor_parallel=1"),
+    "ADT044": (ERROR, "unknown comm_overlap mode"),
+    "ADT050": (ERROR, "unknown compressor"),
+    "ADT051": (WARNING, "compressor has no data axis to compress over"),
+    # --- program lint (optimized HLO) -------------------------------- #
+    "ADT101": (ERROR, "step program contains a host transfer"),
+    "ADT102": (ERROR, "multi-step window lowered without a fused loop"),
+    "ADT103": (ERROR, "donated buffers are not aliased "
+                      "(state re-allocated every dispatch)"),
+    "ADT104": (ERROR, "large copy of a donated/cache buffer "
+                      "(in-place update regressed to copy-on-write)"),
+    "ADT105": (ERROR, "forbidden full-extent buffer materialized "
+                      "(a shard re-replicated)"),
+    "ADT106": (ERROR, "full-extent buffer lives across the step boundary "
+                      "(ZeRO-3 storage re-materialized)"),
+    "ADT107": (ERROR, "fewer collectives than the plan requires "
+                      "(per-layer gathers collapsed or missing)"),
+    "ADT108": (ERROR, "decomposed collective pair re-fused "
+                      "(monolithic all-reduce survived)"),
+    "ADT109": (ERROR, "collective wire precision disagrees with the "
+                      "declared policy"),
+    "ADT110": (ERROR, "full-array gather (result exceeds the sharded "
+                      "size budget)"),
+    "ADT111": (ERROR, "missing in-place dynamic-update-slice writes"),
+    "ADT112": (ERROR, "full-sequence attention-score square in a "
+                      "single-token step"),
+    "ADT113": (ERROR, "single-replica program carries cross-device "
+                      "collectives"),
+    "ADT114": (ERROR, "expected model-axis collectives are missing"),
+    # --- source lint (repo AST) -------------------------------------- #
+    "ADT201": (ERROR, "raw collective call outside the sanctioned "
+                      "modules (bypasses the precision policy)"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding: stable code, severity, location, message, fix."""
+
+    code: str
+    message: str
+    where: str = ""          # var name / boundary / program / file:line
+    severity: str = ""       # default: the code's registered severity
+    fix: str = ""            # suggested fix, one line
+    rule: str = ""           # rule name that produced it
+
+    def __post_init__(self):
+        if self.code not in CODES:
+            raise KeyError(
+                f"unregistered diagnostic code {self.code!r}; add it to "
+                "analysis.diagnostics.CODES (and the docs table)")
+        if not self.severity:
+            object.__setattr__(self, "severity", CODES[self.code][0])
+        if self.severity not in _SEVERITY_ORDER:
+            raise ValueError(f"unknown severity {self.severity!r}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self):
+        loc = f" [{self.where}]" if self.where else ""
+        fix = f" (fix: {self.fix})" if self.fix else ""
+        return f"{self.code} {self.severity.upper()}{loc}: " \
+               f"{self.message}{fix}"
+
+
+class LintReport:
+    """An ordered collection of diagnostics with severity accessors —
+    what every linter entry point returns."""
+
+    def __init__(self, diagnostics=()):
+        self.diagnostics: list[Diagnostic] = list(diagnostics)
+
+    def extend(self, diags):
+        self.diagnostics.extend(diags)
+        return self
+
+    @property
+    def errors(self) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == ERROR]
+
+    @property
+    def warnings(self) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == WARNING]
+
+    @property
+    def ok(self) -> bool:
+        """No ERRORs (warnings don't fail CI)."""
+        return not self.errors
+
+    def codes(self) -> set[str]:
+        return {d.code for d in self.diagnostics}
+
+    def by_code(self, code: str) -> list[Diagnostic]:
+        return [d for d in self.diagnostics if d.code == code]
+
+    def sorted(self) -> "LintReport":
+        return LintReport(sorted(
+            self.diagnostics,
+            key=lambda d: (_SEVERITY_ORDER[d.severity], d.code, d.where)))
+
+    def render(self, title: str = "") -> str:
+        lines = []
+        if title:
+            lines.append(f"== {title} ==")
+        if not self.diagnostics:
+            lines.append("clean (no diagnostics)")
+        else:
+            lines.extend(str(d) for d in self.sorted().diagnostics)
+            lines.append(f"{len(self.errors)} error(s), "
+                         f"{len(self.warnings)} warning(s)")
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "ok": self.ok,
+            "errors": len(self.errors),
+            "warnings": len(self.warnings),
+            "diagnostics": [d.to_dict()
+                            for d in self.sorted().diagnostics],
+        }, indent=1)
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def __iter__(self):
+        return iter(self.diagnostics)

@@ -1,0 +1,533 @@
+"""Mutation-test harness: prove every shipped lint rule actually fires.
+
+A rule that never fires is indistinguishable from a rule that is
+broken, so each shipped rule pairs with at least one *seeded
+violation*:
+
+* **plan mutations** — take a real builder-produced Strategy, apply a
+  JSON-level hand-edit (re-replicate a shard's ZeRO, orphan a precision
+  slot, disagree the comm_overlap records, break the mesh…), and assert
+  the plan linter reports the expected ``ADT0xx`` code — and did NOT
+  report it on the unmutated plan.
+* **program mutations** — take a real compiled program from the corpus
+  and either doctor its HLO text (inject a host transfer, strip the
+  fused loop, drop the donation aliasing…) or swap in the program a
+  broken lowering WOULD have produced (the blocking program for
+  "barrier removed", the fp32 program for "precision policy dropped",
+  the replicated program for "shard re-replicated") — and assert the
+  program rule fires, having passed on the honest text.
+
+``tools/lint_strategy.py --mutate`` runs the whole matrix and fails if
+any rule does not discriminate.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from types import SimpleNamespace
+from typing import Callable, Optional
+
+from autodist_tpu.analysis import program_rules as R
+from autodist_tpu.analysis import programs
+from autodist_tpu.analysis.facts import (collective_counts,
+                                         nonscalar_all_reduces)
+from autodist_tpu.analysis.plan_rules import lint_plan
+from autodist_tpu.analysis.program_rules import lint_program
+
+
+# --------------------------------------------------------------------------- #
+# Cheap plan fixtures (strategy building only — no compiles)
+# --------------------------------------------------------------------------- #
+def _lm_trainable(vocab_size: int = 32):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=vocab_size, hidden_size=16,
+                            num_layers=2, num_heads=2, mlp_dim=32,
+                            max_len=8, dtype=jnp.float32,
+                            dropout_rate=0.0, attention_dropout_rate=0.0)
+    return make_pipeline_lm_trainable(cfg, optax.sgd(0.05),
+                                      jax.random.PRNGKey(0))
+
+
+def _tp_mesh_spec():
+    from autodist_tpu.resource import ResourceSpec
+
+    return ResourceSpec({"topology": {"platform": "cpu",
+                                      "num_devices": 8},
+                         "mesh": {"data": 2, "pipe": 2, "model": 2}})
+
+
+def _dp_mesh_spec():
+    from autodist_tpu.resource import ResourceSpec
+
+    return ResourceSpec({"topology": {"platform": "cpu",
+                                      "num_devices": 8},
+                         "mesh": {"data": 4, "pipe": 2}})
+
+
+def _pipeline_fixture(**builder_kwargs):
+    """(strategy, resource_spec, trainable) for a Pipeline variant on
+    the tiny LM; tp>1 variants get the 3-axis mesh."""
+    from autodist_tpu.strategy.parallel_builders import Pipeline
+
+    tp = builder_kwargs.get("tensor_parallel", 1)
+    spec = _tp_mesh_spec() if tp > 1 else _dp_mesh_spec()
+    trainable = _lm_trainable()
+    strategy = Pipeline(num_microbatches=2, **builder_kwargs).build(
+        trainable, spec)
+    return strategy, spec, trainable
+
+
+def _pipe_only_fixture():
+    """Pipeline on a pipe-only mesh (no data axis) — the fixture the
+    compressor-without-data-axis rule needs a clean base on."""
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.strategy.parallel_builders import Pipeline
+
+    spec = ResourceSpec({"topology": {"platform": "cpu",
+                                      "num_devices": 2},
+                         "mesh": {"pipe": 2}})
+    trainable = _lm_trainable()
+    strategy = Pipeline(num_microbatches=2).build(trainable, spec)
+    return strategy, spec, trainable
+
+
+def _fsdp_fixture():
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.strategy.gspmd_builders import FSDPSharded
+
+    spec = ResourceSpec({"topology": {"platform": "cpu",
+                                      "num_devices": 8}})
+    trainable = programs.tiny_trainable()
+    return FSDPSharded(min_size=1).build(trainable, spec), spec, trainable
+
+
+# --------------------------------------------------------------------------- #
+# Mutation records
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass
+class PlanMutation:
+    """Hand-edit a strategy's JSON dict; ``code`` must appear after."""
+
+    name: str
+    code: str
+    description: str
+    fixture: Callable
+    mutate: Callable[[dict], dict]
+    lowered_factory: Optional[Callable] = None   # ADT034: degrade record
+    kind: str = "plan"
+
+    def run(self) -> dict:
+        from autodist_tpu.strategy.ir import Strategy
+
+        strategy, spec, trainable = self.fixture()
+        clean = lint_plan(strategy, resource_spec=spec,
+                          trainable=trainable)
+        d = json.loads(strategy.to_json())
+        mutated_strategy = Strategy.from_json(json.dumps(self.mutate(d)))
+        lowered = self.lowered_factory() if self.lowered_factory else None
+        mutated = lint_plan(mutated_strategy, resource_spec=spec,
+                            trainable=trainable, lowered=lowered)
+        return {"name": self.name, "kind": self.kind, "code": self.code,
+                "clean_ok": self.code not in clean.codes(),
+                "fired": self.code in mutated.codes(),
+                "description": self.description}
+
+
+@dataclasses.dataclass
+class ProgramMutation:
+    """Doctor a compiled program's text (or swap in a broken sibling
+    program); ``code`` must fire on the result and not on the honest
+    text."""
+
+    name: str
+    code: str
+    description: str
+    text: Callable[[], str]
+    rules: Callable[[], list]
+    mutate: Callable[[str], str]
+    kind: str = "program"
+
+    def run(self) -> dict:
+        text = self.text()
+        rules = self.rules()
+        clean = lint_program(text, rules, where=self.name)
+        mutated = lint_program(self.mutate(text), rules, where=self.name)
+        return {"name": self.name, "kind": self.kind, "code": self.code,
+                "clean_ok": self.code not in clean.codes(),
+                "fired": self.code in mutated.codes(),
+                "description": self.description}
+
+
+def _set_node(d: dict, suffix: str, **updates) -> dict:
+    """Update the first node config whose var_name ends with suffix."""
+    for nc in d["node_configs"]:
+        if nc["var_name"].endswith(suffix):
+            for key, value in updates.items():
+                obj, _, field = key.partition(".")
+                if field:
+                    nc[obj][field] = value
+                else:
+                    nc[obj] = value
+            return d
+    raise KeyError(f"no node config matching {suffix!r}")
+
+
+# --------------------------------------------------------------------------- #
+# The plan-mutation matrix
+# --------------------------------------------------------------------------- #
+def _plan_mutations() -> list[PlanMutation]:
+    def edit(fn):
+        def apply(d):
+            fn(d)
+            return d
+        return apply
+
+    return [
+        PlanMutation(
+            "mesh_product_broken", "ADT001",
+            "hand-edited mesh_axes no longer cover the device count",
+            lambda: _pipeline_fixture(tensor_parallel=2),
+            edit(lambda d: d["graph_config"]["mesh_axes"].update(
+                {"data": 4}))),
+        PlanMutation(
+            "replicas_drifted", "ADT002",
+            "graph replicas disagree with the mesh data axes",
+            lambda: _pipeline_fixture(tensor_parallel=2),
+            edit(lambda d: d["graph_config"].update({"replicas": 4}))),
+        PlanMutation(
+            "unknown_lowering", "ADT003",
+            "lowering kind nobody implements",
+            lambda: _pipeline_fixture(tensor_parallel=2),
+            edit(lambda d: d["graph_config"].update(
+                {"lowering": "magic"}))),
+        PlanMutation(
+            "lowering_axis_missing", "ADT004",
+            "lowering re-pointed at a backend whose mesh axis the "
+            "topology lacks",
+            _fsdp_fixture,
+            edit(lambda d: d["graph_config"].update(
+                {"lowering": "sequence"}))),
+        PlanMutation(
+            "tp_exceeds_model_axis", "ADT005",
+            "tensor_parallel raised beyond the model axis",
+            lambda: _pipeline_fixture(tensor_parallel=2),
+            edit(lambda d: d["graph_config"]["parallel"].update(
+                {"tensor_parallel": 4}))),
+        PlanMutation(
+            "spec_names_missing_axis", "ADT006",
+            "partitioner spec names a mesh axis the mesh lacks",
+            lambda: _pipeline_fixture(tensor_parallel=2),
+            edit(lambda d: _set_node(
+                d, "mlp/wi/kernel",
+                **{"partitioner.spec": ["pipe", None, "megamodel"]}))),
+        PlanMutation(
+            "microbatches_zeroed", "ADT007",
+            "pipeline schedule knob edited out of range",
+            lambda: _pipeline_fixture(tensor_parallel=2),
+            edit(lambda d: d["graph_config"]["parallel"].update(
+                {"num_microbatches": 0}))),
+        PlanMutation(
+            "orphan_precision_slot", "ADT020",
+            "tp_psum narrowing requested on a plan with no tp boundary",
+            lambda: _pipeline_fixture(),
+            edit(lambda d: d["graph_config"].update(
+                {"precision": {"tp_psum": "int8"}}))),
+        PlanMutation(
+            "per_var_precision_disagreement", "ADT021",
+            "hand-edited per-variable precisions disagree in one slot",
+            lambda: _pipeline_fixture(tensor_parallel=2),
+            edit(lambda d: (
+                _set_node(d, "mlp/wi/kernel",
+                          **{"partitioner.precision": "int8"}),
+                _set_node(d, "mlp/wo/kernel",
+                          **{"partitioner.precision": "bf16"})))),
+        PlanMutation(
+            "per_var_precision_contradicts_graph", "ADT022",
+            "per-variable record contradicts the graph policy slot",
+            lambda: _pipeline_fixture(tensor_parallel=2,
+                                      collective_precision={
+                                          "tp_psum": "int8"}),
+            edit(lambda d: _set_node(
+                d, "mlp/wi/kernel",
+                **{"partitioner.precision": "bf16"}))),
+        PlanMutation(
+            "grad_precision_vs_compressor", "ADT023",
+            "grad precision slot plus a pinned non-EF compressor",
+            lambda: _pipeline_fixture(tensor_parallel=2,
+                                      collective_precision={
+                                          "grad": "int8"}),
+            edit(lambda d: _set_node(
+                d, "mlp/wi/kernel",
+                **{"synchronizer.compressor": "fp16"}))),
+        PlanMutation(
+            "zero_rereplicated_onto_tp_shard", "ADT030",
+            "ZeRO request hand-added to a tensor-parallel-sharded "
+            "variable (state already shards with the parameter)",
+            lambda: _pipeline_fixture(tensor_parallel=2),
+            edit(lambda d: _set_node(
+                d, "mlp/wi/kernel",
+                synchronizer={"kind": "ps", "zero_stage": 3,
+                              "reduction_destination": "",
+                              "local_replication": False, "sync": True,
+                              "staleness": 0}))),
+        PlanMutation(
+            "zero3_on_vocab_table", "ADT031",
+            "zero_stage=3 hand-added to the model-sharded table",
+            lambda: _pipeline_fixture(tensor_parallel=2,
+                                      vocab_parallel=True),
+            edit(lambda d: _set_node(
+                d, "shared/embedding",
+                synchronizer={"kind": "ps", "zero_stage": 3,
+                              "reduction_destination": "",
+                              "local_replication": False, "sync": True,
+                              "staleness": 0}))),
+        PlanMutation(
+            "zero_stage_out_of_range", "ADT032",
+            "hand-edited ZeRO stage outside the ladder",
+            lambda: _pipeline_fixture(tensor_parallel=2, zero_stage=3),
+            edit(lambda d: _set_node(
+                d, "ln_mlp/scale", **{"synchronizer.zero_stage": 7}))),
+        PlanMutation(
+            "gspmd_zero_stage3", "ADT033",
+            "stage 3 hand-edited under the gspmd lowering",
+            _fsdp_fixture,
+            edit(lambda d: _set_node(
+                d, "w",
+                synchronizer={"kind": "ps", "zero_stage": 3,
+                              "reduction_destination": "",
+                              "local_replication": False, "sync": True,
+                              "staleness": 0}))),
+        PlanMutation(
+            "lowering_degraded_zero", "ADT034",
+            "the lowering recorded a warn-and-degrade (surfaced "
+            "through the one shared diagnostics path)",
+            lambda: _pipeline_fixture(tensor_parallel=2),
+            lambda d: d,
+            lowered_factory=lambda: SimpleNamespace(zero_degraded={
+                "stages/mlp/wi/kernel":
+                    "ZeRO on a tp-sharded variable is a no-op request"})),
+        PlanMutation(
+            "comm_overlap_disagreement", "ADT040",
+            "per-variable overlap modes disagree with no graph knob",
+            lambda: _pipeline_fixture(tensor_parallel=2),
+            edit(lambda d: (
+                d["graph_config"]["parallel"].update(
+                    {"comm_overlap": None}),
+                _set_node(d, "mlp/wi/kernel",
+                          **{"partitioner.comm_overlap": "rsag"}),
+                _set_node(d, "mlp/wo/kernel",
+                          **{"partitioner.comm_overlap": "matmul"})))),
+        PlanMutation(
+            "comm_overlap_contradicts_graph", "ADT041",
+            "per-variable overlap contradicts the graph knob",
+            lambda: _pipeline_fixture(tensor_parallel=2,
+                                      comm_overlap="rsag"),
+            edit(lambda d: _set_node(
+                d, "mlp/wi/kernel",
+                **{"partitioner.comm_overlap": "matmul"}))),
+        PlanMutation(
+            "overlap_noop_at_tp1", "ADT042",
+            "comm_overlap recorded on a tp=1 plan (silent no-op)",
+            lambda: _pipeline_fixture(),
+            edit(lambda d: d["graph_config"]["parallel"].update(
+                {"comm_overlap": "rsag"}))),
+        PlanMutation(
+            "vocab_noop_at_tp1", "ADT043",
+            "vocab_parallel recorded on a tp=1 plan (silent no-op)",
+            lambda: _pipeline_fixture(),
+            edit(lambda d: d["graph_config"]["parallel"].update(
+                {"vocab_parallel": True}))),
+        PlanMutation(
+            "unknown_overlap_mode", "ADT044",
+            "comm_overlap mode nobody implements",
+            lambda: _pipeline_fixture(tensor_parallel=2),
+            edit(lambda d: d["graph_config"]["parallel"].update(
+                {"comm_overlap": "ring"}))),
+        PlanMutation(
+            "compressor_without_data_axis", "ADT051",
+            "compressor hand-added on a pipe-only mesh (no data axis "
+            "to compress over)",
+            _pipe_only_fixture,
+            edit(lambda d: _set_node(
+                d, "ln_mlp/scale",
+                **{"synchronizer.compressor": "bf16_ef"}))),
+        PlanMutation(
+            "unknown_compressor", "ADT050",
+            "compressor name outside the registry",
+            lambda: _pipeline_fixture(tensor_parallel=2),
+            edit(lambda d: _set_node(
+                d, "ln_mlp/scale",
+                **{"synchronizer.compressor": "wavelet"}))),
+    ]
+
+
+# --------------------------------------------------------------------------- #
+# The program-mutation matrix
+# --------------------------------------------------------------------------- #
+def _inject(line: str):
+    def apply(text: str) -> str:
+        head, sep, tail = text.partition("ENTRY ")
+        return head + line + "\n" + sep + tail
+    return apply
+
+
+def _program_mutations() -> list[ProgramMutation]:
+    P = programs
+    tp_only = (("tp_psum", "int8"),)
+    T = P.DEC_T
+    lane = P.DEC_SLOTS * 1 * T * P.DEC_HEAD_DIM
+    min_gathers = P.Z3_V * P.Z3_LEAVES
+    # The pipeline-corpus vocab geometry (distinctive V, tp=2 padding)
+    PIPE_V = 93
+    PIPE_V_PAD = PIPE_V + (-PIPE_V) % 2
+
+    def tp1_ars():
+        return collective_counts(P.pipeline_step_text(1))["all-reduce"]
+
+    return [
+        ProgramMutation(
+            "host_transfer_injected", "ADT101",
+            "a send() appears inside the step program",
+            lambda: P.tiny_step_text(2),
+            lambda: [R.no_host_transfer()],
+            _inject("  %ht = f32[8]{0} send(f32[8]{0} %x, token[] %tk), "
+                    "channel_id=1")),
+        ProgramMutation(
+            "decode_window_unrolled", "ADT102",
+            "the K-token decode window loses its fused while loop",
+            lambda: P.decode_step_text(2, True),
+            lambda: [R.fused_loop()],
+            lambda t: t.replace(" while(", " unrolled(")
+                       .replace("while (", "unrolled (")),
+        ProgramMutation(
+            "donation_alias_dropped", "ADT103",
+            "the donated KV cache loses its input/output aliasing",
+            lambda: P.decode_step_text(2, True),
+            lambda: [R.donated_alias()],
+            lambda t: t.replace("input_output_alias", "io_alias_gone")),
+        ProgramMutation(
+            "cache_lane_copy_injected", "ADT104",
+            "a cache-lane-sized copy appears per dispatch "
+            "(copy-on-write regression)",
+            lambda: P.decode_step_text(2, True),
+            lambda: [R.no_donated_copy(T, lane, "cache-lane")],
+            _inject(f"  %cp = f32[{P.DEC_SLOTS},1,{T},{P.DEC_HEAD_DIM}]"
+                    "{3,2,1,0} copy(f32"
+                    f"[{P.DEC_SLOTS},1,{T},{P.DEC_HEAD_DIM}]"
+                    "{2,3,1,0} %cache)")),
+        ProgramMutation(
+            "vocab_shard_rereplicated", "ADT105",
+            "the vocab-sharded loss head re-replicates (the program a "
+            "dropped spec would compile to)",
+            lambda: P.pipeline_step_text(2, vocab_parallel=True,
+                                         vocab_size=PIPE_V),
+            lambda: [R.no_buffer_with_dim((PIPE_V, PIPE_V_PAD),
+                                          "vocab")],
+            lambda t: P.pipeline_step_text(2, vocab_size=PIPE_V)),
+        ProgramMutation(
+            "zero3_boundary_rematerialized", "ADT106",
+            "full parameters re-appear across the step boundary (the "
+            "program a dropped ZeRO-3 spec would compile to)",
+            lambda: P.zero_step_text(3),
+            lambda: [R.sharded_step_boundary(P.Z3_DIM)],
+            lambda t: P.zero_step_text(0)),
+        ProgramMutation(
+            "zero3_gathers_bulk_collapsed", "ADT107",
+            "the per-layer gather chain collapses into a bulk "
+            "materialization",
+            lambda: P.zero_step_text(3),
+            lambda: [R.min_collectives("all-gather", min_gathers,
+                                       "per-layer ZeRO-3 gathers")],
+            lambda t: t.replace("all-gather", "bulk-gather")),
+        ProgramMutation(
+            "refusion_barrier_removed", "ADT108",
+            "the rs+ag re-fusion barrier is removed (the blocking "
+            "program XLA would re-fuse to)",
+            lambda: P.pipeline_step_text(2, comm_overlap="rsag",
+                                         collective_precision=tp_only),
+            lambda: [R.no_refused_pair(
+                nonscalar_all_reduces(P.pipeline_step_text(1)),
+                payload_only=True)],
+            lambda t: P.pipeline_step_text(2)),
+        ProgramMutation(
+            "precision_policy_dropped", "ADT109",
+            "an int8-policied boundary compiles to an fp32 wire (the "
+            "program a dropped policy would compile to)",
+            lambda: P.pipeline_step_text(
+                2, collective_precision=tp_only),
+            lambda: [R.quantized_wire(mins={"all-reduce": 4})],
+            lambda t: P.pipeline_step_text(2)),
+        ProgramMutation(
+            "unpolicied_boundary_narrowed", "ADT109",
+            "an fp32-policy program silently narrows a wire",
+            lambda: P.pipeline_step_text(2),
+            lambda: [R.quantized_wire(clean=True)],
+            lambda t: P.pipeline_step_text(
+                2, collective_precision=tp_only)),
+        ProgramMutation(
+            "full_array_gather", "ADT110",
+            "an all-gather materializes a full array where the plan "
+            "promises shards",
+            lambda: P.zero_step_text(3),
+            lambda: [R.no_full_gather(10 ** 5)],
+            _inject("  %fg = f32[1000000]{0} all-gather(f32[500000]{0} "
+                    "%p), dimensions={0}")),
+        ProgramMutation(
+            "kv_write_scatterized", "ADT111",
+            "the in-place KV write lowers to something other than "
+            "dynamic-update-slice",
+            lambda: P.decode_step_text(2, True),
+            lambda: [R.min_dus(2 * P.DEC_LAYERS)],
+            lambda t: t.replace("dynamic-update-slice",
+                                "dynamic-overwrite")),
+        ProgramMutation(
+            "score_square_materialized", "ADT112",
+            "a [T, T] attention-score square appears in a single-token "
+            "step",
+            lambda: P.decode_step_text(2, True),
+            lambda: [R.no_score_square(T)],
+            _inject(f"  %sq = f32[3,2,{T},{T}]{{3,2,1,0}} multiply("
+                    f"f32[3,2,{T},{T}]{{3,2,1,0}} %a, "
+                    f"f32[3,2,{T},{T}]{{3,2,1,0}} %b)")),
+        ProgramMutation(
+            "single_replica_collective", "ADT113",
+            "a cross-device collective appears in a 1-device program",
+            lambda: P.tiny_step_text(1),
+            lambda: [R.no_collectives()],
+            _inject("  %ar = f32[8]{0} all-reduce(f32[8]{0} %g), "
+                    "replica_groups={}, to_apply=%add")),
+        ProgramMutation(
+            "tp_psums_missing", "ADT114",
+            "the per-stage Megatron activation all-reduces go missing "
+            "(the tp=1 program presented as tp=2)",
+            lambda: P.pipeline_step_text(2),
+            lambda: [R.min_extra_all_reduces(
+                tp1_ars(), 4, "Megatron activation all-reduces")],
+            lambda t: P.pipeline_step_text(1)),
+    ]
+
+
+def all_mutations() -> list:
+    return _plan_mutations() + _program_mutations()
+
+
+def run_mutations(names=None, kinds=None) -> list[dict]:
+    """Run the matrix (optionally filtered); one result record per
+    mutation: ``ok`` = rule silent on the honest artifact AND fired on
+    the seeded violation."""
+    results = []
+    for mut in all_mutations():
+        if names and mut.name not in names:
+            continue
+        if kinds and mut.kind not in kinds:
+            continue
+        rec = mut.run()
+        rec["ok"] = rec["clean_ok"] and rec["fired"]
+        results.append(rec)
+    return results

@@ -1,0 +1,541 @@
+"""Plan lint: rules over the Strategy IR, *before* lowering.
+
+``lint_plan(strategy)`` checks a serialized (possibly hand-edited)
+strategy for the invariant violations and silent no-ops the builders
+catch only on their own construction path — mesh/shape divisibility,
+precision-slot ↔ boundary consistency, zero_stage × sharding
+compatibility, comm_overlap disagreements — and promotes every
+warn-and-degrade path (``lowered.zero_degraded``, the vocab no-op at
+tp=1, compressor/precision conflicts) into visible, coded diagnostics.
+
+Pass ``resource_spec`` to check the plan against a concrete topology,
+``trainable`` to check sharded dims against real variable shapes, and
+``lowered`` to surface the degradations the lowering actually recorded
+(one shared code path for every degrade: :func:`degraded_diagnostics`).
+
+Every rule is a generator over :class:`PlanContext` registered in
+:data:`PLAN_RULES`; a rule never raises on a malformed plan — it
+reports, so one sweep surfaces *all* findings (the builders' own
+``ValueError``s stay the construction-time fail-fast path).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+from autodist_tpu import const
+from autodist_tpu.analysis.diagnostics import Diagnostic, LintReport
+from autodist_tpu.strategy.ir import (PRECISION_BOUNDARIES, PRECISIONS,
+                                      AllReduceSynchronizer,
+                                      PSSynchronizer, Strategy,
+                                      UnknownPrecisionError,
+                                      normalize_precision)
+
+KNOWN_LOWERINGS = ("collective", "gspmd", "sequence", "pipeline", "expert")
+
+# lowering -> the mesh axis it cannot run without
+_LOWERING_AXIS = {"pipeline": const.PIPE_AXIS,
+                  "sequence": const.SEQ_AXIS,
+                  "expert": const.EXPERT_AXIS}
+
+_OVERLAP_MODES = (None, "", "rsag", "matmul")
+
+
+@dataclasses.dataclass
+class PlanContext:
+    """Everything a plan rule may consult."""
+
+    strategy: Strategy
+    mesh: dict                      # axis -> size (resolved or declared)
+    num_devices: Optional[int]      # from the resource spec, when known
+    var_shapes: dict                # name -> shape (from the trainable)
+    zero_degraded: dict             # from the lowered plan, when given
+
+    @property
+    def graph(self):
+        return self.strategy.graph_config
+
+    @property
+    def parallel(self) -> dict:
+        return self.strategy.graph_config.parallel or {}
+
+    @property
+    def tp(self) -> int:
+        return max(int(self.parallel.get("tensor_parallel", 1) or 1), 1)
+
+    def has_shared(self) -> bool:
+        return any(nc.var_name.startswith("shared/")
+                   for nc in self.strategy.node_configs)
+
+    def is_stage_var(self, name: str) -> bool:
+        return name.startswith("stages/") if self.has_shared() else True
+
+    def precision(self) -> dict:
+        """The graph policy, normalized; unknown entries are reported by
+        their own rule, so this accessor never raises."""
+        try:
+            return normalize_precision(self.graph.precision)
+        except UnknownPrecisionError:
+            return {k: v for k, v in dict(self.graph.precision).items()
+                    if k in PRECISION_BOUNDARIES and v in PRECISIONS
+                    and v != "fp32"}
+
+
+PLAN_RULES = []
+
+
+def plan_rule(fn):
+    PLAN_RULES.append(fn)
+    return fn
+
+
+# --------------------------------------------------------------------------- #
+# Mesh / shape rules
+# --------------------------------------------------------------------------- #
+@plan_rule
+def rule_mesh_matches_devices(ctx: PlanContext):
+    # The strategy's own declared mesh (graph_config.mesh_axes), checked
+    # against the topology's device count — a hand-edited axis size
+    # fires here even though the resource spec itself is consistent.
+    declared = dict(ctx.graph.mesh_axes or {})
+    if not declared or ctx.num_devices is None \
+            or any(v == -1 for v in declared.values()):
+        return
+    total = math.prod(declared.values())
+    if total != ctx.num_devices:
+        yield Diagnostic(
+            "ADT001",
+            f"mesh {declared} covers {total} device(s) but the "
+            f"topology declares {ctx.num_devices}",
+            where="graph_config.mesh_axes",
+            fix="factor the mesh so the axis product equals the device "
+                "count (resource.factor_3d)")
+
+
+@plan_rule
+def rule_replicas_match_mesh(ctx: PlanContext):
+    mesh = ctx.mesh
+    if not mesh:
+        return
+    data = mesh.get(const.DATA_AXIS, 1) * mesh.get(const.DCN_AXIS, 1)
+    if ctx.graph.replicas != data:
+        yield Diagnostic(
+            "ADT002",
+            f"graph_config.replicas={ctx.graph.replicas} but the mesh "
+            f"data axes cover {data} device(s)",
+            where="graph_config.replicas",
+            fix="replicas must equal data x dcn "
+                "(StrategyBuilder.num_replicas)")
+
+
+@plan_rule
+def rule_known_lowering(ctx: PlanContext):
+    kind = ctx.graph.lowering
+    if kind not in KNOWN_LOWERINGS:
+        yield Diagnostic(
+            "ADT003",
+            f"unknown lowering {kind!r}; expected one of "
+            f"{list(KNOWN_LOWERINGS)}",
+            where="graph_config.lowering")
+
+
+@plan_rule
+def rule_lowering_axis_present(ctx: PlanContext):
+    axis = _LOWERING_AXIS.get(ctx.graph.lowering)
+    if axis and ctx.mesh and axis not in ctx.mesh:
+        yield Diagnostic(
+            "ADT004",
+            f"the {ctx.graph.lowering!r} lowering needs a {axis!r} mesh "
+            f"axis; the mesh declares {dict(ctx.mesh)}",
+            where="graph_config.mesh_axes",
+            fix=f"declare mesh: {{..., {axis}: ...}}")
+
+
+@plan_rule
+def rule_tp_matches_model_axis(ctx: PlanContext):
+    tp = ctx.tp
+    if tp > 1 and ctx.mesh \
+            and ctx.mesh.get(const.MODEL_AXIS, 1) != tp:
+        yield Diagnostic(
+            "ADT005",
+            f"parallel.tensor_parallel={tp} but the mesh "
+            f"{const.MODEL_AXIS!r} axis has "
+            f"{ctx.mesh.get(const.MODEL_AXIS, 1)} device(s)",
+            where="graph_config.parallel.tensor_parallel")
+
+
+@plan_rule
+def rule_spec_axes_and_divisibility(ctx: PlanContext):
+    mesh = ctx.mesh
+    for nc in ctx.strategy.node_configs:
+        part = nc.partitioner
+        if part is None or not part.spec:
+            continue
+        axes = [a for a in part.spec if a is not None]
+        for a in axes:
+            for leaf in (a if isinstance(a, (list, tuple)) else [a]):
+                if mesh and leaf not in mesh:
+                    yield Diagnostic(
+                        "ADT006",
+                        f"partitioner spec {part.spec} names mesh axis "
+                        f"{leaf!r}, which the mesh "
+                        f"{dict(mesh)} does not declare",
+                        where=nc.var_name)
+        shape = ctx.var_shapes.get(nc.var_name)
+        if shape is None or len(shape) != len(part.spec):
+            continue
+        # Stage vars: dims after the leading pipe entry must divide
+        # their axis exactly (the lowering does not pad them).  Shared
+        # model-sharded dims (the vocab table) are zero-padded by the
+        # lowering, so non-divisibility there is legal.
+        if not ctx.is_stage_var(nc.var_name):
+            continue
+        for dim, a in list(zip(shape, part.spec))[1:]:
+            if a is None or isinstance(a, (list, tuple)):
+                continue
+            n = mesh.get(a) if mesh else None
+            if n and dim % n:
+                yield Diagnostic(
+                    "ADT006",
+                    f"dim {dim} shards over {a!r} ({n} devices) but "
+                    f"does not divide it",
+                    where=nc.var_name,
+                    fix="pad the dimension or drop the rule for this "
+                        "variable")
+
+
+@plan_rule
+def rule_pipeline_schedule(ctx: PlanContext):
+    if ctx.graph.lowering != "pipeline":
+        return
+    M = int(ctx.parallel.get("num_microbatches", 1) or 0)
+    V = int(ctx.parallel.get("virtual_stages", 1) or 0)
+    if M < 1:
+        yield Diagnostic("ADT007", f"num_microbatches={M} must be >= 1",
+                         where="graph_config.parallel.num_microbatches")
+    if V < 1:
+        yield Diagnostic("ADT007", f"virtual_stages={V} must be >= 1",
+                         where="graph_config.parallel.virtual_stages")
+    if ctx.graph.accum_steps < 1:
+        yield Diagnostic("ADT007",
+                         f"accum_steps={ctx.graph.accum_steps} must be "
+                         ">= 1", where="graph_config.accum_steps")
+
+
+# --------------------------------------------------------------------------- #
+# Precision policy rules
+# --------------------------------------------------------------------------- #
+def _tp_sharded(ctx):
+    """Stage variables carrying a model-axis dim in their spec tail."""
+    out = []
+    for nc in ctx.strategy.node_configs:
+        part = nc.partitioner
+        if part is not None and part.spec \
+                and ctx.is_stage_var(nc.var_name) \
+                and const.MODEL_AXIS in part.spec[1:]:
+            out.append(nc)
+    return out
+
+
+def _vocab_sharded(ctx):
+    """Shared variables sharded over the model axis (the vocab table)."""
+    out = []
+    for nc in ctx.strategy.node_configs:
+        part = nc.partitioner
+        if part is not None and part.spec \
+                and not ctx.is_stage_var(nc.var_name) \
+                and const.MODEL_AXIS in part.spec:
+            out.append(nc)
+    return out
+
+
+@plan_rule
+def rule_orphan_precision_slot(ctx: PlanContext):
+    precision = ctx.precision()
+    if not precision:
+        return
+    nodes = ctx.strategy.node_configs
+    has = {
+        "tp_psum": bool(_tp_sharded(ctx)),
+        "vocab_stats": bool(_vocab_sharded(ctx)),
+        "zero3_gather": any(
+            isinstance(nc.synchronizer, PSSynchronizer)
+            and nc.synchronizer.zero_stage >= 3 for nc in nodes),
+        "grad": any(isinstance(nc.synchronizer, AllReduceSynchronizer)
+                    for nc in nodes),
+    }
+    for slot, value in precision.items():
+        if not has.get(slot, True):
+            yield Diagnostic(
+                "ADT020",
+                f"precision slot {slot}={value!r} has no matching "
+                "boundary in this plan — the narrowing is a silent "
+                "no-op",
+                where=f"graph_config.precision.{slot}",
+                fix="drop the slot, or add the boundary it narrows "
+                    "(tensor_parallel/vocab_parallel/zero_stage)")
+
+
+@plan_rule
+def rule_per_var_precision_consistency(ctx: PlanContext):
+    precision = ctx.precision()
+    for slot, group in (("tp_psum", _tp_sharded(ctx)),
+                        ("vocab_stats", _vocab_sharded(ctx))):
+        recorded = {nc.partitioner.precision for nc in group
+                    if getattr(nc.partitioner, "precision", None)
+                    not in (None, "fp32")}
+        graph_value = precision.get(slot)
+        if graph_value is None:
+            if len(recorded) > 1:
+                yield Diagnostic(
+                    "ADT021",
+                    f"per-variable precisions for the {slot} boundary "
+                    f"disagree ({sorted(recorded)}); the stage body "
+                    "lowers with ONE policy",
+                    where=slot,
+                    fix="set graph_config.precision instead of "
+                        "per-variable records")
+            continue
+        for nc in group:
+            rec = getattr(nc.partitioner, "precision", None)
+            if rec is not None and rec != graph_value:
+                yield Diagnostic(
+                    "ADT022",
+                    f"per-variable precision {rec!r} contradicts the "
+                    f"graph {slot}={graph_value!r} slot (the graph "
+                    "policy wins at lowering; the cost model prices "
+                    "from the per-variable record)",
+                    where=nc.var_name,
+                    fix="regenerate the node configs from the builder, "
+                        "or align the record")
+
+
+@plan_rule
+def rule_grad_precision_vs_compressor(ctx: PlanContext):
+    grad_prec = ctx.precision().get("grad")
+    if not grad_prec:
+        return
+    elected = {"bf16": "bf16_ef", "int8": "int8_ef"}.get(grad_prec)
+    for nc in ctx.strategy.node_configs:
+        comp = getattr(nc.synchronizer, "compressor", "none") or "none"
+        if isinstance(nc.synchronizer, AllReduceSynchronizer) \
+                and comp not in ("none", elected):
+            yield Diagnostic(
+                "ADT023",
+                f"graph precision grad={grad_prec!r} elects the "
+                f"{elected!r} error-feedback compressor, but this "
+                f"variable pins compressor={comp!r}",
+                where=nc.var_name,
+                fix="pass either collective_precision's grad slot or "
+                    "compressor=, not both")
+
+
+# --------------------------------------------------------------------------- #
+# ZeRO rules
+# --------------------------------------------------------------------------- #
+@plan_rule
+def rule_zero_stage_valid(ctx: PlanContext):
+    for nc in ctx.strategy.node_configs:
+        if isinstance(nc.synchronizer, PSSynchronizer) \
+                and nc.synchronizer.zero_stage not in (0, 1, 2, 3):
+            yield Diagnostic(
+                "ADT032",
+                f"zero_stage={nc.synchronizer.zero_stage!r} is not a "
+                "valid stage (0 off, 1 state, 2 +grads, 3 +params)",
+                where=nc.var_name)
+
+
+@plan_rule
+def rule_zero_on_tp_sharded(ctx: PlanContext):
+    for nc in _tp_sharded(ctx):
+        if isinstance(nc.synchronizer, PSSynchronizer) \
+                and nc.synchronizer.zero_stage >= 1:
+            yield Diagnostic(
+                "ADT030",
+                "ZeRO on a tensor-parallel-sharded variable degrades: "
+                "its optimizer state already shards with the parameter "
+                "(the lowering records the degrade)",
+                where=nc.var_name,
+                fix="leave tp-sharded variables on plain sync; ZeRO "
+                    "moves only replicated state")
+
+
+@plan_rule
+def rule_zero3_on_vocab_table(ctx: PlanContext):
+    for nc in _vocab_sharded(ctx):
+        if isinstance(nc.synchronizer, PSSynchronizer) \
+                and nc.synchronizer.zero_stage >= 3:
+            yield Diagnostic(
+                "ADT031",
+                "zero_stage=3 on the model-sharded table degrades to "
+                "optimizer-state sharding: the parameter is already "
+                "1/tp-sharded over the model axis",
+                where=nc.var_name,
+                fix="use zero_stage<=2 on vocab-sharded tables (state "
+                    "still shards over model x pipe x data)")
+
+
+@plan_rule
+def rule_gspmd_zero_stage(ctx: PlanContext):
+    if ctx.graph.lowering != "gspmd":
+        return
+    for nc in ctx.strategy.node_configs:
+        if isinstance(nc.synchronizer, PSSynchronizer) \
+                and nc.synchronizer.zero_stage > 1:
+            yield Diagnostic(
+                "ADT033",
+                f"zero_stage={nc.synchronizer.zero_stage} under the "
+                "gspmd lowering: parameter sharding there is "
+                "FSDPSharded's job",
+                where=nc.var_name,
+                fix="use gspmd_builders.FSDPSharded, or the pipeline "
+                    "builder's zero_stage knob")
+
+
+@plan_rule
+def rule_lowered_degrades(ctx: PlanContext):
+    yield from degraded_diagnostics(ctx.zero_degraded)
+
+
+def degraded_diagnostics(zero_degraded: Optional[dict]):
+    """The ONE code path that turns a lowering's warn-and-degrade
+    records (``lowered.zero_degraded``) into diagnostics — used by
+    :func:`lint_plan` and by anything holding a lowered plan."""
+    for name, reason in sorted((zero_degraded or {}).items()):
+        yield Diagnostic(
+            "ADT034",
+            f"lowering degraded the ZeRO request: {reason}",
+            where=name,
+            fix="adjust the plan if the degraded form is not what you "
+                "meant; the program trains, but without this shard")
+
+
+# --------------------------------------------------------------------------- #
+# comm_overlap / vocab rules
+# --------------------------------------------------------------------------- #
+@plan_rule
+def rule_overlap_modes(ctx: PlanContext):
+    graph_mode = ctx.parallel.get("comm_overlap") or None
+    if graph_mode not in _OVERLAP_MODES:
+        yield Diagnostic(
+            "ADT044",
+            f"unknown comm_overlap mode {graph_mode!r}; expected "
+            "'rsag' or 'matmul'",
+            where="graph_config.parallel.comm_overlap")
+    var_modes = {}
+    for nc in ctx.strategy.node_configs:
+        mode = getattr(nc.partitioner, "comm_overlap", None) \
+            if nc.partitioner else None
+        if mode:
+            var_modes.setdefault(mode, []).append(nc.var_name)
+            if mode not in _OVERLAP_MODES:
+                yield Diagnostic(
+                    "ADT044",
+                    f"unknown comm_overlap mode {mode!r}",
+                    where=nc.var_name)
+    if graph_mode is None and len(var_modes) > 1:
+        yield Diagnostic(
+            "ADT040",
+            f"per-variable comm_overlap modes disagree "
+            f"({sorted(var_modes)}); the stage body lowers with one "
+            "mode",
+            where="node_configs",
+            fix="set graph_config.parallel['comm_overlap']")
+    elif graph_mode is not None:
+        for mode, names in var_modes.items():
+            if mode != graph_mode:
+                yield Diagnostic(
+                    "ADT041",
+                    f"per-variable comm_overlap={mode!r} contradicts "
+                    f"the graph knob {graph_mode!r} (the graph knob "
+                    "drives the stage body)",
+                    where=names[0])
+
+
+@plan_rule
+def rule_noop_at_tp1(ctx: PlanContext):
+    if ctx.graph.lowering != "pipeline" or ctx.tp > 1:
+        return
+    if ctx.parallel.get("comm_overlap"):
+        yield Diagnostic(
+            "ADT042",
+            "comm_overlap is recorded but tensor_parallel=1 emits no "
+            "model-axis collectives to decompose — a silent no-op",
+            where="graph_config.parallel.comm_overlap",
+            fix="set tensor_parallel>1, or drop the knob")
+    if ctx.parallel.get("vocab_parallel"):
+        yield Diagnostic(
+            "ADT043",
+            "vocab_parallel is recorded but tensor_parallel=1 keeps "
+            "the table replicated — a silent no-op",
+            where="graph_config.parallel.vocab_parallel",
+            fix="set tensor_parallel>1, or drop the knob")
+
+
+# --------------------------------------------------------------------------- #
+# Synchronizer / compressor rules
+# --------------------------------------------------------------------------- #
+@plan_rule
+def rule_known_compressor(ctx: PlanContext):
+    from autodist_tpu.kernel.compressor import Compressor
+
+    seen = set()
+    for nc in ctx.strategy.node_configs:
+        comp = getattr(nc.synchronizer, "compressor", "none") or "none"
+        if comp in seen:
+            continue
+        seen.add(comp)
+        try:
+            Compressor.create(comp)
+        except (ValueError, TypeError) as e:
+            yield Diagnostic("ADT050", str(e), where=nc.var_name)
+
+
+@plan_rule
+def rule_compressor_without_data_axis(ctx: PlanContext):
+    mesh = ctx.mesh
+    if not mesh or const.DATA_AXIS in mesh or const.DCN_AXIS in mesh:
+        return
+    for nc in ctx.strategy.node_configs:
+        comp = getattr(nc.synchronizer, "compressor", "none") or "none"
+        if comp != "none":
+            yield Diagnostic(
+                "ADT051",
+                f"compressor {comp!r} has no data axis to compress "
+                f"over on mesh {dict(mesh)}; gradients sync "
+                "uncompressed",
+                where=nc.var_name)
+            return   # one diagnostic covers the mesh-level condition
+
+
+# --------------------------------------------------------------------------- #
+# Entry point
+# --------------------------------------------------------------------------- #
+def lint_plan(strategy: Strategy, resource_spec=None, trainable=None,
+              lowered=None) -> LintReport:
+    """Run every plan rule over ``strategy``; see the module docstring
+    for what the optional context arguments unlock."""
+    mesh = dict(strategy.graph_config.mesh_axes or {})
+    num_devices = None
+    if resource_spec is not None:
+        try:
+            mesh = dict(resource_spec.resolved_mesh_shape())
+            num_devices = resource_spec.num_devices()
+        except (ValueError, RuntimeError):
+            pass
+    var_shapes = {}
+    if trainable is not None:
+        try:
+            var_shapes = {i.name: tuple(i.shape)
+                          for i in trainable.var_infos()}
+        except (AttributeError, TypeError):
+            pass
+    ctx = PlanContext(
+        strategy=strategy, mesh=mesh, num_devices=num_devices,
+        var_shapes=var_shapes,
+        zero_degraded=dict(getattr(lowered, "zero_degraded", None) or {}))
+    report = LintReport()
+    for rule in PLAN_RULES:
+        report.extend(rule(ctx))
+    return report.sorted()

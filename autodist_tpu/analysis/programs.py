@@ -1,0 +1,276 @@
+"""The compiled-program corpus the program linter sweeps.
+
+Small, CPU-lowerable programs covering every lowering family the repo
+ships — the tiny data-parallel trainable, the dp×pp×tp pipeline (plain,
+overlapped, vocab-parallel, quantized), the ZeRO-ladder pipeline with a
+distinctive non-tp parameter dim, and the serving engine's fused decode
+window.  Each text is memoized per process: an 8-device compile costs
+tens of seconds, and one compiled text serves ``tools/hlo_probe.py``'s
+probes, the program-lint rules, the mutation harness, and the tier-1
+tests alike.
+
+Geometry constants are chosen *distinctive* (a vocab of 93, a mix dim
+of 29, a cache length of 57 — extents no other tensor dimension
+equals), so a shape-scan hit in the facts layer IS the buffer the rule
+forbids.
+"""
+from __future__ import annotations
+
+import functools
+
+from autodist_tpu.analysis.facts import compiled_text
+
+
+def tiny_trainable():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import Trainable
+
+    params = {"w": jnp.zeros((16, 4), jnp.float32)}
+
+    def loss_fn(p, batch):
+        return jnp.mean((batch["x"] @ p["w"] - batch["y"]) ** 2)
+
+    return Trainable.from_loss_fn(loss_fn, params, optax.sgd(0.1))
+
+
+def tiny_batch(n: int = 1):
+    import numpy as np
+
+    r = np.random.RandomState(0)
+    return {"x": r.randn(8, 16).astype(np.float32),
+            "y": r.randn(8, 4).astype(np.float32)}
+
+
+@functools.lru_cache(maxsize=None)
+def tiny_step_text(num_devices: int = 2) -> str:
+    """One data-parallel train step of the tiny trainable on an
+    ``num_devices``-device mesh (the single-replica bypass program at
+    ``num_devices=1``)."""
+    import jax
+
+    from autodist_tpu import AllReduce, AutoDist
+
+    spec = {"topology": {"platform": "cpu", "num_devices": num_devices}}
+    runner = AutoDist(spec, AllReduce()).build(tiny_trainable())
+    try:
+        return compiled_text(runner.lowered.step_fn, runner.state,
+                             runner._place_batch(tiny_batch()),
+                             jax.random.PRNGKey(0))
+    finally:
+        runner.close()
+
+
+@functools.lru_cache(maxsize=None)
+def tiny_scan_texts(k: int = 4) -> tuple[str, str]:
+    """``(text_k, text_1)``: the k-step fused ``run_steps`` program and
+    the single-step program it must match collective-for-collective."""
+    import jax
+    from jax import lax
+
+    from autodist_tpu import AllReduce, AutoDist, stack_steps
+
+    spec = {"topology": {"platform": "cpu", "num_devices": 2}}
+    runner = AutoDist(spec, AllReduce()).build(tiny_trainable())
+    try:
+        step_fn = runner.lowered.step_fn
+
+        def scanned(state, batches, rngs):
+            def body(s, xs):
+                b, r = xs
+                return step_fn(s, b, r)
+            return lax.scan(body, state, (batches, rngs))
+
+        stacked = runner.place_steps(stack_steps(
+            [tiny_batch() for _ in range(k)]))
+        rngs = jax.random.split(jax.random.PRNGKey(0), k)
+        text_k = compiled_text(jax.jit(scanned), runner.state, stacked,
+                               rngs)
+        text_1 = compiled_text(step_fn, runner.state,
+                               runner._place_batch(tiny_batch()),
+                               jax.random.PRNGKey(0))
+    finally:
+        runner.close()
+    return text_k, text_1
+
+
+# --------------------------------------------------------------------------- #
+# dp×pp×tp pipeline LM programs
+# --------------------------------------------------------------------------- #
+def pipeline_runner(tensor_parallel: int, comm_overlap=None,
+                    vocab_parallel: bool = False, vocab_size: int = 32,
+                    collective_precision=None):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=vocab_size, hidden_size=16,
+                            num_layers=2,
+                            num_heads=2, mlp_dim=32, max_len=8,
+                            dtype=jnp.float32, dropout_rate=0.0,
+                            attention_dropout_rate=0.0)
+    mesh = {"data": 2, "pipe": 2, "model": 2} if tensor_parallel > 1 \
+        else {"data": 4, "pipe": 2}
+    spec = {"topology": {"platform": "cpu", "num_devices": 8},
+            "mesh": mesh}
+    trainable = make_pipeline_lm_trainable(cfg, optax.sgd(0.05),
+                                           jax.random.PRNGKey(0))
+    # Hashable policy form (lru_cache): a ("slot", "prec") tuple-of-
+    # pairs stands in for the per-boundary dict.
+    if isinstance(collective_precision, tuple):
+        collective_precision = dict(collective_precision)
+    return AutoDist(spec, "Pipeline", num_microbatches=2,
+                    tensor_parallel=tensor_parallel,
+                    comm_overlap=comm_overlap,
+                    vocab_parallel=vocab_parallel,
+                    collective_precision=collective_precision
+                    ).build(trainable)
+
+
+@functools.lru_cache(maxsize=None)
+def pipeline_step_text(tensor_parallel: int, comm_overlap=None,
+                       vocab_parallel: bool = False,
+                       vocab_size: int = 32,
+                       collective_precision=None) -> str:
+    """Optimized HLO of one pipeline train step (memoized: the tp=1 and
+    blocking tp=2 programs serve several probes/rules — each 8-device
+    compile costs tens of seconds, and the bench embeds an all-probes
+    run under a budget)."""
+    import jax
+    import numpy as np
+
+    r = np.random.RandomState(0)
+    batch = {"x": r.randint(0, vocab_size, (8, 8)).astype(np.int32),
+             "y": r.randint(0, vocab_size, (8, 8)).astype(np.int32)}
+    runner = pipeline_runner(tensor_parallel, comm_overlap,
+                             vocab_parallel, vocab_size,
+                             collective_precision)
+    try:
+        return compiled_text(runner.lowered.step_fn, runner.state,
+                             runner._place_batch(batch),
+                             jax.random.PRNGKey(0))
+    finally:
+        runner.close()
+
+
+# --------------------------------------------------------------------------- #
+# ZeRO-ladder pipeline programs
+# --------------------------------------------------------------------------- #
+# Distinctive dim of the probe's non-tp stage matrices: no activation,
+# batch, or other parameter carries it, so a hit in the ENTRY signature
+# IS a full parameter living across the step boundary.
+Z3_DIM = 29
+Z3_V = 2          # virtual stages = per-device layers
+Z3_LEAVES = 3     # ZeRO-3 stage leaves: mix_in, mix_out, wo/bias
+
+
+def zero_runner(zero_stage: int, collective_precision=None):
+    """dp×pp×tp pipeline (mesh {data:2, pipe:2, model:2}, V=2) whose
+    stage has Megatron wi/wo (tp-sharded; their ZeRO requests degrade,
+    state shards with the parameter) plus a non-tp ``mix`` pair carrying
+    the distinctive :data:`Z3_DIM` — the variables the ZeRO stage
+    actually moves."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from autodist_tpu import AutoDist, PipelineTrainable
+    from autodist_tpu.parallel.tensor import column_parallel, row_parallel
+
+    HID, FF, C = 8, 16, 4
+    r = np.random.RandomState(0)
+    stacked = {
+        "wi": {"kernel": jnp.asarray(r.randn(C, HID, FF) * 0.3,
+                                     jnp.float32),
+               "bias": jnp.zeros((C, FF), jnp.float32)},
+        "wo": {"kernel": jnp.asarray(r.randn(C, FF, HID) * 0.3,
+                                     jnp.float32),
+               "bias": jnp.zeros((C, HID), jnp.float32)},
+        "mix_in": jnp.asarray(r.randn(C, HID, Z3_DIM) * 0.3, jnp.float32),
+        "mix_out": jnp.asarray(r.randn(C, Z3_DIM, HID) * 0.3, jnp.float32),
+    }
+
+    def stage_fn(p, x, model_axis=None, comm_overlap=None):
+        h = jax.nn.relu(column_parallel(x, p["wi"]["kernel"],
+                                        p["wi"]["bias"],
+                                        model_axis=model_axis))
+        y = row_parallel(h, p["wo"]["kernel"], p["wo"]["bias"],
+                         model_axis=model_axis)
+        return y + jnp.tanh(y @ p["mix_in"]) @ p["mix_out"]
+
+    def head(outputs, batch):
+        return jnp.mean((outputs - batch["y"]) ** 2), {}
+
+    trainable = PipelineTrainable(stage_fn, stacked, head, optax.adam(1e-2),
+                                  num_stages=C)
+    spec = {"topology": {"platform": "cpu", "num_devices": 8},
+            "mesh": {"data": 2, "pipe": 2, "model": 2}}
+    if isinstance(collective_precision, tuple):
+        collective_precision = dict(collective_precision)
+    return AutoDist(spec, "Pipeline", num_microbatches=2,
+                    virtual_stages=Z3_V, tensor_parallel=2,
+                    zero_stage=zero_stage,
+                    collective_precision=collective_precision
+                    ).build(trainable)
+
+
+@functools.lru_cache(maxsize=None)
+def zero_step_text(zero_stage: int, collective_precision=None) -> str:
+    import jax
+    import numpy as np
+
+    r = np.random.RandomState(0)
+    batch = {"x": r.randn(8, 8).astype(np.float32),
+             "y": r.randn(8, 8).astype(np.float32)}
+    runner = zero_runner(zero_stage, collective_precision)
+    try:
+        return compiled_text(runner.lowered.step_fn, runner.state,
+                             runner._place_batch(batch),
+                             jax.random.PRNGKey(0))
+    finally:
+        runner.close()
+
+
+# --------------------------------------------------------------------------- #
+# Serving decode programs
+# --------------------------------------------------------------------------- #
+# Decode-probe geometry: T (cache max_len) and V (vocab) are chosen
+# distinctive — no other tensor dimension equals either, so a shape scan
+# hit IS the buffer the claim forbids.
+DEC_T = 57
+DEC_V = 93
+DEC_LAYERS = 2
+DEC_SLOTS = 3
+DEC_HEAD_DIM = 8
+
+
+@functools.lru_cache(maxsize=None)
+def decode_step_text(tensor_parallel: int, vocab_parallel: bool) -> str:
+    """Optimized HLO of one fused-decode dispatch of the serving
+    engine (memoized like the pipeline texts)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+    from autodist_tpu.serving import ServingEngine
+
+    cfg = TransformerConfig(vocab_size=DEC_V, hidden_size=16,
+                            num_layers=DEC_LAYERS, num_heads=2,
+                            mlp_dim=32, max_len=DEC_T, dtype=jnp.float32,
+                            dropout_rate=0.0, attention_dropout_rate=0.0)
+    params = make_pipeline_lm_trainable(
+        cfg, optax.sgd(0.1), jax.random.PRNGKey(0)).params
+    engine = ServingEngine(cfg, params, tensor_parallel=tensor_parallel,
+                           vocab_parallel=vocab_parallel,
+                           num_slots=DEC_SLOTS, max_len=DEC_T,
+                           prefill_len=8, decode_steps=4)
+    return engine.compiled_decode_text()

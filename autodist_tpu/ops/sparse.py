@@ -63,10 +63,12 @@ def _rows_per_shard(full_rows: int, num_shards: int) -> int:
 
 def _collective_lookup_fwd(shard, ids, axis_name, num_shards, full_rows):
     flat_ids = ids.reshape(-1)
+    # lint: allow-raw-collective — sparse-lookup kernel: id exchange
     gids = lax.all_gather(flat_ids, axis_name)       # [n, B] — tiny
     rows, _, _ = _local_hits(shard, gids, axis_name)  # [n, B, D]
     n, b, d = rows.shape
     # Sum over shards; device i keeps slice i == the rows for its own ids.
+    # lint: allow-raw-collective — sparse-lookup kernel row exchange
     mine = lax.psum_scatter(rows.reshape(n * b, d), axis_name,
                             scatter_dimension=0, tiled=True)
     out = mine.reshape(*ids.shape, d)
@@ -76,8 +78,11 @@ def _collective_lookup_fwd(shard, ids, axis_name, num_shards, full_rows):
 def _collective_lookup_bwd(axis_name, num_shards, full_rows, ids, g):
     flat_ids = ids.reshape(-1)
     d = g.shape[-1]
-    gids = lax.all_gather(flat_ids, axis_name)                 # [n, B]
-    grows = lax.all_gather(g.reshape(-1, d), axis_name)        # [n, B, D]
+    # The IndexedSlices-style sparse grad exchange: ids + touched rows,
+    # not a policied dense boundary.
+    gids = lax.all_gather(flat_ids, axis_name)   # lint: allow-raw-collective
+    grows = lax.all_gather(   # lint: allow-raw-collective
+        g.reshape(-1, d), axis_name)             # [n, B, D]
     rows_per_shard = _rows_per_shard(full_rows, num_shards)
     local = gids - lax.axis_index(axis_name) * rows_per_shard
     ok = (local >= 0) & (local < rows_per_shard)

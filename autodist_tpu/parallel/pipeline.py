@@ -233,6 +233,8 @@ def last_stage_value(value, axis_name: str = const.PIPE_AXIS):
     S = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     return jax.tree.map(
+        # pipe-axis last-stage broadcast (role select), not a policied
+        # data boundary:        # lint: allow-raw-collective
         lambda x: lax.psum(
             jnp.where(idx == S - 1, x, jnp.zeros_like(x)), axis_name),
         value)
@@ -970,12 +972,14 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         idx = lax.axis_index(pipe_axis)
 
         def bc_last(m):
+            # lint: allow-raw-collective — pipe-axis metric broadcast
             return lax.psum(
                 jnp.where(idx == n - 1, m, jnp.zeros_like(m)), pipe_axis)
 
         out = {}
         for k, m in metrics.items():
             if stage_aux and k == "aux_loss":
+                # lint: allow-raw-collective — scalar pipe-axis metric
                 out[k] = lax.psum(m, pipe_axis)
             else:
                 out[k] = jax.tree.map(bc_last, m)
@@ -1057,6 +1061,9 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                     g, common.axes_entry(pol.zero_axes),
                     zero_count(pol), mean=False)
                 return rs / n_d
+            # pipe-axis role sum (each device holds a DIFFERENT shared-
+            # grad piece); the policied dp grad boundary is the pmean/
+            # compressor below:  # lint: allow-raw-collective
             gp = lax.psum(g, pipe_axis)
             if pol is not None and pol.compressor != "none" and has_data:
                 return compressed(name, gp, pol.compressor)

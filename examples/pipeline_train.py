@@ -205,6 +205,23 @@ def main():
     strategy = ad.build_or_load_strategy(trainable)
     runner = ad.build(trainable, strategy)
 
+    # Plan lint at build: every silent degrade (ZeRO on a tp shard,
+    # vocab no-op at tp=1, orphan precision slot, ...) surfaces as a
+    # coded ADT diagnostic instead of a buried log line (the same rules
+    # `tools/lint_strategy.py --zoo` gates CI on).
+    from autodist_tpu import analysis
+
+    plan_report = analysis.lint_plan(
+        strategy, resource_spec=ad.resource_spec, trainable=trainable,
+        lowered=getattr(runner, "lowered", None))
+    if plan_report.diagnostics:
+        print(f"plan lint ({len(plan_report.errors)} error(s), "
+              f"{len(plan_report.warnings)} warning(s)):")
+        for diag in plan_report.sorted():
+            print(f"  {diag}")
+    else:
+        print("plan lint: clean")
+
     print(f"pipe={pp} x virtual={args.virtual_stages} "
           f"(C={C} chunks), dp={dp}, tp={tp}, M={args.microbatches}, "
           f"comm_overlap={overlap}, vocab_parallel={args.vocab_parallel}, "

@@ -1,0 +1,181 @@
+"""CI wiring of the lint CLIs (tools/lint_strategy.py,
+tools/lint_source.py) — the `telemetry_report.py --check` pattern:
+in-process main() for the rc contract, subprocess for the real CI
+spelling, with a budget guard on anything that compiles.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+TOOLS = os.path.join(REPO, "tools")
+
+
+def _tool(name):
+    sys.path.insert(0, TOOLS)
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
+# --------------------------------------------------------------------------- #
+# tools/lint_source.py — the AST raw-collective lint
+# --------------------------------------------------------------------------- #
+def test_lint_source_repo_is_clean():
+    lint_source = _tool("lint_source")
+    assert lint_source.main(["--check"]) == 0
+
+
+def test_lint_source_flags_raw_collective(tmp_path):
+    lint_source = _tool("lint_source")
+    pkg = tmp_path / "autodist_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    (pkg / "newlowering.py").write_text(textwrap.dedent("""
+        from jax import lax
+
+        def sync(g, axis):
+            return lax.psum(g, axis)
+    """))
+    diags = lint_source.lint_tree(str(tmp_path / "autodist_tpu"))
+    assert [d.code for d in diags] == ["ADT201"]
+    assert "newlowering.py:5" in diags[0].where
+
+
+def test_lint_source_catches_aliased_spellings(tmp_path):
+    """from-imports and module aliases cannot dodge the guard: every
+    local spelling of a forbidden collective is resolved."""
+    lint_source = _tool("lint_source")
+    pkg = tmp_path / "autodist_tpu"
+    pkg.mkdir()
+    (pkg / "sneaky.py").write_text(textwrap.dedent("""
+        import jax
+        import jax.lax as jl
+        from jax.lax import all_gather
+        from jax.lax import psum as my_sum
+        from jax import lax as L
+
+        def a(x, ax):
+            return all_gather(x, ax)
+
+        def b(x, ax):
+            return my_sum(x, ax)
+
+        def c(x, ax):
+            return jl.psum_scatter(x, ax, scatter_dimension=0)
+
+        def d(x, ax):
+            return L.psum(x, ax)
+
+        def e(x, ax):
+            return jax.lax.psum(x, ax)
+    """))
+    diags = lint_source.lint_tree(str(pkg))
+    assert len(diags) == 5
+    assert {d.code for d in diags} == {"ADT201"}
+
+
+def test_lint_source_honors_pragma_and_allowlist(tmp_path):
+    lint_source = _tool("lint_source")
+    pkg = tmp_path / "autodist_tpu"
+    (pkg / "parallel").mkdir(parents=True)
+    (pkg / "kernel").mkdir()
+    (pkg / "parallel" / "ok.py").write_text(textwrap.dedent("""
+        from jax import lax
+
+        def role_sum(g, axis):
+            # pipe-axis role reduction:  # lint: allow-raw-collective
+            return lax.psum(g, axis)
+    """))
+    # kernel/ is allowlisted wholesale
+    (pkg / "kernel" / "raw.py").write_text(
+        "from jax import lax\n\n"
+        "def f(g, a):\n    return lax.all_gather(g, a)\n")
+    assert lint_source.lint_tree(str(pkg)) == []
+
+
+def test_lint_source_subprocess_gate():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "lint_source.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+
+
+# --------------------------------------------------------------------------- #
+# tools/lint_strategy.py — plan/program/mutation sweep
+# --------------------------------------------------------------------------- #
+def test_lint_strategy_files_mode(tmp_path):
+    lint_strategy = _tool("lint_strategy")
+    from autodist_tpu.analysis.mutations import _pipeline_fixture
+
+    strategy, _, _ = _pipeline_fixture(tensor_parallel=2)
+    good = tmp_path / "good.json"
+    good.write_text(strategy.to_json())
+    assert lint_strategy.main([str(good)]) == 0
+
+    d = json.loads(strategy.to_json())
+    d["graph_config"]["lowering"] = "magic"
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(d))
+    assert lint_strategy.main([str(bad)]) == 1
+
+
+def test_lint_strategy_zoo_plan_sweep_subprocess(tmp_path):
+    """The CI gate: plan-lint the ENTIRE candidate zoo in a fresh
+    process.  Budget guard: --plan-only --no-decode skips every
+    compile (the program level is covered in-process by
+    test_analysis.py over the shared memoized corpus)."""
+    out = tmp_path / "zoo.json"
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+                "PYTHONPATH": REPO})
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "lint_strategy.py"),
+         "--zoo", "--check", "--plan-only", "--no-decode",
+         "--json", str(out)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    report = json.loads(out.read_text())
+    # the sweep actually covered the zoo: both fixture families, and
+    # the marquee candidates among them
+    names = [r["candidate"] for r in report["zoo"]]
+    assert any(n.startswith("generic/") for n in names)
+    assert sum(n.startswith("pipeline_lm/") for n in names) >= 5
+    for rec in report["zoo"]:
+        errors = [d for d in rec["plan"]
+                  if d["severity"] == "error"]
+        assert not errors, (rec["candidate"], errors)
+
+
+def test_lint_strategy_max_programs_budget_is_loud():
+    """--max-programs N drops compiles but never silently: every
+    skipped program is listed in the report (no-silent-caps)."""
+    lint_strategy = _tool("lint_strategy")
+    n_err, _, results = lint_strategy.lint_zoo(
+        max_programs=0, decode=True, out=lambda *a, **k: None)
+    assert n_err == 0
+    skipped = [r for r in results
+               if r.get("program") == "skipped (--max-programs budget)"]
+    assert skipped, "budget guard left no audit trail"
+
+
+def test_lint_strategy_mutate_mode_in_process():
+    """`--mutate` (plan half): the harness reports one record per
+    mutation and rc 0 exactly when every rule fires.  The compile-heavy
+    program half runs in test_analysis.py over the shared corpus."""
+    from autodist_tpu.analysis.mutations import run_mutations
+
+    results = run_mutations(kinds=["plan"])
+    assert all(r["ok"] for r in results), [
+        r for r in results if not r["ok"]]
+    # the CLI's rc contract over the same records
+    lint_strategy = _tool("lint_strategy")
+    failed, _ = lint_strategy.run_mutation_matrix(
+        out=lambda *a, **k: None)
+    assert failed == 0

@@ -4,54 +4,34 @@ Round-5 VERDICT demanded silicon-free falsifiability: every "we emit
 fewer/better collectives" claim must be checkable without the flaky TPU
 tunnel.  This probe lowers real train-step programs with
 ``jax.jit(...).lower(...).compile()`` on simulated CPU meshes and
-asserts collective *counts and kinds* in the optimized HLO text:
+asserts collective *counts and kinds* in the optimized HLO text.
+
+This module is now a thin back-compat shim: the facts layer lives in
+:mod:`autodist_tpu.analysis.facts`, the memoized program corpus in
+:mod:`autodist_tpu.analysis.programs`, the declarative rules in
+:mod:`autodist_tpu.analysis.program_rules`, and the probes themselves —
+identical names, reports, and pass/fail behavior — in
+:mod:`autodist_tpu.analysis.probes`.  The same engine also powers
+``tools/lint_strategy.py``, which sweeps the ENTIRE AutoStrategy zoo
+(plan lint + program lint) instead of these eight hand-picked programs.
 
 * ``probe_steps_per_loop`` — ``run_steps``'s k-step program is ONE HLO
   module whose scan is a ``while`` loop with the *same* collective
-  counts as the single-step program: k optimizer steps fuse into one
-  dispatch instead of unrolling (or worse, k dispatches).
-* ``probe_single_replica`` — the single-replica allreduce bypass
-  (kernel/lowering.py): a 1-device program contains zero ``all-reduce``
-  ops.
-* ``probe_pipeline_tp`` — the dp×pp×tp composition: at
-  ``tensor_parallel=2`` the pipeline step carries the per-stage
-  ``model``-axis activation all-reduces (Megatron's one-per-block,
-  forward and backward) *on top of* the tp=1 program's collectives, and
-  both carry the ``collective-permute`` stage ring.
+  counts as the single-step program.
+* ``probe_single_replica`` — a 1-device program contains zero
+  cross-device collectives (the allreduce bypass).
+* ``probe_pipeline_tp`` — tensor_parallel=2 adds the per-stage
+  Megatron activation all-reduces on top of the tp=1 program.
 * ``probe_collective_matmul`` — the latency-hiding decomposition
-  (``Pipeline(comm_overlap=...)``): the converted program carries ZERO
-  monolithic model-axis all-reduce (its all-reduce count equals the
-  tp=1 program's — nothing re-fused) while emitting the decomposed
-  forms instead: ≥ tp−1 extra ``collective-permute`` (the chunked
-  collective-matmul ring) plus ``reduce-scatter``/``all-gather`` pairs.
-* ``probe_vocab_parallel`` — vocab parallelism
-  (``Pipeline(vocab_parallel=True)``): the vocab-sharded tp=2 program
-  contains no full-vocab-sized buffer and no vocab-axis all-gather
-  anywhere (distinctive-dimension shape scan), vs. the replicated
-  baseline which carries the ``[V, H]`` table and ``[.., V]`` logits —
-  a silent re-replication of the loss head fails CI on CPU.
-* ``probe_quantized`` — the per-collective precision policy
-  (``Pipeline(collective_precision=...)``): an int8-policy tp=2 program
-  carries the narrowed element type on every policied collective
-  operand (fp16 levels wire on psums, TRUE s8 on gathers, with the
-  convert pairs), un-policied fp32 boundaries stay untouched, the
-  quantized decomposed rs+ag pair stays un-re-fused, and the int8
-  ZeRO-3 gathers narrow per layer.
-* ``probe_decode`` — the serving engine's fused decode step
-  (``autodist_tpu/serving/``): the vocab-parallel tp=2 program carries
-  zero full-vocab buffers, no ``[T, T]`` attention-score square, KV
-  writes via in-place ``dynamic-update-slice`` on donated (aliased)
-  cache buffers with no full-cache copy, and one fused ``while`` loop
-  per K-token window.
-* ``probe_zero3`` — ZeRO-2/3 on the tp×dp mesh
-  (``Pipeline(zero_stage=...)``): the stage-3 program's *step boundary*
-  (the ENTRY signature: donated-in state + returned state) carries ZERO
-  buffers of the distinctive full-parameter extent — parameters live
-  only as flat shards between steps — while emitting >= per-layer
-  all-gathers (one per (virtual stage, leaf); a collective-combiner
-  pass merging them into one bulk materialization, or a re-gather of
-  full storage, fails here); the stage-2 program syncs gradients by
-  reduce-scatter where the stage-0 baseline has none.
+  removes every monolithic model-axis all-reduce without re-fusion.
+* ``probe_vocab_parallel`` — the vocab-sharded program materializes no
+  full-vocab buffer anywhere.
+* ``probe_quantized`` — the per-collective precision policy narrows
+  exactly the policied boundaries' wire dtypes.
+* ``probe_decode`` — the serving decode window is buffer-clean,
+  in-place, and one fused dispatch per K tokens.
+* ``probe_zero3`` — ZeRO-3 stores parameters only as shards across the
+  step boundary, gathering per layer on demand.
 
 Run as a script for a JSON report::
 
@@ -62,10 +42,8 @@ Run as a script for a JSON report::
 from __future__ import annotations
 
 import argparse
-import collections
 import json
 import os
-import re
 import sys
 
 if __name__ == "__main__":  # simulated mesh before the first jax import
@@ -77,742 +55,25 @@ if __name__ == "__main__":  # simulated mesh before the first jax import
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
 
-# HLO spells ops `%name = type all-reduce(...)`; async TPU lowerings
-# split into -start/-done pairs — count the -start as the op.
-_COLLECTIVE_RE = re.compile(
-    r"=\s*(?:\([^)]*\)|\S+)\s*"
-    r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
-    r"all-to-all)(?:-start)?\(")
-
-# Every typed array shape in HLO text: `f32[8,8,93]{2,1,0}` etc.
-_SHAPE_RE = re.compile(
-    r"\b(?:pred|s4|u4|s8|u8|s16|u16|s32|u32|s64|u64|"
-    r"f8\w*|bf16|f16|f32|f64|c64|c128)\[([0-9,]*)\]")
-
-# Same scan keeping the element type — the quantized-collectives probe
-# asserts the *dtype* on the wire, not just the op kind.
-_TYPED_SHAPE_RE = re.compile(
-    r"\b(pred|s4|u4|s8|u8|s16|u16|s32|u32|s64|u64|"
-    r"f8\w*|bf16|f16|f32|f64|c64|c128)\[([0-9,]*)\]")
-
-# Result-type prefix + collective kind: `%x = f16[8]{0} all-reduce(...)`
-# or the tuple/async forms `= (s8[4], s8[4]) all-gather-start(...)`.
-_COLLECTIVE_TYPED_RE = re.compile(
-    r"=\s*(\([^)]*\)|\S+)\s*"
-    r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
-    r"all-to-all)(?:-start)?\(")
-
-# Wire dtypes a narrowed boundary may carry: bf16 casts, f16 int8-level
-# sums, true-s8 gathers (and any future fp8 wire).
-_NARROW_DTYPES = ("bf16", "f16", "s8", "u8", "f8")
-
-
-def collective_counts(hlo_text: str) -> dict[str, int]:
-    """Count collective ops by kind in optimized HLO text."""
-    counts = collections.Counter(_COLLECTIVE_RE.findall(hlo_text))
-    return {k: counts.get(k, 0)
-            for k in ("all-reduce", "all-gather", "reduce-scatter",
-                      "collective-permute", "all-to-all")}
-
-
-def collective_wire(hlo_text: str) -> list[tuple[str, str, int]]:
-    """Every collective op's ``(kind, element_type, result_elements)``
-    from optimized HLO text — the wire-dtype analog of
-    :func:`collective_counts` (async ``-start`` forms count once; for
-    tuple results the widest element drives the entry)."""
-    out = []
-    for m in _COLLECTIVE_TYPED_RE.finditer(hlo_text):
-        prefix, kind = m.group(1), m.group(2)
-        best = None
-        for dt, dims in _TYPED_SHAPE_RE.findall(prefix):
-            elems = 1
-            for d in dims.split(","):
-                if d:
-                    elems *= int(d)
-            if best is None or elems > best[1]:
-                best = (dt, elems)
-        if best is None:
-            best = ("", 0)
-        out.append((kind, best[0], best[1]))
-    return out
-
-
-def narrowed_collective_counts(hlo_text: str) -> dict[str, int]:
-    """Collectives whose wire element type is narrower than fp32, by
-    kind — zero everywhere for an fp32-policy program; the policied
-    boundaries for a narrowed one."""
-    counts: dict[str, int] = {
-        k: 0 for k in ("all-reduce", "all-gather", "reduce-scatter",
-                       "collective-permute", "all-to-all")}
-    for kind, dtype, _ in collective_wire(hlo_text):
-        if any(dtype.startswith(n) for n in _NARROW_DTYPES):
-            counts[kind] += 1
-    return counts
-
-
-def nonscalar_all_reduces(hlo_text: str) -> int:
-    """All-reduce ops with a result of more than one element: the
-    shared-scale pmaxes a quantized boundary adds are scalars, so this
-    count isolates the payload-carrying reductions — a monolithic
-    model-axis all-reduce surviving (or re-fusing after) a decomposition
-    shows up here."""
-    return sum(1 for kind, _, elems in collective_wire(hlo_text)
-               if kind == "all-reduce" and elems > 1)
-
-
-_CONVERT_RE = re.compile(r"=\s*(\w+)\[[0-9,]*\][^ ]*\s*convert\(")
-
-
-def convert_counts(hlo_text: str) -> dict[str, int]:
-    """Count ``convert`` ops by result element type — the
-    convert-before/convert-after halves of a narrowed boundary."""
-    return dict(collections.Counter(_CONVERT_RE.findall(hlo_text)))
-
-
-def buffers_with_dim(hlo_text: str, dim: int) -> int:
-    """Count array shapes carrying ``dim`` in optimized HLO text — the
-    memory-shape analog of :func:`collective_counts`: with a dim chosen
-    to be distinctive (a vocab size no other tensor dimension equals),
-    zero hits proves the program never materializes a buffer of that
-    extent on any device."""
-    hits = 0
-    for m in _SHAPE_RE.finditer(hlo_text):
-        dims = [int(d) for d in m.group(1).split(",") if d]
-        if dim in dims:
-            hits += 1
-    return hits
-
-
-def buffers_with_dim_repeated(hlo_text: str, dim: int,
-                              times: int = 2) -> int:
-    """Count array shapes carrying ``dim`` at least ``times`` times —
-    e.g. a ``[.., T, T]`` attention-score square at a distinctive
-    sequence extent, which a single-token decode step must never
-    build."""
-    hits = 0
-    for m in _SHAPE_RE.finditer(hlo_text):
-        dims = [int(d) for d in m.group(1).split(",") if d]
-        if dims.count(dim) >= times:
-            hits += 1
-    return hits
-
-
-_DUS_RE = re.compile(r"dynamic-update-slice(?:-start)?\(")
-_COPY_RE = re.compile(r"=\s*(?:\([^)]*\)|\S+?\[([0-9,]*)\]\S*)\s*copy\(")
-
-
-def dynamic_update_slices(hlo_text: str) -> int:
-    """Count dynamic-update-slice ops (fused or top-level)."""
-    return len(_DUS_RE.findall(hlo_text))
-
-
-def large_copies_with_dim(hlo_text: str, dim: int, min_volume: int) -> int:
-    """Count ``copy`` ops whose result shape carries ``dim`` AND at
-    least ``min_volume`` elements — the signature of a full-cache
-    round-trip (small layout copies of token-shaped slices pass)."""
-    hits = 0
-    for m in _COPY_RE.finditer(hlo_text):
-        if m.group(1) is None:
-            continue
-        dims = [int(d) for d in m.group(1).split(",") if d]
-        vol = 1
-        for d in dims:
-            vol *= d
-        if dim in dims and vol >= min_volume:
-            hits += 1
-    return hits
-
-
-def entry_signature(hlo_text: str) -> str:
-    """The ENTRY computation's definition line — every array that is
-    live ACROSS the step boundary (donated-in state, fed batch/rng,
-    returned state/metrics) appears in this signature; per-layer
-    gathers and other step-internal temporaries do not."""
-    for line in hlo_text.splitlines():
-        if line.startswith("ENTRY "):
-            return line
-    raise ValueError("no ENTRY computation in HLO text")
-
-
-def compiled_text(jitted, *args) -> str:
-    """Optimized (post-SPMD-partitioning) HLO of one jitted program."""
-    return jitted.lower(*args).compile().as_text()
-
-
-def _tiny_trainable():
-    import jax
-    import jax.numpy as jnp
-    import optax
-
-    from autodist_tpu import Trainable
-
-    params = {"w": jnp.zeros((16, 4), jnp.float32)}
-
-    def loss_fn(p, batch):
-        return jnp.mean((batch["x"] @ p["w"] - batch["y"]) ** 2)
-
-    return Trainable.from_loss_fn(loss_fn, params, optax.sgd(0.1))
-
-
-def _tiny_batch(n: int = 1):
-    import numpy as np
-
-    r = np.random.RandomState(0)
-    return {"x": r.randn(8, 16).astype(np.float32),
-            "y": r.randn(8, 4).astype(np.float32)}
-
-
-def probe_steps_per_loop(k: int = 4) -> dict:
-    """k-step ``run_steps`` program == one module, one loop, the
-    single-step program's collective counts (not k×: the scan body is
-    not unrolled, so steps-per-loop amortizes dispatch, not compute)."""
-    import jax
-    from jax import lax
-
-    from autodist_tpu import AllReduce, AutoDist, stack_steps
-
-    spec = {"topology": {"platform": "cpu", "num_devices": 2}}
-    runner = AutoDist(spec, AllReduce()).build(_tiny_trainable())
-    try:
-        step_fn = runner.lowered.step_fn
-
-        def scanned(state, batches, rngs):
-            def body(s, xs):
-                b, r = xs
-                return step_fn(s, b, r)
-            return lax.scan(body, state, (batches, rngs))
-
-        stacked = runner.place_steps(stack_steps(
-            [_tiny_batch() for _ in range(k)]))
-        rngs = jax.random.split(jax.random.PRNGKey(0), k)
-        text_k = compiled_text(jax.jit(scanned), runner.state, stacked,
-                               rngs)
-        text_1 = compiled_text(step_fn, runner.state,
-                               runner._place_batch(_tiny_batch()),
-                               jax.random.PRNGKey(0))
-    finally:
-        runner.close()
-    counts_k, counts_1 = collective_counts(text_k), collective_counts(text_1)
-    has_loop = " while(" in text_k or "while (" in text_k
-    assert has_loop, "k-step program lowered without a fused loop"
-    assert counts_k == counts_1, (
-        f"k-step program changed per-kind collective counts: one step "
-        f"{counts_1} vs {k} steps {counts_k} — the scan unrolled")
-    return {"k": k, "fused_loop": has_loop,
-            "collectives_one_step": counts_1,
-            "collectives_k_steps": counts_k}
-
-
-def probe_single_replica() -> dict:
-    """1-device program: the allreduce bypass emits ZERO all-reduce ops
-    (and no other cross-device collective either)."""
-    import jax
-
-    from autodist_tpu import AllReduce, AutoDist
-
-    spec = {"topology": {"platform": "cpu", "num_devices": 1}}
-    runner = AutoDist(spec, AllReduce()).build(_tiny_trainable())
-    try:
-        text = compiled_text(runner.lowered.step_fn, runner.state,
-                             runner._place_batch(_tiny_batch()),
-                             jax.random.PRNGKey(0))
-    finally:
-        runner.close()
-    counts = collective_counts(text)
-    assert counts["all-reduce"] == 0, (
-        f"single-replica step still carries {counts['all-reduce']} "
-        "all-reduce op(s)")
-    assert sum(counts.values()) == 0, (
-        f"single-replica step carries cross-device collectives: {counts}")
-    return {"collectives": counts}
-
-
-def _pipeline_runner(tensor_parallel: int, comm_overlap=None,
-                     vocab_parallel: bool = False, vocab_size: int = 32,
-                     collective_precision=None):
-    import jax
-    import jax.numpy as jnp
-    import optax
-
-    from autodist_tpu import AutoDist
-    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
-    from autodist_tpu.models.transformer import TransformerConfig
-
-    cfg = TransformerConfig(vocab_size=vocab_size, hidden_size=16,
-                            num_layers=2,
-                            num_heads=2, mlp_dim=32, max_len=8,
-                            dtype=jnp.float32, dropout_rate=0.0,
-                            attention_dropout_rate=0.0)
-    mesh = {"data": 2, "pipe": 2, "model": 2} if tensor_parallel > 1 \
-        else {"data": 4, "pipe": 2}
-    spec = {"topology": {"platform": "cpu", "num_devices": 8},
-            "mesh": mesh}
-    trainable = make_pipeline_lm_trainable(cfg, optax.sgd(0.05),
-                                           jax.random.PRNGKey(0))
-    # Hashable policy form (lru_cache): a ("slot", "prec") tuple-of-
-    # pairs stands in for the per-boundary dict.
-    if isinstance(collective_precision, tuple):
-        collective_precision = dict(collective_precision)
-    return AutoDist(spec, "Pipeline", num_microbatches=2,
-                    tensor_parallel=tensor_parallel,
-                    comm_overlap=comm_overlap,
-                    vocab_parallel=vocab_parallel,
-                    collective_precision=collective_precision
-                    ).build(trainable)
-
-
-import functools
-
-
-@functools.lru_cache(maxsize=None)
-def _pipeline_step_text(tensor_parallel: int, comm_overlap=None,
-                        vocab_parallel: bool = False,
-                        vocab_size: int = 32,
-                        collective_precision=None) -> str:
-    """Optimized HLO of one pipeline train step (memoized: the tp=1 and
-    blocking tp=2 programs serve both probe_pipeline_tp and
-    probe_collective_matmul — each 8-device compile costs tens of
-    seconds, and the bench embeds an all-probes run under a budget)."""
-    import jax
-    import numpy as np
-
-    r = np.random.RandomState(0)
-    batch = {"x": r.randint(0, vocab_size, (8, 8)).astype(np.int32),
-             "y": r.randint(0, vocab_size, (8, 8)).astype(np.int32)}
-    runner = _pipeline_runner(tensor_parallel, comm_overlap,
-                              vocab_parallel, vocab_size,
-                              collective_precision)
-    try:
-        return compiled_text(runner.lowered.step_fn, runner.state,
-                             runner._place_batch(batch),
-                             jax.random.PRNGKey(0))
-    finally:
-        runner.close()
-
-
-def probe_pipeline_tp() -> dict:
-    """tensor_parallel=2 pipeline step: the stage ring's
-    collective-permute is present, and the model-axis activation
-    all-reduces appear on top of the tp=1 program's count — at least 4
-    more (out-proj + wo forward psums, their custom-VJP backward psums),
-    emitted once in the tick-scan body."""
-    c1 = collective_counts(_pipeline_step_text(1))
-    c2 = collective_counts(_pipeline_step_text(2))
-    assert c1["collective-permute"] > 0 and c2["collective-permute"] > 0, (
-        f"pipeline ring missing: tp1 {c1} tp2 {c2}")
-    extra = c2["all-reduce"] - c1["all-reduce"]
-    assert extra >= 4, (
-        f"tensor_parallel=2 added only {extra} all-reduce op(s) over "
-        f"tp=1 ({c1['all-reduce']} -> {c2['all-reduce']}); expected the "
-        "per-stage Megatron activation all-reduces (>= 4)")
-    return {"collectives_tp1": c1, "collectives_tp2": c2,
-            "model_axis_all_reduces": extra}
-
-
-def probe_collective_matmul() -> dict:
-    """The latency-hiding decomposition (``Pipeline(comm_overlap=...)``)
-    at tp=2, against two baselines: the blocking tp=2 program (whose
-    model-axis all-reduces must vanish) and the tp=1 program (whose
-    all-reduce count the converted program must *equal* — any excess is
-    a monolithic model-axis all-reduce that survived or re-fused, any
-    shortfall means data/pipe sync went missing).  The ``"matmul"``
-    mode must add ≥ tp−1 collective-permute over blocking tp=2 (the
-    chunked ring); both modes must emit reduce-scatter + all-gather
-    (the decomposed boundary reductions)."""
-    tp = 2
-    c1 = collective_counts(_pipeline_step_text(1))
-    c_blk = collective_counts(_pipeline_step_text(tp))
-    report = {"collectives_tp1": c1, "collectives_tp2_blocking": c_blk}
-    for mode in ("rsag", "matmul"):
-        c = collective_counts(_pipeline_step_text(tp, comm_overlap=mode))
-        report[f"collectives_tp2_{mode}"] = c
-        assert c["all-reduce"] == c1["all-reduce"], (
-            f"comm_overlap={mode!r}: converted tp={tp} program carries "
-            f"{c['all-reduce']} all-reduce op(s) vs the tp=1 baseline's "
-            f"{c1['all-reduce']} — a monolithic model-axis all-reduce "
-            "survived the decomposition (or XLA re-fused the rs+ag pair)")
-        assert c["reduce-scatter"] >= 1 and c["all-gather"] >= 1, (
-            f"comm_overlap={mode!r}: expected decomposed reduce-scatter/"
-            f"all-gather pairs in the converted program, got {c}")
-        if mode == "matmul":
-            ring_extra = c["collective-permute"] - c_blk["collective-permute"]
-            assert ring_extra >= tp - 1, (
-                f"collective-matmul ring missing: only {ring_extra} "
-                f"collective-permute op(s) over the blocking tp={tp} "
-                f"program (expected >= {tp - 1})")
-            report["ring_collective_permutes"] = ring_extra
-    report["model_axis_all_reduces_removed"] = (
-        c_blk["all-reduce"] - c1["all-reduce"])
-    return report
-
-
-def probe_vocab_parallel() -> dict:
-    """Vocab parallelism (``Pipeline(vocab_parallel=True)``), the memory
-    claim, structurally: at tp=2 the vocab-sharded program's loss head
-    never materializes a full-vocab buffer — no array shape in the whole
-    optimized per-device module carries the vocab extent V (or its
-    zero-padded V_pad; that also rules out a vocab-axis all-gather,
-    whose result would be V-sized) — while the replicated tp=2 baseline
-    carries the ``[V, H]`` table and ``[.., V]`` logits.  V is chosen so
-    no other tensor dimension collides with it (93: odd, so the
-    non-divisible zero-pad path compiles too; V_pad=94, shard=47)."""
-    V = 93
-    V_pad = V + (-V) % 2
-    base = collective_counts(_pipeline_step_text(2, vocab_size=V))
-    base_full = buffers_with_dim(_pipeline_step_text(2, vocab_size=V), V)
-    vp_text = _pipeline_step_text(2, vocab_parallel=True, vocab_size=V)
-    vp = collective_counts(vp_text)
-    assert base_full > 0, (
-        "replicated baseline shows no full-vocab buffer — the probe's "
-        "distinctive-dim scan is broken, not proving anything")
-    leaks = buffers_with_dim(vp_text, V) + buffers_with_dim(vp_text, V_pad)
-    assert leaks == 0, (
-        f"vocab-parallel tp=2 program materializes {leaks} full-vocab-"
-        f"sized buffer(s) (dim {V}/{V_pad}) — the loss head re-replicated "
-        "(or a vocab-axis all-gather assembled the full logits)")
-    assert vp["collective-permute"] > 0, (
-        f"pipeline ring missing from the vocab-parallel program: {vp}")
-    return {"vocab_size": V, "padded_vocab": V_pad,
-            "baseline_full_vocab_buffers": base_full,
-            "vocab_parallel_full_vocab_buffers": leaks,
-            "collectives_baseline": base,
-            "collectives_vocab_parallel": vp}
-
-
-# Distinctive dim of the probe's non-tp stage matrices: no activation,
-# batch, or other parameter carries it, so a hit in the ENTRY signature
-# IS a full parameter living across the step boundary.
-_Z3_DIM = 29
-_Z3_V = 2          # virtual stages = per-device layers
-_Z3_LEAVES = 3     # ZeRO-3 stage leaves: mix_in, mix_out, wo/bias
-
-
-def _zero_runner(zero_stage: int, collective_precision=None):
-    """dp×pp×tp pipeline (mesh {data:2, pipe:2, model:2}, V=2) whose
-    stage has Megatron wi/wo (tp-sharded; their ZeRO requests degrade,
-    state shards with the parameter) plus a non-tp ``mix`` pair carrying
-    the distinctive :data:`_Z3_DIM` — the variables the ZeRO stage
-    actually moves."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
-
-    from autodist_tpu import AutoDist, PipelineTrainable
-    from autodist_tpu.parallel.tensor import column_parallel, row_parallel
-
-    HID, FF, C = 8, 16, 4
-    r = np.random.RandomState(0)
-    stacked = {
-        "wi": {"kernel": jnp.asarray(r.randn(C, HID, FF) * 0.3,
-                                     jnp.float32),
-               "bias": jnp.zeros((C, FF), jnp.float32)},
-        "wo": {"kernel": jnp.asarray(r.randn(C, FF, HID) * 0.3,
-                                     jnp.float32),
-               "bias": jnp.zeros((C, HID), jnp.float32)},
-        "mix_in": jnp.asarray(r.randn(C, HID, _Z3_DIM) * 0.3, jnp.float32),
-        "mix_out": jnp.asarray(r.randn(C, _Z3_DIM, HID) * 0.3, jnp.float32),
-    }
-
-    def stage_fn(p, x, model_axis=None, comm_overlap=None):
-        h = jax.nn.relu(column_parallel(x, p["wi"]["kernel"],
-                                        p["wi"]["bias"],
-                                        model_axis=model_axis))
-        y = row_parallel(h, p["wo"]["kernel"], p["wo"]["bias"],
-                         model_axis=model_axis)
-        return y + jnp.tanh(y @ p["mix_in"]) @ p["mix_out"]
-
-    def head(outputs, batch):
-        return jnp.mean((outputs - batch["y"]) ** 2), {}
-
-    trainable = PipelineTrainable(stage_fn, stacked, head, optax.adam(1e-2),
-                                  num_stages=C)
-    spec = {"topology": {"platform": "cpu", "num_devices": 8},
-            "mesh": {"data": 2, "pipe": 2, "model": 2}}
-    if isinstance(collective_precision, tuple):
-        collective_precision = dict(collective_precision)
-    return AutoDist(spec, "Pipeline", num_microbatches=2,
-                    virtual_stages=_Z3_V, tensor_parallel=2,
-                    zero_stage=zero_stage,
-                    collective_precision=collective_precision
-                    ).build(trainable)
-
-
-@functools.lru_cache(maxsize=None)
-def _zero_step_text(zero_stage: int, collective_precision=None) -> str:
-    import jax
-    import numpy as np
-
-    r = np.random.RandomState(0)
-    batch = {"x": r.randn(8, 8).astype(np.float32),
-             "y": r.randn(8, 8).astype(np.float32)}
-    runner = _zero_runner(zero_stage, collective_precision)
-    try:
-        return compiled_text(runner.lowered.step_fn, runner.state,
-                             runner._place_batch(batch),
-                             jax.random.PRNGKey(0))
-    finally:
-        runner.close()
-
-
-def probe_zero3() -> dict:
-    """ZeRO-2/3 on the tp×dp pipeline, structurally: the stage-3
-    program stores parameters ONLY as flat shards across the step
-    boundary (zero ENTRY-signature buffers of the distinctive extent,
-    vs. the stage-0 baseline whose state carries them — a re-gather of
-    full storage, or a re-materialization surviving into the returned
-    state, fails here) while emitting >= one all-gather per (layer,
-    leaf) — the per-layer on-demand gathers; a combiner pass collapsing
-    them into one bulk up-front gather drops the count below
-    layers x leaves and fails.  Stage 2 syncs gradients by
-    reduce-scatter where the stage-0 baseline emits none."""
-    t0 = _zero_step_text(0)
-    t2 = _zero_step_text(2)
-    t3 = _zero_step_text(3)
-    c0, c2, c3 = map(collective_counts, (t0, t2, t3))
-    boundary0 = buffers_with_dim(entry_signature(t0), _Z3_DIM)
-    boundary3 = buffers_with_dim(entry_signature(t3), _Z3_DIM)
-    assert boundary0 > 0, (
-        "stage-0 baseline shows no full-parameter buffer at the step "
-        "boundary — the probe's distinctive-dim scan is broken, not "
-        "proving anything")
-    assert boundary3 == 0, (
-        f"stage-3 program carries {boundary3} full-parameter buffer(s) "
-        f"(dim {_Z3_DIM}) across the step boundary — parameters must "
-        "live only as ZeRO shards between steps")
-    min_gathers = _Z3_V * _Z3_LEAVES
-    assert c3["all-gather"] >= min_gathers, (
-        f"stage-3 program emits {c3['all-gather']} all-gather(s); "
-        f"expected >= {min_gathers} (one per (virtual stage, leaf)) — "
-        "the per-layer gathers collapsed into a bulk materialization")
-    assert c3["reduce-scatter"] >= 1, (
-        f"stage-3 program emits no reduce-scatter: {c3} — the gather's "
-        "custom VJP should scatter gradients into shard form")
-    assert c0["reduce-scatter"] == 0, (
-        f"stage-0 baseline unexpectedly reduce-scatters: {c0}")
-    assert c2["reduce-scatter"] >= 1, (
-        f"stage-2 program syncs gradients without a reduce-scatter: "
-        f"{c2} — the ZeRO grad sync regressed to an all-reduce")
-    return {"distinctive_dim": _Z3_DIM,
-            "boundary_full_param_buffers_stage0": boundary0,
-            "boundary_full_param_buffers_stage3": boundary3,
-            "min_per_layer_gathers": min_gathers,
-            "collectives_stage0": c0,
-            "collectives_stage2": c2,
-            "collectives_stage3": c3}
-
-
-# Decode-probe geometry: T (cache max_len) and V (vocab) are chosen
-# distinctive — no other tensor dimension equals either, so a shape scan
-# hit IS the buffer the claim forbids.
-_DEC_T = 57
-_DEC_V = 93
-_DEC_LAYERS = 2
-_DEC_SLOTS = 3
-
-
-@functools.lru_cache(maxsize=None)
-def _decode_step_text(tensor_parallel: int, vocab_parallel: bool) -> str:
-    """Optimized HLO of one fused-decode dispatch of the serving
-    engine (memoized like the pipeline texts)."""
-    import jax
-    import jax.numpy as jnp
-    import optax
-
-    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
-    from autodist_tpu.models.transformer import TransformerConfig
-    from autodist_tpu.serving import ServingEngine
-
-    cfg = TransformerConfig(vocab_size=_DEC_V, hidden_size=16,
-                            num_layers=_DEC_LAYERS, num_heads=2,
-                            mlp_dim=32, max_len=_DEC_T, dtype=jnp.float32,
-                            dropout_rate=0.0, attention_dropout_rate=0.0)
-    params = make_pipeline_lm_trainable(
-        cfg, optax.sgd(0.1), jax.random.PRNGKey(0)).params
-    engine = ServingEngine(cfg, params, tensor_parallel=tensor_parallel,
-                           vocab_parallel=vocab_parallel,
-                           num_slots=_DEC_SLOTS, max_len=_DEC_T,
-                           prefill_len=8, decode_steps=4)
-    return engine.compiled_decode_text()
-
-
-def probe_decode() -> dict:
-    """The serving engine's decode-step memory/dispatch claims,
-    structurally: the vocab-parallel tp=2 program carries ZERO
-    full-vocab buffers (vs the tp=1 baseline, which carries the ``[V,H]``
-    table and ``[B,V]`` logits — the scan-validity control); neither
-    program builds a ``[T, T]`` attention-score square (decode scores
-    live at ``[B, heads, 1, T]``); the KV cache updates via in-place
-    ``dynamic-update-slice`` (>= 2 per layer: k and v) with the cache
-    buffers donated/aliased and no full-cache-sized copy anywhere; and
-    the K-token window is ONE module with a fused ``while`` loop — one
-    dispatch per K tokens, the ``run_steps`` property at decode time."""
-    tp = 2
-    base = _decode_step_text(1, False)
-    vp = _decode_step_text(tp, True)
-    V_pad = _DEC_V + (-_DEC_V) % tp
-    base_full = buffers_with_dim(base, _DEC_V)
-    assert base_full > 0, (
-        "tp=1 baseline decode shows no full-vocab buffer — the probe's "
-        "distinctive-dim scan is broken, not proving anything")
-    leaks = buffers_with_dim(vp, _DEC_V) + buffers_with_dim(vp, V_pad)
-    assert leaks == 0, (
-        f"vocab-parallel decode materializes {leaks} full-vocab-sized "
-        f"buffer(s) (dim {_DEC_V}/{V_pad}) — the greedy epilogue "
-        "re-replicated (or a vocab-axis all-gather assembled the logits)")
-    report = {"vocab_size": _DEC_V, "max_len": _DEC_T,
-              "baseline_full_vocab_buffers": base_full,
-              "vocab_parallel_full_vocab_buffers": leaks}
-    # one layer's cache lane [slots, heads_local, T, head_dim] is the
-    # smallest buffer a "full-cache copy" could round-trip
-    cfg_head_dim = 8
-    for name, text, heads_local in (("tp1", base, 2), ("vp", vp, 1)):
-        squares = buffers_with_dim_repeated(text, _DEC_T)
-        assert squares == 0, (
-            f"{name} decode builds {squares} [{_DEC_T}, {_DEC_T}]-extent "
-            "buffer(s) — a full-sequence attention-score square in a "
-            "single-token step")
-        dus = dynamic_update_slices(text)
-        assert dus >= 2 * _DEC_LAYERS, (
-            f"{name} decode emits only {dus} dynamic-update-slice(s); "
-            f"expected >= {2 * _DEC_LAYERS} (k and v per layer) — the "
-            "KV write lowered to something else (scatter/concat)")
-        lane_n = _DEC_SLOTS * heads_local * _DEC_T * cfg_head_dim
-        cache_copies = large_copies_with_dim(text, _DEC_T, lane_n)
-        assert cache_copies == 0, (
-            f"{name} decode copies {cache_copies} cache-lane-sized "
-            f"buffer(s) per dispatch — the in-place update regressed "
-            "to copy-on-write")
-        assert " while(" in text or "while (" in text, (
-            f"{name} decode lowered without a fused loop — K token "
-            "steps are dispatching separately")
-        assert "input_output_alias" in text, (
-            f"{name} decode carries no input/output aliasing — the "
-            "donated KV cache is being re-allocated every dispatch")
-        report[f"dynamic_update_slices_{name}"] = dus
-        report[f"collectives_{name}"] = collective_counts(text)
-    assert report["collectives_vp"]["all-reduce"] >= 2 * _DEC_LAYERS, (
-        "vocab-parallel tp=2 decode misses the per-layer Megatron "
-        f"boundary all-reduces: {report['collectives_vp']}")
-    assert sum(report["collectives_tp1"].values()) == 0, (
-        f"tp=1 decode carries collectives: {report['collectives_tp1']}")
-    return report
-
-
-def probe_quantized() -> dict:
-    """The per-collective precision policy, structurally: quantization
-    happens *inside* the program — convert-before, narrowed collective
-    operand dtype, convert-after — exactly at the policied boundaries.
-
-    * fp32 policy (the default) carries ZERO narrowed collectives — a
-      lowering that silently narrows an un-policied boundary fails.
-    * ``tp_psum=int8`` at blocking tp=2 carries >= 4 narrowed
-      all-reduces (the Megatron out/wo forward psums and qkv/wi backward
-      cotangent psums, on an fp16 levels wire) with the matching
-      f16-in/f32-out convert pairs — while the dp grad sync, NOT
-      policied in this program, keeps its payload-carrying fp32
-      all-reduces (narrowing is per-boundary, not per-program).
-    * ``tp_psum=int8`` + ``comm_overlap=rsag``: the decomposed pair
-      stays un-re-fused (payload-carrying all-reduce count equals the
-      tp=1 baseline's — the shared-scale pmaxes a quantized boundary
-      adds are scalar and counted separately) and both halves narrow:
-      the rs sums int8 levels on fp16, the ag rides a TRUE s8 wire.
-    * full ``int8`` policy at zero_stage=3: the per-layer on-demand
-      gathers carry narrowed payloads (>= one per (virtual stage,
-      leaf)) and the backward cotangent reduce-scatter narrows too.
-    """
-    tp = 2
-    fp32_text = _pipeline_step_text(tp)
-    n_fp32 = narrowed_collective_counts(fp32_text)
-    assert sum(n_fp32.values()) == 0, (
-        f"fp32-policy tp={tp} program carries narrowed collectives: "
-        f"{n_fp32} — an un-policied boundary silently narrowed")
-
-    tp_only = (("tp_psum", "int8"),)
-    q_text = _pipeline_step_text(tp, collective_precision=tp_only)
-    n_q = narrowed_collective_counts(q_text)
-    assert n_q["all-reduce"] >= 4, (
-        f"tp_psum=int8 narrowed only {n_q['all-reduce']} all-reduce "
-        "op(s); expected >= 4 (out/wo forward + qkv/wi backward psums "
-        "on the fp16 levels wire)")
-    conv = convert_counts(q_text)
-    assert conv.get("f16", 0) >= n_q["all-reduce"], (
-        f"missing convert-before halves: {conv} vs {n_q['all-reduce']} "
-        "narrowed all-reduces")
-    assert conv.get("f32", 0) >= 1, (
-        f"missing convert-after halves (back to f32): {conv}")
-    big_f32_ars = sum(1 for kind, dt, elems in collective_wire(q_text)
-                      if kind == "all-reduce" and dt == "f32"
-                      and elems > 1)
-    assert big_f32_ars >= 1, (
-        "tp_psum-only int8 policy narrowed the (un-policied) dp grad "
-        "sync too — fp32 boundaries must stay untouched")
-
-    c1_payload = nonscalar_all_reduces(_pipeline_step_text(1))
-    rsag_text = _pipeline_step_text(tp, comm_overlap="rsag",
-                                    collective_precision=tp_only)
-    n_rsag = narrowed_collective_counts(rsag_text)
-    rsag_payload = nonscalar_all_reduces(rsag_text)
-    assert rsag_payload == c1_payload, (
-        f"quantized rs+ag program carries {rsag_payload} payload "
-        f"all-reduce(s) vs the tp=1 baseline's {c1_payload} — a "
-        "monolithic model-axis all-reduce survived or the pair re-fused")
-    assert n_rsag["reduce-scatter"] >= 1, (
-        f"no narrowed reduce-scatter in the quantized rs+ag program: "
-        f"{n_rsag}")
-    assert n_rsag["all-gather"] >= 1, (
-        f"no narrowed all-gather in the quantized rs+ag program: "
-        f"{n_rsag}")
-    s8_ags = sum(1 for kind, dt, _ in collective_wire(rsag_text)
-                 if kind == "all-gather" and dt == "s8")
-    assert s8_ags >= 1, (
-        "the ag half of the quantized pair is not on a true s8 wire")
-
-    z3_text = _zero_step_text(3, "int8")
-    n_z3 = narrowed_collective_counts(z3_text)
-    min_gathers = _Z3_V * _Z3_LEAVES
-    assert n_z3["all-gather"] >= min_gathers, (
-        f"int8 zero_stage=3 program narrows only {n_z3['all-gather']} "
-        f"all-gather(s); expected >= {min_gathers} (one per (virtual "
-        "stage, leaf))")
-    assert n_z3["reduce-scatter"] >= 1, (
-        f"int8 zero3 backward cotangent reduce-scatter not narrowed: "
-        f"{n_z3}")
-    return {"narrowed_fp32_policy": n_fp32,
-            "narrowed_tp_psum_int8": n_q,
-            "converts_tp_psum_int8": {k: conv[k] for k in ("f16", "f32")
-                                      if k in conv},
-            "payload_f32_all_reduces_tp_psum_int8": big_f32_ars,
-            "payload_all_reduces_tp1": c1_payload,
-            "payload_all_reduces_rsag_int8": rsag_payload,
-            "narrowed_rsag_int8": n_rsag,
-            "s8_all_gathers_rsag_int8": s8_ags,
-            "narrowed_zero3_int8": n_z3,
-            "min_per_layer_gathers": min_gathers}
-
-
-PROBES = {
-    "steps_per_loop": probe_steps_per_loop,
-    "single_replica": probe_single_replica,
-    "pipeline_tp": probe_pipeline_tp,
-    "collective_matmul": probe_collective_matmul,
-    "vocab_parallel": probe_vocab_parallel,
-    "zero3": probe_zero3,
-    "quantized": probe_quantized,
-    "decode": probe_decode,
-}
-
-
-def run_probes(names=None) -> tuple[dict, list]:
-    """Run the named probes (default all); returns (report, failed)."""
-    report, failed = {}, []
-    for name in (names or list(PROBES)):
-        try:
-            report[name] = {"ok": True, **PROBES[name]()}
-        except AssertionError as e:
-            report[name] = {"ok": False, "error": str(e)}
-            failed.append(name)
-    return report, failed
+from autodist_tpu.analysis.facts import (buffers_with_dim,  # noqa: E402,F401
+                                         buffers_with_dim_repeated,
+                                         collective_counts,
+                                         collective_wire, compiled_text,
+                                         convert_counts,
+                                         dynamic_update_slices,
+                                         entry_signature,
+                                         large_copies_with_dim,
+                                         narrowed_collective_counts,
+                                         nonscalar_all_reduces)
+from autodist_tpu.analysis.probes import (PROBES,  # noqa: E402,F401
+                                          probe_collective_matmul,
+                                          probe_decode,
+                                          probe_pipeline_tp,
+                                          probe_quantized,
+                                          probe_single_replica,
+                                          probe_steps_per_loop,
+                                          probe_vocab_parallel,
+                                          probe_zero3, run_probes)
 
 
 def main(argv=None) -> int:

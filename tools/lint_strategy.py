@@ -1,0 +1,308 @@
+"""Sweep the AutoStrategy zoo (and serialized plans) through the linter.
+
+Three modes, composable::
+
+    # every AutoStrategy candidate x {train, decode}: plan lint, then
+    # lower + compile on the simulated CPU mesh and program-lint the
+    # optimized HLO — fails (rc 1) on any ADT ERROR
+    JAX_PLATFORMS=cpu python tools/lint_strategy.py --zoo
+
+    # the mutation-test harness: prove every shipped rule fires on its
+    # seeded violation (and stays silent on the honest artifact)
+    JAX_PLATFORMS=cpu python tools/lint_strategy.py --mutate
+
+    # plan-lint serialized strategy JSON files (hand-edited plans)
+    python tools/lint_strategy.py /path/to/strategy.json
+
+``--check`` is the CI spelling (compact output, same rc contract);
+``--plan-only`` skips the program compiles; ``--max-programs N`` is the
+CI budget guard — plan lint still covers every candidate, and every
+program the cap drops is listed (no silent truncation).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+if __name__ == "__main__":  # simulated mesh before the first jax import
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flag = "--xla_force_host_platform_device_count=8"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+# Distinctive vocab for the zoo's LM fixture: the program-lint
+# full-vocab rule needs an extent no other tensor dimension equals
+# (odd, so the zero-pad path compiles too).
+ZOO_VOCAB = 93
+
+
+def _zoo_fixtures():
+    """The trainable/topology pairs the candidate zoo builds against:
+    the tiny data-parallel trainable (AllReduce/PS/ZeRO/gspmd families)
+    and the stage-structured pipeline LM on the 3-axis mesh (every
+    Pipeline variant).  Yields ``(fixture_name, trainable, spec,
+    batch)``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from autodist_tpu.analysis import programs
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+    from autodist_tpu.resource import ResourceSpec
+
+    yield ("generic",
+           programs.tiny_trainable(),
+           ResourceSpec({"topology": {"platform": "cpu",
+                                      "num_devices": 8}}),
+           programs.tiny_batch())
+
+    cfg = TransformerConfig(vocab_size=ZOO_VOCAB, hidden_size=16,
+                            num_layers=2, num_heads=2, mlp_dim=32,
+                            max_len=8, dtype=jnp.float32,
+                            dropout_rate=0.0,
+                            attention_dropout_rate=0.0)
+    r = np.random.RandomState(0)
+    lm_batch = {
+        "x": r.randint(0, ZOO_VOCAB, (8, 8)).astype(np.int32),
+        "y": r.randint(0, ZOO_VOCAB, (8, 8)).astype(np.int32)}
+    yield ("pipeline_lm",
+           make_pipeline_lm_trainable(cfg, optax.sgd(0.05),
+                                      jax.random.PRNGKey(0)),
+           ResourceSpec({"topology": {"platform": "cpu",
+                                      "num_devices": 8},
+                         "mesh": {"data": 2, "pipe": 2, "model": 2}}),
+           lm_batch)
+
+
+def iter_zoo_strategies():
+    """Build every :func:`default_candidates` builder against every
+    fixture it fits; yields ``(name, strategy, spec, trainable, batch)``
+    — byte-identical strategies deduped like AutoStrategy's own loop."""
+    from autodist_tpu.simulator.auto_strategy import default_candidates
+
+    seen_content = set()
+    for fixture, trainable, spec, batch in _zoo_fixtures():
+        seen_names: dict[str, int] = {}
+        for builder in default_candidates():
+            name = type(builder).__name__
+            seen_names[name] = seen_names.get(name, 0) + 1
+            if seen_names[name] > 1:
+                name = f"{name}#{seen_names[name]}"
+            try:
+                strategy = builder.build(trainable, spec)
+            except ValueError:
+                continue   # candidate does not fit this fixture
+            # A stage-structured trainable lowers through the pipeline
+            # backend only (AutoStrategy scores the others but they
+            # cannot lower it); the generic trainable exercises the
+            # collective/gspmd families.
+            is_pipeline = strategy.graph_config.lowering == "pipeline"
+            if is_pipeline != (fixture == "pipeline_lm"):
+                continue
+            content = json.dumps(
+                [n.to_dict() for n in strategy.node_configs]
+                + [strategy.graph_config.to_dict()], sort_keys=True)
+            if content in seen_content:
+                continue
+            seen_content.add(content)
+            yield f"{fixture}/{name}", strategy, spec, trainable, batch
+
+
+def _train_program_text(strategy, spec, trainable, batch) -> str:
+    """Lower + compile one zoo candidate's train step on the CPU mesh."""
+    import jax
+
+    from autodist_tpu.analysis.facts import compiled_text
+    from autodist_tpu.autodist import AutoDist
+
+    runner = AutoDist(spec, "AllReduce").build(trainable, strategy)
+    try:
+        return compiled_text(runner.lowered.step_fn, runner.state,
+                             runner._place_batch(batch),
+                             jax.random.PRNGKey(0))
+    finally:
+        runner.close()
+
+
+def lint_zoo(max_programs=None, plan_only=False, decode=True,
+             out=print) -> tuple[int, int, list]:
+    """Sweep the zoo; returns ``(n_errors, n_warnings, results)``."""
+    from autodist_tpu.analysis import (lint_plan, lint_program,
+                                       rules_for_decode,
+                                       rules_for_strategy)
+    from autodist_tpu.analysis import programs
+
+    results = []
+    n_err = n_warn = 0
+    compiled = 0
+    candidates = list(iter_zoo_strategies())
+    for name, strategy, spec, trainable, batch in candidates:
+        rec = {"candidate": name, "lowering":
+               strategy.graph_config.lowering}
+        plan = lint_plan(strategy, resource_spec=spec,
+                         trainable=trainable)
+        rec["plan"] = [d.to_dict() for d in plan]
+        n_err += len(plan.errors)
+        n_warn += len(plan.warnings)
+        if not plan_only:
+            if max_programs is not None and compiled >= max_programs:
+                rec["program"] = "skipped (--max-programs budget)"
+                out(f"{name}: program lint SKIPPED "
+                    "(--max-programs budget)")
+            else:
+                compiled += 1
+                try:
+                    text = _train_program_text(strategy, spec,
+                                               trainable, batch)
+                except Exception as e:   # a candidate that cannot lower
+                    n_err += 1
+                    rec["program_error"] = f"{type(e).__name__}: {e}"
+                    out(f"{name}: FAILED to lower/compile — {e}")
+                    results.append(rec)
+                    continue
+                vocab = ZOO_VOCAB if "pipeline_lm" in name else None
+                rules = rules_for_strategy(strategy, vocab_size=vocab)
+                prog = lint_program(text, rules, where=name)
+                rec["program"] = [d.to_dict() for d in prog]
+                rec["program_rules"] = [r.name for r in rules]
+                n_err += len(prog.errors)
+                n_warn += len(prog.warnings)
+        status = []
+        if plan.errors or rec.get("program_error"):
+            status.append("ERRORS")
+        out(f"{name}: plan {len(plan.errors)}E/{len(plan.warnings)}W"
+            + ("" if plan_only or "program" not in rec
+               or isinstance(rec.get("program"), str)
+               else f", program {len([d for d in rec['program'] if d['severity'] == 'error'])}E"
+                    f" ({len(rec.get('program_rules', []))} rules)")
+            + (" " + " ".join(status) if status else ""))
+        results.append(rec)
+
+    if decode and not plan_only:
+        for tp, vocab_parallel in ((1, False), (2, False), (2, True)):
+            name = f"decode/tp{tp}" + ("+vocab" if vocab_parallel else "")
+            if max_programs is not None and compiled >= max_programs:
+                out(f"{name}: SKIPPED (--max-programs budget)")
+                results.append({"candidate": name,
+                                "program": "skipped (--max-programs "
+                                           "budget)"})
+                continue
+            compiled += 1
+            text = programs.decode_step_text(tp, vocab_parallel)
+            rules = rules_for_decode(
+                tp, vocab_parallel, vocab_size=programs.DEC_V,
+                max_len=programs.DEC_T,
+                num_layers=programs.DEC_LAYERS,
+                num_slots=programs.DEC_SLOTS,
+                heads_local=max(2 // tp, 1),
+                head_dim=programs.DEC_HEAD_DIM)
+            prog = lint_program(text, rules, where=name)
+            n_err += len(prog.errors)
+            n_warn += len(prog.warnings)
+            out(f"{name}: program {len(prog.errors)}E/"
+                f"{len(prog.warnings)}W ({len(rules)} rules)")
+            results.append({"candidate": name,
+                            "program": [d.to_dict() for d in prog],
+                            "program_rules": [r.name for r in rules]})
+    return n_err, n_warn, results
+
+
+def run_mutation_matrix(out=print) -> tuple[int, list]:
+    from autodist_tpu.analysis.mutations import run_mutations
+
+    results = run_mutations()
+    failed = 0
+    for rec in results:
+        if rec["ok"]:
+            out(f"mutation {rec['name']:<38} {rec['code']} fired")
+        else:
+            failed += 1
+            out(f"mutation {rec['name']:<38} {rec['code']} FAILED "
+                f"(clean_ok={rec['clean_ok']}, fired={rec['fired']})")
+    out(f"mutation matrix: {len(results) - failed}/{len(results)} "
+        "rules fire on their seeded violations")
+    return failed, results
+
+
+def lint_files(paths, out=print) -> tuple[int, list]:
+    """Plan-lint serialized strategy JSON files."""
+    from autodist_tpu.analysis import lint_plan
+    from autodist_tpu.strategy.ir import Strategy
+
+    n_err = 0
+    results = []
+    for path in paths:
+        with open(path) as f:
+            strategy = Strategy.from_json(f.read())
+        report = lint_plan(strategy)
+        n_err += len(report.errors)
+        out(report.render(title=path))
+        results.append({"path": path,
+                        "plan": [d.to_dict() for d in report]})
+    return n_err, results
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Plan + program lint over the AutoStrategy zoo "
+                    "(ADT diagnostics; rc 1 on any ERROR)")
+    ap.add_argument("strategies", nargs="*",
+                    help="serialized strategy JSON files to plan-lint")
+    ap.add_argument("--zoo", action="store_true",
+                    help="sweep every AutoStrategy candidate (plan "
+                         "lint + program lint) and the decode configs")
+    ap.add_argument("--mutate", action="store_true",
+                    help="run the mutation-test harness (each rule "
+                         "must fire on its seeded violation)")
+    ap.add_argument("--plan-only", action="store_true",
+                    help="skip the program compiles (plan lint only)")
+    ap.add_argument("--no-decode", action="store_true",
+                    help="skip the decode-window programs")
+    ap.add_argument("--max-programs", type=int, default=None,
+                    metavar="N",
+                    help="compile at most N programs (CI budget "
+                         "guard); skipped programs are listed")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="write the machine-readable report here")
+    ap.add_argument("--check", action="store_true",
+                    help="CI mode: compact output, same rc contract "
+                         "(rc 1 on any ERROR / non-firing mutation)")
+    args = ap.parse_args(argv)
+    if not (args.zoo or args.mutate or args.strategies):
+        ap.error("nothing to do: pass --zoo, --mutate, and/or "
+                 "strategy JSON files")
+
+    out = (lambda *a, **k: None) if args.check else print
+    n_err = 0
+    report = {}
+    if args.strategies:
+        file_err, report["files"] = lint_files(args.strategies, out=out)
+        n_err += file_err
+    if args.zoo:
+        zoo_err, zoo_warn, report["zoo"] = lint_zoo(
+            max_programs=args.max_programs, plan_only=args.plan_only,
+            decode=not args.no_decode, out=out)
+        n_err += zoo_err
+        print(f"zoo sweep: {zoo_err} error(s), {zoo_warn} warning(s) "
+              f"across {len(report['zoo'])} candidate(s)")
+    if args.mutate:
+        mut_failed, report["mutations"] = run_mutation_matrix(out=out)
+        n_err += mut_failed
+        if mut_failed:
+            print(f"mutation matrix: {mut_failed} rule(s) did NOT fire")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1)
+    return 1 if n_err else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
