@@ -65,6 +65,8 @@ CODES: dict[str, tuple[str, str]] = {
     "ADT044": (ERROR, "unknown comm_overlap mode"),
     "ADT050": (ERROR, "unknown compressor"),
     "ADT051": (WARNING, "compressor has no data axis to compress over"),
+    "ADT060": (ERROR, "model/pipeline sharding rides the cross-slice "
+                      "dcn axis (DCN carries only data parallelism)"),
     # --- program lint (optimized HLO) -------------------------------- #
     "ADT101": (ERROR, "step program contains a host transfer"),
     "ADT102": (ERROR, "multi-step window lowered without a fused loop"),

@@ -97,6 +97,20 @@ def _pipe_only_fixture():
     return strategy, spec, trainable
 
 
+def _multislice_fixture():
+    """Pipeline on a two-slice (dcn x data x pipe) mesh — the fixture
+    the dcn-axis-misuse rule needs a clean multi-slice base on."""
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.strategy.parallel_builders import Pipeline
+
+    spec = ResourceSpec({"topology": {"platform": "cpu",
+                                      "num_devices": 8},
+                         "mesh": {"dcn": 2, "data": 2, "pipe": 2}})
+    trainable = _lm_trainable()
+    strategy = Pipeline(num_microbatches=2).build(trainable, spec)
+    return strategy, spec, trainable
+
+
 def _fsdp_fixture():
     from autodist_tpu.resource import ResourceSpec
     from autodist_tpu.strategy.gspmd_builders import FSDPSharded
@@ -349,6 +363,14 @@ def _plan_mutations() -> list[PlanMutation]:
             lambda: _pipeline_fixture(tensor_parallel=2),
             edit(lambda d: d["graph_config"]["parallel"].update(
                 {"comm_overlap": "ring"}))),
+        PlanMutation(
+            "tp_sharded_across_dcn", "ADT060",
+            "a stage variable's spec hand-edited to shard over the "
+            "cross-slice dcn axis (model collectives riding DCN)",
+            _multislice_fixture,
+            edit(lambda d: _set_node(
+                d, "mlp/wi/kernel",
+                **{"partitioner.spec": ["pipe", "dcn", None]}))),
         PlanMutation(
             "compressor_without_data_axis", "ADT051",
             "compressor hand-added on a pipe-only mesh (no data axis "

@@ -474,6 +474,42 @@ def rule_noop_at_tp1(ctx: PlanContext):
 
 
 # --------------------------------------------------------------------------- #
+# Hierarchical-topology rules
+# --------------------------------------------------------------------------- #
+@plan_rule
+def rule_dcn_axis_misuse(ctx: PlanContext):
+    """The dcn axis joins slices over the data-center network: it may
+    carry only data-parallel gradient sync.  A partitioner record that
+    shards a *variable* over ``dcn`` puts model/pipeline collectives on
+    the slow level — the hierarchical cost model prices such plans
+    strictly worse than the same degree kept within a slice, and the
+    topology-aware search never emits them, so a hand-edited one is
+    almost certainly a mistake."""
+    for nc in ctx.strategy.node_configs:
+        part = nc.partitioner
+        if part is None:
+            continue
+        spec_hits = False
+        for entry in (part.spec or []):
+            leaves = entry if isinstance(entry, (list, tuple)) else [entry]
+            if const.DCN_AXIS in [a for a in leaves if a]:
+                spec_hits = True
+                break
+        if not spec_hits and not (part.spec is None
+                                  and part.mesh_axis == const.DCN_AXIS
+                                  and part.num_shards > 1):
+            continue
+        yield Diagnostic(
+            "ADT060",
+            "partitioner shards this variable over the cross-slice "
+            "'dcn' axis; DCN carries only data-parallel sync — keep "
+            "tensor/pipeline sharding within a slice",
+            where=nc.var_name,
+            fix="shard over 'model'/'pipe' (ici axes) and leave 'dcn' "
+                "to the data-parallel replica set")
+
+
+# --------------------------------------------------------------------------- #
 # Synchronizer / compressor rules
 # --------------------------------------------------------------------------- #
 @plan_rule

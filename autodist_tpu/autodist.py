@@ -54,6 +54,40 @@ class AutoDist:
             self._mesh = self.resource_spec.make_mesh()
         return self._mesh
 
+    def _mesh_for(self, strategy: Strategy):
+        """The mesh a strategy lowers on: the spec's resolved mesh —
+        unless the strategy carries its *own* factorization of the same
+        topology in ``graph_config.mesh_axes`` (a searched candidate,
+        :mod:`autodist_tpu.simulator.search`, or a chief→worker handoff
+        of one).  The strategy's axes then govern mesh construction, so
+        one resource spec can lower any factorization the search
+        elected; a mesh_axes record inconsistent with the device count
+        falls back to the spec (plan lint ADT001 flags it)."""
+        import math
+
+        declared = dict(getattr(strategy.graph_config, "mesh_axes",
+                                None) or {})
+        if declared:
+            try:
+                resolved = self.resource_spec.resolved_mesh_shape()
+                n = self.resource_spec.num_devices()
+            except (ValueError, RuntimeError):
+                resolved = None
+            if (resolved is not None and declared != resolved
+                    and all(isinstance(v, int) and v > 0
+                            for v in declared.values())
+                    and math.prod(declared.values()) == n):
+                key = tuple(declared.items())
+                cache = getattr(self, "_mesh_cache", None)
+                if cache is None:
+                    cache = self._mesh_cache = {}
+                if key not in cache:
+                    self.resource_spec.bootstrap()
+                    cache[key] = self.resource_spec.with_mesh(
+                        declared).make_mesh()
+                return cache[key]
+        return self.mesh
+
     # ------------------------------------------------------------------ #
     def build_or_load_strategy(self, trainable: Trainable) -> Strategy:
         """Chief builds + publishes; workers load by ID (≙ reference
@@ -142,20 +176,21 @@ class AutoDist:
 
     def _lower(self, trainable: Trainable, strategy: Strategy) -> Lowered:
         kind = strategy.graph_config.lowering
+        mesh = self._mesh_for(strategy)
         if kind == "collective":
-            return lower(trainable, strategy, self.mesh)
+            return lower(trainable, strategy, mesh)
         if kind == "gspmd":
             from autodist_tpu.kernel.gspmd import lower_gspmd
-            lowered = lower_gspmd(trainable, strategy, self.mesh)
+            lowered = lower_gspmd(trainable, strategy, mesh)
         elif kind == "sequence":
             from autodist_tpu.parallel.sequence import lower_sequence_ir
-            lowered = lower_sequence_ir(trainable, strategy, self.mesh)
+            lowered = lower_sequence_ir(trainable, strategy, mesh)
         elif kind == "pipeline":
             from autodist_tpu.parallel.pipeline import lower_pipeline_ir
-            lowered = lower_pipeline_ir(trainable, strategy, self.mesh)
+            lowered = lower_pipeline_ir(trainable, strategy, mesh)
         elif kind == "expert":
             from autodist_tpu.parallel.moe import lower_expert_ir
-            lowered = lower_expert_ir(trainable, strategy, self.mesh)
+            lowered = lower_expert_ir(trainable, strategy, mesh)
         else:
             raise ValueError(
                 f"unknown lowering {kind!r}; expected one of 'collective', "

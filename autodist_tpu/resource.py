@@ -46,6 +46,23 @@ except ImportError:  # pragma: no cover
 
 
 @dataclasses.dataclass(frozen=True)
+class LinkSpec:
+    """One level of the hierarchical network model: a named link class
+    with its effective per-device bandwidth and per-collective launch
+    latency.  Two levels exist on a TPU pod — ``ici`` within a slice
+    and ``dcn`` across slices (the data-center network joining slices,
+    orders of magnitude slower per device) — and the cost model prices
+    each collective per level it crosses (the two-level reduction shape
+    of arxiv 2110.10548).  Calibration (``calibration.json`` ``"link"``
+    section: ``ici_gbps`` / ``dcn_gbps`` / ``dcn_alpha_s`` / ...)
+    overrides these chip-table defaults the same way for both levels."""
+
+    level: str                   # "ici" | "dcn"
+    gbps: float                  # effective GB/s per device at this level
+    alpha_s: float               # per-collective launch latency (seconds)
+
+
+@dataclasses.dataclass(frozen=True)
 class ChipSpec:
     """Per-generation hardware constants (analog of the reference's
     ``network_bandwidth`` field, ``resource_spec.py:209-215``, generalized
@@ -57,15 +74,29 @@ class ChipSpec:
     hbm_gbps: float              # memory bandwidth
     ici_gbps: float              # per-link interconnect bandwidth
     mxu_tile: int = 128
+    # Cross-slice (DCN) level: per-device share of the slice's
+    # data-center uplink, and the (much larger) cross-slice collective
+    # launch latency.  Like ici_gbps these are *relative-rank* figures,
+    # not datasheet truth; a measured "link" dcn_* calibration section
+    # replaces them.
+    dcn_gbps: float = 5.0
+    dcn_alpha_s: float = 1e-4
+
+    def link_levels(self) -> dict[str, LinkSpec]:
+        """The hierarchical network model: level name → LinkSpec."""
+        return {
+            "ici": LinkSpec("ici", self.ici_gbps, 5e-6),
+            "dcn": LinkSpec("dcn", self.dcn_gbps, self.dcn_alpha_s),
+        }
 
 
 # Public figures; used only for relative cost decisions and MFU math.
 CHIP_SPECS = {
-    "v4": ChipSpec("v4", peak_bf16_tflops=275.0, hbm_gb=32, hbm_gbps=1228, ici_gbps=50),
-    "v5e": ChipSpec("v5e", peak_bf16_tflops=197.0, hbm_gb=16, hbm_gbps=819, ici_gbps=50),
-    "v5p": ChipSpec("v5p", peak_bf16_tflops=459.0, hbm_gb=95, hbm_gbps=2765, ici_gbps=100),
-    "v6e": ChipSpec("v6e", peak_bf16_tflops=918.0, hbm_gb=32, hbm_gbps=1640, ici_gbps=100),
-    "cpu": ChipSpec("cpu", peak_bf16_tflops=1.0, hbm_gb=8, hbm_gbps=50, ici_gbps=10),
+    "v4": ChipSpec("v4", peak_bf16_tflops=275.0, hbm_gb=32, hbm_gbps=1228, ici_gbps=50, dcn_gbps=6.25),
+    "v5e": ChipSpec("v5e", peak_bf16_tflops=197.0, hbm_gb=16, hbm_gbps=819, ici_gbps=50, dcn_gbps=6.25),
+    "v5p": ChipSpec("v5p", peak_bf16_tflops=459.0, hbm_gb=95, hbm_gbps=2765, ici_gbps=100, dcn_gbps=12.5),
+    "v6e": ChipSpec("v6e", peak_bf16_tflops=918.0, hbm_gb=32, hbm_gbps=1640, ici_gbps=100, dcn_gbps=12.5),
+    "cpu": ChipSpec("cpu", peak_bf16_tflops=1.0, hbm_gb=8, hbm_gbps=50, ici_gbps=10, dcn_gbps=1.0),
 }
 
 
@@ -234,6 +265,31 @@ class ResourceSpec:
             raise ValueError(
                 f"mesh shape {shape} does not match {n} devices")
         return shape
+
+    def with_mesh(self, mesh_shape: Mapping[str, int]) -> "ResourceSpec":
+        """A copy of this spec with a different mesh factorization of
+        the *same* topology — how the topology-aware search
+        (:mod:`autodist_tpu.simulator.search`) enumerates candidate
+        ``(dcn, data, pipe, model, ...)`` factorizations without
+        re-parsing or re-bootstrapping anything.  Shares platform,
+        generation, device inventory, slice count, and multihost state
+        with the original."""
+        import copy
+
+        for ax in mesh_shape:
+            if ax not in const.ALL_AXES:
+                raise ValueError(
+                    f"unknown mesh axis {ax!r}; valid axes: "
+                    f"{const.ALL_AXES}")
+        clone = copy.copy(self)
+        clone.mesh_shape = dict(mesh_shape)
+        return clone
+
+    def link_levels(self) -> dict[str, LinkSpec]:
+        """This topology's hierarchical network model (chip-table
+        defaults; the cost model overlays calibrated ``"link"``
+        constants on top)."""
+        return self.chip.link_levels()
 
     def three_d(self) -> tuple[int, int, int]:
         """The resolved ``(dp, pp, tp)`` degrees of this topology.

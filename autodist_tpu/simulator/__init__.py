@@ -6,7 +6,10 @@ from autodist_tpu.simulator.auto_strategy import (AutoStrategy,
                                                   rank_serving)
 from autodist_tpu.simulator.cost_model import (CostModel, DecodeCost,
                                                StrategyCost)
+from autodist_tpu.simulator.search import (KnobConfig, SearchResult,
+                                           SearchSpace, search_strategies)
 
 __all__ = ["AutoStrategy", "CostModel", "StrategyCost", "DecodeCost",
            "default_candidates", "default_serving_candidates",
-           "rank_serving"]
+           "rank_serving", "KnobConfig", "SearchResult", "SearchSpace",
+           "search_strategies"]

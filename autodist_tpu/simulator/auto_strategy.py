@@ -162,6 +162,22 @@ class AutoStrategy(StrategyBuilder):
 
     Args:
       candidates: builder instances to choose among (default: the zoo).
+      search: enumerate the topology-aware knob cross-product
+        (:mod:`autodist_tpu.simulator.search`) in place of the fixed
+        candidate zoo: every ``(dp-across-DCN, dp-within-ICI, pp, tp,
+        vocab_parallel, zero_stage, comm_overlap,
+        collective_precision, num_microbatches, compressor)`` point
+        the topology admits is synthesized, dominance-pruned,
+        plan-linted, and priced against the hierarchical (ICI/DCN)
+        network model.  The zoo still seeds the frontier, so the
+        searched winner never scores below the zoo winner; the same
+        report/measure/multihost machinery applies, with searched
+        candidates carrying descriptive knob-string names.  After
+        ``build``, ``auto.search_result`` holds the full
+        :class:`~autodist_tpu.simulator.search.SearchResult`.
+      search_space: a :class:`~autodist_tpu.simulator.search.
+        SearchSpace` bounding the cross-product (implies
+        ``search=True``).
       measure_top_k: when > 1, lower + time this many of the analytically
         best feasible candidates and pick the measured winner.  Costs one
         compile per measured candidate.  Multihost: launch workers with
@@ -177,10 +193,14 @@ class AutoStrategy(StrategyBuilder):
 
     def __init__(self, candidates: Optional[Sequence[StrategyBuilder]] = None,
                  measure_top_k: int = 0, example_batch=None,
-                 measure_steps: int = 3, **cost_model_kwargs):
+                 measure_steps: int = 3, search: bool = False,
+                 search_space=None, **cost_model_kwargs):
         self.candidates = list(candidates) if candidates is not None \
             else default_candidates()
-        if not self.candidates:
+        self.search = bool(search) or search_space is not None
+        self.search_space = search_space
+        self.search_result = None
+        if not self.candidates and not self.search:
             raise ValueError("AutoStrategy needs at least one candidate")
         if measure_top_k > 1 and example_batch is None:
             raise ValueError("measure_top_k needs an example_batch to time")
@@ -217,6 +237,64 @@ class AutoStrategy(StrategyBuilder):
         self.measured = {}
         self._winner_runner = None
         self._winner_strategy_id = None
+        if self.search:
+            scored = self._search_candidates(trainable, resource_spec,
+                                             model)
+        else:
+            scored = self._score_zoo(trainable, resource_spec, model)
+        if not scored:
+            raise ValueError("no AutoStrategy candidate produced a strategy")
+        scored.sort(key=lambda t: (t[1].score, t[1].num_collectives))
+        self.report = [(name, cost) for name, cost, _ in scored]
+        for name, cost in self.report:
+            logging.info(
+                "auto-strategy candidate %-18s comm=%8.1fMB t=%7.3fms "
+                "colls=%3d mem/dev=%6.2fGB%s", name,
+                cost.comm_bytes / 1e6, cost.comm_time_s * 1e3,
+                cost.num_collectives, cost.mem_bytes_per_device / 1e9,
+                "" if cost.feasible else "  INFEASIBLE")
+        best_name, best_cost, best_strategy = scored[0]
+        if not best_cost.feasible:
+            raise ValueError(
+                "no candidate strategy fits in device memory "
+                f"(best: {best_name} needs "
+                f"{best_cost.mem_bytes_per_device / 1e9:.2f} GB/device)")
+        if self.measure_top_k > 1:
+            measured = self._measure(trainable, resource_spec, scored)
+            if measured is not None:
+                best_name, best_strategy = measured
+        logging.info("auto-strategy picked %s", best_name)
+        return best_strategy
+
+    def _search_candidates(self, trainable, resource_spec, model):
+        """The topology-aware cross-product frontier as the candidate
+        set (same ``(name, cost, strategy)`` triples the zoo loop
+        produces — report/measure/multihost machinery downstream is
+        shared)."""
+        import numpy as _np
+
+        import jax as _jax
+
+        from autodist_tpu.simulator.search import search_strategies
+
+        global_batch = None
+        if self.example_batch is not None:
+            leaves = [l for l in _jax.tree.leaves(self.example_batch)
+                      if _np.ndim(l) > 0]
+            if leaves:
+                global_batch = int(_np.shape(leaves[0])[0])
+        self.search_result = search_strategies(
+            trainable, resource_spec, self.search_space,
+            cost_model=model, global_batch=global_batch,
+            seed_builders=self.candidates)
+        logging.info("auto-strategy search:\n%s",
+                     self.search_result.report())
+        return [(c.name, c.cost, c.strategy)
+                for c in self.search_result.frontier]
+
+    def _score_zoo(self, trainable, resource_spec, model):
+        """Score the fixed candidate zoo (the pre-search path, and the
+        compatibility default)."""
         import json
 
         scored = []
@@ -278,29 +356,7 @@ class AutoStrategy(StrategyBuilder):
                 logging.debug("candidate %s skipped: %s", name, e)
                 continue
             scored.append((name, cost, strategy))
-        if not scored:
-            raise ValueError("no AutoStrategy candidate produced a strategy")
-        scored.sort(key=lambda t: (t[1].score, t[1].num_collectives))
-        self.report = [(name, cost) for name, cost, _ in scored]
-        for name, cost in self.report:
-            logging.info(
-                "auto-strategy candidate %-18s comm=%8.1fMB t=%7.3fms "
-                "colls=%3d mem/dev=%6.2fGB%s", name,
-                cost.comm_bytes / 1e6, cost.comm_time_s * 1e3,
-                cost.num_collectives, cost.mem_bytes_per_device / 1e9,
-                "" if cost.feasible else "  INFEASIBLE")
-        best_name, best_cost, best_strategy = scored[0]
-        if not best_cost.feasible:
-            raise ValueError(
-                "no candidate strategy fits in device memory "
-                f"(best: {best_name} needs "
-                f"{best_cost.mem_bytes_per_device / 1e9:.2f} GB/device)")
-        if self.measure_top_k > 1:
-            measured = self._measure(trainable, resource_spec, scored)
-            if measured is not None:
-                best_name, best_strategy = measured
-        logging.info("auto-strategy picked %s", best_name)
-        return best_strategy
+        return scored
 
     def take_cached_runner(self, strategy_id: str):
         """Hand the measured winner's already-compiled runner to the

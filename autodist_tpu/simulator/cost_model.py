@@ -64,7 +64,10 @@ _VOCAB_NAME_RE = re.compile(r"(^|/)embedding$")
 # (resource.ChipSpec) / COLLECTIVE_ALPHA; a ``"link"`` section in
 # calibration.json (or an explicit ``CostModel(link_profile=...)``)
 # replaces them with measured values.  Keys: ``ici_gbps``,
-# ``hop_alpha_s``, ``mxu_efficiency``.
+# ``hop_alpha_s``, ``mxu_efficiency`` — and, for the cross-slice (DCN)
+# level of the hierarchical network model, ``dcn_gbps`` /
+# ``dcn_alpha_s`` (merged from calibration exactly like ``ici_gbps``;
+# the drift report proposes both).
 LINK_PROFILE: dict = {}
 
 # Fraction of peak matmul throughput a pipeline-stage chunk sustains —
@@ -210,6 +213,16 @@ class StrategyCost:
     # outweighs this term.
     wire_bytes_saved: float = 0.0
     quant_dq_time_s: float = 0.0
+    # Per-level breakdown of the hierarchical network model: the bytes
+    # and time of the cross-slice (DCN) exchanges, already included in
+    # comm_bytes / comm_time_s.  A collective spanning the dcn axis
+    # decomposes into intra-slice reduce + cross-slice exchange +
+    # intra-slice broadcast (arxiv 2110.10548); this is the cross-slice
+    # term, priced at the dcn_gbps/dcn_alpha_s constants — broken out
+    # so the drift report can fit dcn_gbps independently of ici_gbps
+    # and the search report can show per-level comm per candidate.
+    dcn_bytes: float = 0.0
+    dcn_time_s: float = 0.0
 
     @property
     def score(self) -> float:
@@ -285,6 +298,64 @@ class CostModel:
             self.quant_profile.update(quant_profile)
 
     # ------------------------------------------------------------------ #
+    def with_spec(self, resource_spec: ResourceSpec) -> "CostModel":
+        """The same pricing constants bound to a different resource
+        spec — how the topology-aware search prices each candidate
+        against its *own* mesh factorization (the mesh is read from
+        ``self.spec``, so pricing a re-factored candidate with the
+        original model would silently ignore its pp/tp/dcn degrees)."""
+        return CostModel(resource_spec,
+                         sparsity_fraction=self.sparsity_fraction,
+                         opt_state_multiplier=self.opt_state_multiplier,
+                         hbm_headroom=self.hbm_headroom,
+                         tokens_per_step=self.tokens_per_step,
+                         act_bytes_per_token=self.act_bytes_per_token,
+                         link_profile=self.link_profile,
+                         quant_profile=self.quant_profile)
+
+    def _dcn_link(self) -> tuple[float, float]:
+        """(bytes/s, launch alpha) of the cross-slice DCN level —
+        calibrated ``"link"`` ``dcn_*`` constants over the chip-table
+        defaults, the same override chain as ``ici_gbps``."""
+        bw = float(self.link_profile.get(
+            "dcn_gbps", getattr(self.chip, "dcn_gbps", 5.0))) * 1e9
+        alpha = float(self.link_profile.get(
+            "dcn_alpha_s", getattr(self.chip, "dcn_alpha_s", 1e-4)))
+        return bw, alpha
+
+    def _dcn_degree(self, mesh: dict) -> int:
+        """Slice count the replica sync crosses: the mesh's ``dcn``
+        axis — or, when an explicit mesh omits it on a declared
+        multi-slice topology, ``num_slices`` (the data axis still
+        physically crosses slices whether or not the user named the
+        level; pricing it flat would be exactly the mispricing the
+        hierarchical model exists to fix)."""
+        from autodist_tpu import const
+
+        n_dcn = max(int(mesh.get(const.DCN_AXIS, 1) or 1), 1)
+        if n_dcn == 1:
+            n_dcn = max(int(getattr(self.spec, "num_slices", 1) or 1), 1)
+        return n_dcn
+
+    @staticmethod
+    def _split_ring(n_sync: int, n_dcn: int) -> tuple[float, float]:
+        """Hierarchical ring factors for a replica-sync group of
+        ``n_sync`` members of which ``n_dcn`` cross slices: intra-slice
+        reduce-scatter + broadcast at ICI rates plus a cross-slice
+        exchange of the intra-slice shard at DCN rates (the two-level
+        reduction shape of arxiv 2110.10548).  Returns ``(ici_factor,
+        dcn_factor)`` — multiply each by the payload bytes and price at
+        its level's bandwidth.  Pure-ICI groups (``n_dcn == 1``) keep
+        today's exact single-level factor, so single-slice pricing is
+        byte-identical to the flat model."""
+        def ring(k: int) -> float:
+            return 2.0 * (k - 1) / k if k > 1 else 0.0
+
+        if n_dcn <= 1 or n_sync % n_dcn:
+            return ring(n_sync), 0.0
+        g = n_sync // n_dcn
+        return ring(g), ring(n_dcn) / max(g, 1)
+
     def _hints(self, trainable) -> tuple[Optional[int], Optional[float]]:
         tokens = self.tokens_per_step if self.tokens_per_step is not None \
             else getattr(trainable, "tokens_per_step", None)
@@ -353,7 +424,13 @@ class CostModel:
         mesh = self.spec.resolved_mesh_shape()
         n = max(strategy.graph_config.replicas, 1)
         infos = {v.name: v for v in trainable.var_infos()}
-        ring = 2.0 * (n - 1) / n if n > 1 else 0.0
+        # The replica group spans data x dcn; dcn-crossing sync
+        # decomposes per level (intra-slice at ICI + cross-slice shard
+        # exchange at DCN) instead of pricing everything at ici_gbps.
+        ring, dcn_factor = self._split_ring(n, self._dcn_degree(mesh))
+        bw_dcn, dcn_alpha = self._dcn_link()
+        dcn_bytes = dcn_time = 0.0
+        dcn_colls = 0
         total_devices = 1
         for v in mesh.values():
             total_devices *= v
@@ -383,9 +460,12 @@ class CostModel:
                     opt_div = shards * n
                 mem_bytes += bytes_ * 2.0 / shards \
                     + bytes_ * self.opt_state_multiplier / opt_div
-                comm_bytes += ring * (bytes_ if uses_data
-                                      else bytes_ / shards)
+                payload = bytes_ if uses_data else bytes_ / shards
+                comm_bytes += ring * payload
                 num_collectives += 2
+                if dcn_factor:
+                    dcn_bytes += dcn_factor * payload
+                    dcn_colls += 2
                 # Row-parallel on the model axis: fwd+bwd activation
                 # allreduce of tokens x shape[1] over the TP group.
                 part = node.partitioner
@@ -413,6 +493,9 @@ class CostModel:
                     + bytes_ * self.opt_state_multiplier / opt_div
                 comm_bytes += ring * bytes_
                 num_collectives += 2 if opt_div > 1 else 1
+                if dcn_factor:
+                    dcn_bytes += dcn_factor * bytes_
+                    dcn_colls += 2 if opt_div > 1 else 1
         if tokens and act_hint:
             # Activations divide by the number of batch shards (the data
             # axis), not all devices: a TP group processes the same
@@ -423,11 +506,16 @@ class CostModel:
         comm_time = comm_bytes / bw \
             + COLLECTIVE_ALPHA * num_collectives * (1 if total_devices > 1
                                                     else 0)
+        if dcn_bytes:
+            dcn_time = dcn_bytes / bw_dcn + dcn_alpha * dcn_colls
+            comm_time += dcn_time
         hbm = self.chip.hbm_gb * 1e9 * self.hbm_headroom
-        return StrategyCost(comm_bytes=comm_bytes, comm_time_s=comm_time,
-                            num_collectives=num_collectives,
+        return StrategyCost(comm_bytes=comm_bytes + dcn_bytes,
+                            comm_time_s=comm_time,
+                            num_collectives=num_collectives + dcn_colls,
                             mem_bytes_per_device=mem_bytes,
-                            feasible=mem_bytes <= hbm)
+                            feasible=mem_bytes <= hbm,
+                            dcn_bytes=dcn_bytes, dcn_time_s=dcn_time)
 
     def _parallel_cost(self, trainable, strategy) -> StrategyCost:
         """Pricing for the sequence / pipeline / expert lowerings.
@@ -464,6 +552,15 @@ class CostModel:
         mxu_eff = float(self.link_profile.get(
             "mxu_efficiency", _DEFAULT_MXU_EFFICIENCY))
         flops_rate = self.chip.peak_bf16_tflops * 1e12 * mxu_eff
+        # Hierarchical network model: any sync group spanning the dcn
+        # axis decomposes into an intra-slice part (priced through the
+        # ICI `comm` pool below) and a cross-slice shard exchange priced
+        # at the DCN constants here — never at ici_gbps.
+        n_dcn = self._dcn_degree(mesh)
+        bw_dcn, dcn_alpha = self._dcn_link()
+        dcn_b = 0.0      # cross-slice wire bytes
+        dcn_t = 0.0      # cross-slice time, launch alphas included
+        dcn_colls = 0
         # Overlapped collectives are priced in *seconds* directly (their
         # per-hop alphas included), with their wire bytes and launch
         # counts reported but not re-charged through the bytes/bw + alpha
@@ -491,6 +588,22 @@ class CostModel:
 
         def ring(k: int) -> float:
             return 2.0 * (k - 1) / k if k > 1 else 0.0
+
+        def split_ring(n_sync: int) -> tuple[float, float]:
+            """(ici factor, dcn factor) of a replica sync group — see
+            :meth:`_split_ring`; the dcn factor's bytes are priced at
+            the DCN constants via :func:`dcn_sync` below."""
+            return self._split_ring(n_sync, n_dcn)
+
+        def dcn_sync(node, full_bytes: float, launches: int = 1):
+            """One grad-sync boundary's cross-slice exchange: wire
+            bytes after the node's compressor/grad-policy factor,
+            priced at DCN bandwidth plus launch alphas."""
+            nonlocal dcn_b, dcn_t, dcn_colls
+            b = grad_bytes(node, full_bytes)
+            dcn_b += b
+            dcn_t += b / bw_dcn + dcn_alpha * launches
+            dcn_colls += launches
 
         # Iterate var_infos (not node_configs): a hand-edited strategy
         # omitting node configs for some variables still trains them
@@ -562,8 +675,13 @@ class CostModel:
                 grad_b += bytes_ / g_div
                 mem += bytes_ / p_div + bytes_ / g_div \
                     + bytes_ * opt_mult / opt_div
-                comm += grad_bytes(node, (accum if stage >= 3 else 1)
-                                   * ring(n_sync) * bytes_)
+                f_ici, f_dcn = split_ring(n_sync)
+                mult = accum if stage >= 3 else 1
+                comm += grad_bytes(node, mult * f_ici * bytes_)
+                if f_dcn:
+                    dcn_sync(node, mult * f_dcn * bytes_,
+                             2 * accum if stage >= 3
+                             else 2 if opt_div > 1 else 1)
                 colls += (2 * accum if stage >= 3
                           else 2 if opt_div > 1 else 1)
             if tokens:
@@ -614,8 +732,22 @@ class CostModel:
                         and part.num_shards > 1))
                 if is_stage:
                     spec_tail = (part.spec[1:] if part.spec else [])
-                    tp_sharded = const.MODEL_AXIS in spec_tail
-                    per_dev = bytes_ / (S * (tp if tp_sharded else 1))
+                    tail_axes = {a for e in spec_tail
+                                 for a in (e if isinstance(e, (list, tuple))
+                                           else [e]) if a}
+                    tp_over_dcn = const.DCN_AXIS in tail_axes
+                    tp_sharded = const.MODEL_AXIS in tail_axes \
+                        or tp_over_dcn
+                    # The boundary group of this var's model-parallel
+                    # collectives: the model axis, times the dcn axis
+                    # when a (mis-)edited plan shards across slices —
+                    # those boundaries are priced at DCN below, so such
+                    # plans rank strictly worse than the same degree
+                    # kept within a slice (and ADT060 flags them).
+                    tp_group = (tp if const.MODEL_AXIS in tail_axes
+                                else 1) * (n_dcn if tp_over_dcn else 1)
+                    per_dev = bytes_ / (S * (tp_group if tp_sharded
+                                             else 1))
                     # ZeRO on a tp-sharded var degrades (state shards
                     # with the parameter — recorded on the lowered plan).
                     stage, p_div, g_div, opt_div = (
@@ -652,7 +784,8 @@ class CostModel:
                         # (full precision), so z3 narrowing is a wire-
                         # volume lever for the drift report, not a step-
                         # time lever past the floor.
-                        half = ring(n_data) / 2.0
+                        f_ici, f_dcn = split_ring(n_data)
+                        half = f_ici / 2.0
                         rs_bytes = accum * half * per_dev \
                             * PSUM_WIRE_FACTOR[z3_prec]
                         ag_bytes = accum * half * per_dev \
@@ -674,25 +807,47 @@ class CostModel:
                             t_hide = 2.0 * tokens_local \
                                 * (per_dev / 4.0) / flops_rate
                         exposed = alpha_floor + max(0.0, t_ag - t_hide)
-                        stage1_pair = ring(n_data) * per_dev / bw_link \
+                        stage1_pair = f_ici * per_dev / bw_link \
                             + 2.0 * hop_alpha
                         already = rs_bytes / bw_link + hop_alpha * accum
                         overlap_s += max(exposed,
                                          stage1_pair - already)
                         hidden_bytes += ag_bytes
                         extra_colls += accum * 2 * V
+                        if f_dcn:
+                            # Cross-slice half of the rs/ag pair: the
+                            # intra-slice shard exchanged at DCN rates;
+                            # never overlap-credited (no hiding modeled
+                            # across the slow level).
+                            rs_d = accum * (f_dcn / 2.0) * per_dev \
+                                * PSUM_WIRE_FACTOR[z3_prec]
+                            ag_d = accum * (f_dcn / 2.0) * per_dev \
+                                * GATHER_WIRE_FACTOR[z3_prec]
+                            saved_bytes += accum * f_dcn * per_dev \
+                                - rs_d - ag_d
+                            dcn_b += rs_d + ag_d
+                            dcn_t += (rs_d + ag_d) / bw_dcn \
+                                + dcn_alpha * 2 * accum
+                            dcn_colls += 2 * accum
                     else:
-                        comm += grad_bytes(node, ring(n_data) * per_dev)
+                        f_ici, f_dcn = split_ring(n_data)
+                        comm += grad_bytes(node, f_ici * per_dev)
+                        if f_dcn:
+                            dcn_sync(node, f_dcn * per_dev,
+                                     2 if opt_div > 1 else 1)
                         colls += 2 if opt_div > 1 else 1
                     # rank >= 2 gates out the column-parallel biases
                     # (spec tail ['model']), which shard but never
                     # all-reduce activations.
-                    row_parallel = (len(spec_tail) >= 2
-                                    and spec_tail[0] == const.MODEL_AXIS)
-                    if row_parallel and tp > 1 and tokens:
+                    head = spec_tail[0] if spec_tail else None
+                    head_axes = {a for a in (head if isinstance(
+                        head, (list, tuple)) else [head]) if a}
+                    row_parallel = (len(spec_tail) >= 2 and bool(
+                        head_axes & {const.MODEL_AXIS, const.DCN_AXIS}))
+                    if row_parallel and tp_group > 1 and tokens:
                         width = info.shape[-1]
-                        act_bytes = 2.0 * ring(tp) * V * tokens_local \
-                            * width * _ACT_BYTES
+                        act_bytes = 2.0 * ring(tp_group) * V \
+                            * tokens_local * width * _ACT_BYTES
                         mode = overlap_cfg or normalize_comm_overlap(
                             getattr(part, "comm_overlap", None))
                         # Boundary precision: the graph policy's tp_psum
@@ -708,7 +863,20 @@ class CostModel:
                             # collective.
                             qdq_s += qdq(2.0 * V * tokens_local * width,
                                          prec_b)
-                        if mode is None:
+                        if tp_over_dcn:
+                            # Megatron boundary spanning slices: the
+                            # whole per-execution payload crosses DCN
+                            # every microbatch and is never overlap-
+                            # credited — exactly why the search keeps
+                            # tp within a slice and ADT060 flags plans
+                            # that don't.
+                            wired = act_bytes * act_factor
+                            saved_bytes += act_bytes - wired
+                            dcn_b += wired
+                            dcn_t += wired / bw_dcn \
+                                + dcn_alpha * 2 * M * V
+                            dcn_colls += 2 * M * V
+                        elif mode is None:
                             comm += act_bytes * act_factor
                             saved_bytes += act_bytes * (1.0 - act_factor)
                             colls += 2 * M * V
@@ -787,7 +955,8 @@ class CostModel:
                     mem += per_dev / p_div + per_dev / g_div \
                         + per_dev * opt_mult / opt_div
                     if stage >= 3 and not v_sharded:
-                        half = ring(n_pd) / 2.0
+                        f_ici, f_dcn = split_ring(n_pd)
+                        half = f_ici / 2.0
                         rs_sh = accum * half * per_dev \
                             * PSUM_WIRE_FACTOR[z3_prec]
                         ag_sh = accum * half * per_dev \
@@ -802,8 +971,23 @@ class CostModel:
                         overlap_s += t_ag + hop_alpha * accum
                         hidden_bytes += ag_sh
                         extra_colls += accum * 2
+                        if f_dcn:
+                            rs_d = accum * (f_dcn / 2.0) * per_dev \
+                                * PSUM_WIRE_FACTOR[z3_prec]
+                            ag_d = accum * (f_dcn / 2.0) * per_dev \
+                                * GATHER_WIRE_FACTOR[z3_prec]
+                            saved_bytes += accum * f_dcn * per_dev \
+                                - rs_d - ag_d
+                            dcn_b += rs_d + ag_d
+                            dcn_t += (rs_d + ag_d) / bw_dcn \
+                                + dcn_alpha * 2 * accum
+                            dcn_colls += 2 * accum
                     else:
-                        comm += grad_bytes(node, ring(n_pd) * per_dev)
+                        f_ici, f_dcn = split_ring(n_pd)
+                        comm += grad_bytes(node, f_ici * per_dev)
+                        if f_dcn:
+                            dcn_sync(node, f_dcn * per_dev,
+                                     2 if opt_div > 1 else 1)
                         colls += 2 if opt_div > 1 else 1
                     # Track the unembedding for the loss-head epilogue
                     # pricing below.  Identification priority: a
@@ -901,7 +1085,10 @@ class CostModel:
                     mem += bytes_ * (2.0 + opt_mult) / E
                     param_b += bytes_ / E
                     grad_b += bytes_ / E
-                    comm += grad_bytes(node, ring(n_data) * (bytes_ / E))
+                    f_ici, f_dcn = split_ring(n_data)
+                    comm += grad_bytes(node, f_ici * (bytes_ / E))
+                    if f_dcn:
+                        dcn_sync(node, f_dcn * (bytes_ / E))
                     colls += 1
                 else:
                     n_sync = n_data * E
@@ -911,9 +1098,13 @@ class CostModel:
                     grad_b += bytes_ / g_div
                     mem += bytes_ / p_div + bytes_ / g_div \
                         + bytes_ * opt_mult / opt_div
-                    comm += grad_bytes(
-                        node, (accum if stage >= 3 else 1)
-                        * ring(n_sync) * bytes_)
+                    f_ici, f_dcn = split_ring(n_sync)
+                    mult = accum if stage >= 3 else 1
+                    comm += grad_bytes(node, mult * f_ici * bytes_)
+                    if f_dcn:
+                        dcn_sync(node, mult * f_dcn * bytes_,
+                                 2 * accum if stage >= 3
+                                 else 2 if opt_div > 1 else 1)
                     colls += (2 * accum if stage >= 3
                               else 2 if opt_div > 1 else 1)
             if tokens:
@@ -925,12 +1116,13 @@ class CostModel:
             if tokens and act_hint:
                 mem += act_hint * tokens_per_dev
         comm_time = ((comm / bw_link + hop_alpha * colls + overlap_s
-                      + qdq_s)
+                      + qdq_s + dcn_t)
                      if total_devices > 1 else 0.0)
         hbm = self.chip.hbm_gb * 1e9 * self.hbm_headroom
-        return StrategyCost(comm_bytes=comm + hidden_bytes,
+        return StrategyCost(comm_bytes=comm + hidden_bytes + dcn_b,
                             comm_time_s=comm_time,
-                            num_collectives=colls + extra_colls,
+                            num_collectives=colls + extra_colls
+                            + dcn_colls,
                             mem_bytes_per_device=mem,
                             feasible=mem <= hbm,
                             overlap_time_s=(overlap_s
@@ -942,7 +1134,10 @@ class CostModel:
                             grad_shard_bytes=grad_b,
                             wire_bytes_saved=saved_bytes,
                             quant_dq_time_s=(qdq_s if total_devices > 1
-                                             else 0.0))
+                                             else 0.0),
+                            dcn_bytes=dcn_b,
+                            dcn_time_s=(dcn_t if total_devices > 1
+                                        else 0.0))
 
     # ------------------------------------------------------------------ #
     # Serving: per-token decode latency
@@ -1044,7 +1239,20 @@ class CostModel:
             return self._parallel_cost(trainable, strategy)
         n = max(strategy.graph_config.replicas, 1)
         infos = {v.name: v for v in trainable.var_infos()}
-        ring = 2.0 * (n - 1) / n if n > 1 else 0.0
+        # Hierarchical split of the replica sync: the dcn-crossing part
+        # of every collective is priced at DCN constants, never at
+        # ici_gbps (pure-ICI topologies keep today's exact factors).
+        try:
+            n_dcn = self._dcn_degree(self.spec.resolved_mesh_shape())
+        except (ValueError, RuntimeError):
+            n_dcn = max(int(getattr(self.spec, "num_slices", 1) or 1), 1)
+        if n % max(n_dcn, 1):
+            n_dcn = 1
+        ring, dcn_factor = self._split_ring(n, n_dcn)
+        bw_dcn, dcn_alpha = self._dcn_link()
+        sparse_frac = (n_dcn - 1) / n_dcn if n_dcn > 1 else 0.0
+        dcn_bytes = dcn_time = 0.0
+        dcn_colls = 0
 
         comm_bytes = 0.0
         mem_bytes = 0.0
@@ -1071,9 +1279,14 @@ class CostModel:
             if sparse_fast:
                 # Sparse sharded path: only touched rows move (gather of
                 # params + scatter of grads), ≙ the reference's sparse
-                # PS push/pull (ps_synchronizer.py:476-535).
-                comm_bytes += 2.0 * self.sparsity_fraction * bytes_
+                # PS push/pull (ps_synchronizer.py:476-535).  The cross-
+                # slice share of the shard owners is priced at DCN.
+                sp = 2.0 * self.sparsity_fraction * bytes_
+                comm_bytes += sp * (1.0 - sparse_frac)
+                dcn_bytes += sp * sparse_frac
                 num_collectives += 2
+                if sparse_frac:
+                    dcn_colls += 2
                 mem_bytes += (bytes_ / n) * (1.0 + self.opt_state_multiplier) \
                     + self.sparsity_fraction * bytes_  # gathered activations
             elif sharded:
@@ -1082,6 +1295,9 @@ class CostModel:
                 # launches, optimizer state sharded 1/n.
                 comm_bytes += ring * bytes_ * factor
                 num_collectives += 2
+                if dcn_factor:
+                    dcn_bytes += dcn_factor * bytes_ * factor
+                    dcn_colls += 2
                 mem_bytes += bytes_ \
                     + bytes_ * factor \
                     + (bytes_ * self.opt_state_multiplier) / n
@@ -1092,31 +1308,45 @@ class CostModel:
                 # volume), optimizer state sharded 1/n.
                 comm_bytes += ring * bytes_
                 num_collectives += 2
+                if dcn_factor:
+                    dcn_bytes += dcn_factor * bytes_
+                    dcn_colls += 2
                 mem_bytes += 2.0 * bytes_ \
                     + (bytes_ * self.opt_state_multiplier) / n
             else:
                 # Replicated DP allreduce: bucketed collectives count once
                 # per group (≙ ScopedAllocator merging, runner.py:40-46).
                 comm_bytes += ring * bytes_ * factor
+                if dcn_factor:
+                    dcn_bytes += dcn_factor * bytes_ * factor
                 group = getattr(sync, "group", None)
                 if group is not None:
                     groups.add(group)
                 else:
                     num_collectives += 1
+                    if dcn_factor:
+                        dcn_colls += 1
                 mem_bytes += bytes_ * (2.0 + self.opt_state_multiplier)
 
         num_collectives += len(groups)
+        if dcn_factor:
+            dcn_colls += len(groups)
         tokens, act_hint = self._hints(trainable)
         if tokens and act_hint:
             mem_bytes += act_hint * tokens / n
         bw = self.chip.ici_gbps * 1e9  # bytes/s
         comm_time = (comm_bytes / bw if n > 1 else 0.0) \
             + COLLECTIVE_ALPHA * num_collectives * (1 if n > 1 else 0)
+        if dcn_bytes and n > 1:
+            dcn_time = dcn_bytes / bw_dcn + dcn_alpha * dcn_colls
+            comm_time += dcn_time
         hbm = self.chip.hbm_gb * 1e9 * self.hbm_headroom
         return StrategyCost(
-            comm_bytes=comm_bytes,
+            comm_bytes=comm_bytes + dcn_bytes,
             comm_time_s=comm_time,
-            num_collectives=num_collectives,
+            num_collectives=num_collectives + dcn_colls,
             mem_bytes_per_device=mem_bytes,
             feasible=mem_bytes <= hbm,
+            dcn_bytes=dcn_bytes,
+            dcn_time_s=dcn_time,
         )

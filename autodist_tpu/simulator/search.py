@@ -1,0 +1,632 @@
+"""Topology-aware strategy search: the knob cross-product replaces the
+hand-enumerated zoo.
+
+The AutoStrategy zoo (:func:`~autodist_tpu.simulator.auto_strategy.
+default_candidates`) ranks a fixed ~20-candidate list — every
+``(dp, pp, tp, …)`` point it did not anticipate is simply never
+considered.  This module enumerates the full cross-product of
+
+    dp-across-DCN × dp-within-ICI × pp × tp × vocab_parallel ×
+    zero_stage × comm_overlap × collective_precision ×
+    num_microbatches × compressor
+
+for the *given* topology and trainable (the cross-product-vs-two-level-
+network-model search of arxiv 2110.10548), prunes it down, and prices
+the survivors with the same hierarchical :class:`~autodist_tpu.
+simulator.cost_model.CostModel` every zoo candidate is scored by:
+
+1. **enumerate** — mesh factorizations keep tensor/pipeline parallelism
+   strictly *within* a slice: only data parallelism ever rides the
+   ``dcn`` axis (a model-axis collective crossing DCN pays orders of
+   magnitude more per byte — the cost model prices exactly that, and
+   plan lint ADT060 flags hand-made violations).  Unbuildable points
+   (no TP rule match, stage count mismatch, batch indivisible) are
+   skipped and counted, like AutoStrategy's own candidate loop.
+2. **dominance-prune** — within each mesh factorization, a config whose
+   cheap closed-form proxies (comm bytes, compute overhead, memory) are
+   all no better — and at least one strictly worse — than a surviving
+   sibling's is dropped before pricing.  The proxies model only the
+   knob effects the cost model itself guarantees monotone (the ZeRO
+   accounting ladder, wire-precision byte factors + q/dq passes,
+   microbatch hop/bubble trade, overlap never pricing above blocking),
+   so dominance can never drop a point the cost model would have
+   ranked first.
+3. **plan-lint** — every synthesized candidate runs
+   :func:`autodist_tpu.analysis.lint_plan` before it is priced; a lint
+   ERROR prunes the candidate, counted and reported per code — never
+   silently.  (PR 9's linter is the correctness backbone that makes a
+   thousands-of-configs search safe.)
+4. **price** — survivors are scored by ``CostModel.strategy_cost``
+   (per-level ICI/DCN comm terms, HBM feasibility gate) and sorted
+   best-first; the zoo seeds the frontier by default so the searched
+   winner can never rank below the zoo winner.
+
+``tools/lint_strategy.py --search`` sweeps the frontier in CI and
+program-lints the winner; ``AutoStrategy(search=True)`` uses the
+frontier in place of the zoo with the same report/measure/multihost
+machinery.  See ``docs/usage/performance.md`` ("Topology-aware
+search").
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional, Sequence
+
+from autodist_tpu import const
+from autodist_tpu.capture import Trainable
+from autodist_tpu.resource import ResourceSpec
+from autodist_tpu.simulator.cost_model import (COLLECTIVE_ALPHA, CostModel,
+                                               SpecMeshMismatch,
+                                               StrategyCost)
+from autodist_tpu.strategy.builders import builder_from_knobs
+from autodist_tpu.utils import logging
+
+
+# --------------------------------------------------------------------------- #
+# One point of the cross-product
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class KnobConfig:
+    """One candidate: a mesh factorization of the topology plus the
+    serializable strategy knobs.  ``dp_dcn`` is always the full slice
+    count — data parallelism is the only axis that rides DCN."""
+
+    dp_dcn: int = 1
+    dp_ici: int = 1
+    pp: int = 1
+    tp: int = 1
+    virtual_stages: int = 1
+    num_microbatches: int = 1
+    vocab_parallel: bool = False
+    zero_stage: int = 0
+    comm_overlap: Optional[str] = None
+    collective_precision: Optional[str] = None
+    compressor: str = "none"
+    pipeline: bool = True      # stage-structured (Pipeline) vs generic
+
+    def mesh(self) -> dict:
+        """The candidate's mesh factorization — dcn outermost (slice
+        boundaries), model innermost (tp rides the shortest links)."""
+        shape: dict = {}
+        if self.dp_dcn > 1:
+            shape[const.DCN_AXIS] = self.dp_dcn
+        if self.dp_ici > 1 or not self.pipeline:
+            shape[const.DATA_AXIS] = self.dp_ici
+        if self.pipeline:
+            shape[const.PIPE_AXIS] = self.pp
+        if self.tp > 1:
+            shape[const.MODEL_AXIS] = self.tp
+        return shape
+
+    def mesh_key(self) -> tuple:
+        """Sibling group for dominance pruning: one mesh factorization."""
+        return (self.dp_dcn, self.dp_ici, self.pp, self.tp)
+
+    def knob_string(self) -> str:
+        """Descriptive candidate name, e.g.
+        ``dcn2_dp1_pp2_tp2_mb2_z3_vp_int8_ov-matmul``."""
+        parts = []
+        if self.dp_dcn > 1:
+            parts.append(f"dcn{self.dp_dcn}")
+        parts += [f"dp{self.dp_ici}", f"pp{self.pp}", f"tp{self.tp}"]
+        if self.pipeline:
+            parts.append(f"mb{self.num_microbatches}")
+            if self.virtual_stages > 1:
+                parts.append(f"vs{self.virtual_stages}")
+        if self.zero_stage:
+            parts.append(f"z{self.zero_stage}")
+        if self.vocab_parallel:
+            parts.append("vp")
+        if self.collective_precision:
+            parts.append(self.collective_precision)
+        if self.comm_overlap:
+            parts.append(f"ov-{self.comm_overlap}")
+        if self.compressor != "none":
+            parts.append(self.compressor)
+        return "_".join(parts)
+
+    def knobs(self) -> dict:
+        return {"pp": self.pp, "tp": self.tp,
+                "virtual_stages": self.virtual_stages,
+                "num_microbatches": self.num_microbatches,
+                "vocab_parallel": self.vocab_parallel,
+                "zero_stage": self.zero_stage,
+                "comm_overlap": self.comm_overlap,
+                "collective_precision": self.collective_precision,
+                "compressor": self.compressor}
+
+
+@dataclasses.dataclass
+class SearchSpace:
+    """Bounds of the cross-product.  ``None`` degree lists derive from
+    the topology (every divisor that keeps tp/pp within a slice);
+    shrink any field to bound the search, e.g.
+    ``SearchSpace(tp=(1, 2), num_microbatches=(4,))``."""
+
+    pp: Optional[Sequence[int]] = None
+    tp: Optional[Sequence[int]] = None
+    num_microbatches: Sequence[int] = (1, 2, 4)
+    vocab_parallel: Sequence[bool] = (False, True)
+    zero_stage: Sequence[int] = (0, 1, 2, 3)
+    comm_overlap: Sequence[Optional[str]] = (None, "matmul")
+    collective_precision: Sequence[Optional[str]] = (None, "bf16", "int8")
+    compressor: Sequence[str] = ("none", "bf16_ef")
+    # Merge the hand-enumerated zoo into the frontier as seeds, so the
+    # searched winner can never score below the zoo winner.
+    seed_zoo: bool = True
+
+
+@dataclasses.dataclass
+class Candidate:
+    """One synthesized candidate through the pipeline stages."""
+
+    name: str
+    config: Optional[KnobConfig]       # None for zoo seeds
+    strategy: object
+    spec: ResourceSpec                 # the derived (re-factored) spec
+    cost: Optional[StrategyCost] = None
+    lint_codes: tuple = ()
+
+
+@dataclasses.dataclass
+class SearchResult:
+    """Everything the search did, with no silent caps: every pruned
+    config is counted, lint prunes carry their codes."""
+
+    topology: dict
+    raw_configs: int = 0
+    skipped_unbuildable: int = 0
+    deduped: int = 0
+    pruned_dominated: int = 0
+    pruned_lint: int = 0
+    priced: int = 0
+    lint_pruned: list = dataclasses.field(default_factory=list)
+    frontier: list = dataclasses.field(default_factory=list)  # Candidate,
+    # best-first (feasible before infeasible, then comm time)
+
+    @property
+    def winner(self) -> Optional[Candidate]:
+        return self.frontier[0] if self.frontier else None
+
+    def counts(self) -> dict:
+        return {"raw_configs": self.raw_configs,
+                "skipped_unbuildable": self.skipped_unbuildable,
+                "deduped": self.deduped,
+                "pruned_dominated": self.pruned_dominated,
+                "pruned_lint": self.pruned_lint,
+                "priced": self.priced}
+
+    def report(self, top: int = 10) -> str:
+        """The search report: enumeration/prune/price counts, the
+        frontier top-``top`` with per-level comm breakdown, and the
+        winner's knob string."""
+        lines = [
+            f"search over {self.topology}: {self.raw_configs} raw "
+            f"configs, {self.skipped_unbuildable} unbuildable, "
+            f"{self.deduped} duplicate, {self.pruned_dominated} "
+            f"pruned by dominance, {self.pruned_lint} pruned by lint, "
+            f"{self.priced} priced"]
+        for name, codes in self.lint_pruned:
+            lines.append(f"  lint-pruned {name}: {', '.join(codes)}")
+        lines.append(
+            f"{'candidate':<34} {'t_ms':>8} {'ici_MB':>8} {'dcn_MB':>8} "
+            f"{'dcn_ms':>7} {'mem_GB':>7}  feasible")
+        for cand in self.frontier[:top]:
+            c = cand.cost
+            lines.append(
+                f"{cand.name:<34} {c.comm_time_s * 1e3:>8.3f} "
+                f"{(c.comm_bytes - c.dcn_bytes) / 1e6:>8.2f} "
+                f"{c.dcn_bytes / 1e6:>8.2f} {c.dcn_time_s * 1e3:>7.3f} "
+                f"{c.mem_bytes_per_device / 1e9:>7.2f}  "
+                f"{'yes' if c.feasible else 'NO'}")
+        if self.winner is not None:
+            lines.append(f"winner: {self.winner.name}")
+        return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------- #
+# Enumeration
+# --------------------------------------------------------------------------- #
+def _divisors(n: int) -> list[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def enumerate_configs(trainable: Trainable, resource_spec: ResourceSpec,
+                      space: Optional[SearchSpace] = None
+                      ) -> list[KnobConfig]:
+    """The raw cross-product for this (topology, trainable) pair.
+
+    Structural constraints applied here (not silent prunes — these
+    points can never lower at all):
+
+    * tp and pp never span slices: both factor the *within-slice*
+      device count; the dcn axis carries only data parallelism.
+    * stage-structured trainables take pp from the divisors of the
+      stage count (``virtual_stages`` absorbing the remainder);
+      generic trainables get the collective/GSPMD families (pp = 1).
+    * knobs with no boundary in a given point (vocab/overlap at tp=1,
+      a compressor under ZeRO) are not emitted — the plan linter would
+      flag each as a silent no-op or conflict.
+    """
+    space = space or SearchSpace()
+    shape = resource_spec.resolved_mesh_shape()
+    n = resource_spec.num_devices()
+    n_dcn = shape.get(const.DCN_AXIS,
+                      max(int(getattr(resource_spec, "num_slices", 1)), 1))
+    n_ici = n // max(n_dcn, 1)
+    stage_structured = getattr(trainable, "num_stages", None) is not None
+    num_stages = getattr(trainable, "num_stages", None)
+    has_shared = bool(getattr(trainable, "has_shared", False))
+
+    if stage_structured:
+        pp_choices = [p for p in (space.pp or _divisors(n_ici))
+                      if n_ici % p == 0 and num_stages % p == 0]
+    else:
+        pp_choices = [1]
+
+    configs = []
+    for pp in pp_choices:
+        tp_choices = [t for t in (space.tp or _divisors(n_ici // pp))
+                      if (n_ici // pp) % t == 0]
+        for tp in tp_choices:
+            dp_ici = n_ici // (pp * tp)
+            base = dict(dp_dcn=n_dcn, dp_ici=dp_ici, pp=pp, tp=tp,
+                        pipeline=stage_structured)
+            if stage_structured:
+                base["virtual_stages"] = num_stages // pp
+            mb_choices = (space.num_microbatches if stage_structured
+                          else (1,))
+            for M in mb_choices:
+                for vp in space.vocab_parallel:
+                    if vp and (tp <= 1 or not has_shared
+                               or not stage_structured):
+                        continue
+                    for zero in space.zero_stage:
+                        if not stage_structured and zero > 1 and tp > 1:
+                            continue
+                        for ov in space.comm_overlap:
+                            if ov and (tp <= 1 or not stage_structured):
+                                continue
+                            for prec in space.collective_precision:
+                                for comp in space.compressor:
+                                    if comp != "none" and (
+                                            zero or prec
+                                            or not stage_structured
+                                            and tp > 1):
+                                        continue
+                                    if prec and tp <= 1 and zero != 3 \
+                                            and not (zero == 0
+                                                     and comp == "none"):
+                                        continue
+                                    if prec and not stage_structured:
+                                        continue
+                                    configs.append(KnobConfig(
+                                        num_microbatches=M,
+                                        vocab_parallel=vp,
+                                        zero_stage=zero,
+                                        comm_overlap=ov,
+                                        collective_precision=prec,
+                                        compressor=comp, **base))
+    return configs
+
+
+# --------------------------------------------------------------------------- #
+# Dominance proxies
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass
+class _Stats:
+    stage_bytes: float
+    shared_bytes: float
+    hidden: int
+    tokens: Optional[int]
+    vocab_rows: int
+    n_leaves: int
+    dcn_penalty: float     # ici_gbps / dcn_gbps — DCN bytes in
+    # ici-equivalent units for the comm proxy
+    flops_rate: float
+
+
+def _stats(trainable, cm: CostModel) -> _Stats:
+    infos = list(trainable.var_infos())
+    shared = sum(i.byte_size for i in infos
+                 if i.name.startswith("shared/"))
+    total = sum(i.byte_size for i in infos)
+    vocab_rows = max((i.shape[0] for i in infos if len(i.shape) == 2),
+                     default=1)
+    bw_dcn, _ = cm._dcn_link()
+    return _Stats(
+        stage_bytes=float(total - shared), shared_bytes=float(shared),
+        hidden=cm._hidden_dim(trainable),
+        tokens=cm._hints(trainable)[0],
+        vocab_rows=int(vocab_rows), n_leaves=len(infos),
+        dcn_penalty=max(cm.chip.ici_gbps * 1e9 / max(bw_dcn, 1.0), 1.0),
+        flops_rate=cm.chip.peak_bf16_tflops * 1e12 * 0.4)
+
+
+def _proxies(cfg: KnobConfig, st: _Stats) -> tuple[float, float, float]:
+    """(comm-bytes, compute-seconds, memory-bytes) dominance proxies —
+    a coarse closed-form model used ONLY to drop points that are
+    pointwise no better than a sibling on the SAME mesh factorization;
+    ranking always comes from the real cost model.  DCN bytes count at
+    the ici/dcn bandwidth ratio so a cross-slice byte is never cheap."""
+    def ring(k: int) -> float:
+        return 2.0 * (k - 1) / k if k > 1 else 0.0
+
+    dp = cfg.dp_ici * cfg.dp_dcn
+    M, V = cfg.num_microbatches, cfg.virtual_stages
+    stage_dev = st.stage_bytes / (cfg.pp * cfg.tp)
+    shared_dev = st.shared_bytes / (cfg.tp if cfg.vocab_parallel else 1)
+    per_dev = stage_dev + shared_dev
+
+    grad_f = {"none": 1.0, "bf16_ef": 0.5, "int8_ef": 0.5,
+              "int8_ring": 0.25, "powersgd": 0.02}.get(cfg.compressor, 1.0)
+    if cfg.collective_precision and cfg.zero_stage == 0 \
+            and cfg.compressor == "none":
+        grad_f = 0.5
+    wire_f = 0.5 if cfg.collective_precision else 1.0
+
+    sync_f = ring(cfg.dp_ici) + st.dcn_penalty * ring(cfg.dp_dcn) \
+        / max(cfg.dp_ici, 1)
+    comm = grad_f * sync_f * per_dev
+    tokens_local = (st.tokens / dp) if st.tokens else 0.0
+    if cfg.tp > 1 and tokens_local:
+        comm += 2.0 * ring(cfg.tp) * V * tokens_local * st.hidden * 2.0 \
+            * wire_f
+        if cfg.vocab_parallel:
+            comm += 2.0 * ring(cfg.tp) * tokens_local \
+                * (st.hidden + 3.0) * 4.0 * wire_f
+    if cfg.pipeline and tokens_local and cfg.pp > 1:
+        T = M * V + cfg.pp - 1
+        comm += 2.0 * T * (tokens_local / M) * st.hidden * 2.0
+
+    launches = 2.0
+    if cfg.zero_stage >= 3:
+        launches += st.n_leaves * V
+    if cfg.tp > 1:
+        launches += 2.0 * M * V
+    if cfg.pipeline and cfg.pp > 1:
+        launches += 2.0 * (M * V + cfg.pp - 1)
+    compute = COLLECTIVE_ALPHA * launches
+    if cfg.collective_precision and cfg.tp > 1 and tokens_local:
+        compute += 2.0 * V * tokens_local * st.hidden * 1e-10
+    if cfg.pipeline and cfg.pp > 1 and st.tokens:
+        bubble = (cfg.pp - 1) / (M * V + cfg.pp - 1)
+        model_elems = (st.stage_bytes + st.shared_bytes) / 4.0
+        compute += bubble * 2.0 * st.tokens * model_elems \
+            / (dp * cfg.pp * cfg.tp) / st.flops_rate
+
+    opt_div = dp if cfg.zero_stage >= 1 else 1
+    grad_div = dp if cfg.zero_stage >= 2 else 1
+    param_div = dp if cfg.zero_stage >= 3 else 1
+    mem = per_dev * (1.0 / param_div + 1.0 / grad_div + 2.0 / opt_div)
+    if tokens_local:
+        mem += tokens_local * st.vocab_rows * 4.0 \
+            / (cfg.tp if cfg.vocab_parallel else 1)
+    return comm, compute, mem
+
+
+def _dominated(a: tuple, b: tuple) -> bool:
+    """True when ``a`` is (weakly) Pareto-dominated by ``b``."""
+    return all(y <= x for x, y in zip(a, b)) \
+        and any(y < x for x, y in zip(a, b))
+
+
+def cm_key(spec: ResourceSpec) -> tuple:
+    """Cache key for per-mesh cost models: one factorization, one
+    model."""
+    return tuple(sorted(spec.mesh_shape.items()))
+
+
+# --------------------------------------------------------------------------- #
+# The search
+# --------------------------------------------------------------------------- #
+def search_strategies(trainable: Trainable,
+                      resource_spec: ResourceSpec,
+                      space: Optional[SearchSpace] = None, *,
+                      cost_model: Optional[CostModel] = None,
+                      global_batch: Optional[int] = None,
+                      seed_builders: Optional[Sequence] = None,
+                      **cost_model_kwargs) -> SearchResult:
+    """Run the full enumerate → dominance-prune → lint → price pipeline
+    for one (trainable, topology) pair; see the module docstring.
+
+    ``global_batch`` (when known, e.g. from AutoStrategy's
+    ``example_batch``) screens pipeline points whose
+    ``replicas × num_microbatches`` does not divide the batch — the
+    same screen AutoStrategy applies to the zoo.
+
+    ``seed_builders`` replaces :func:`default_candidates` as the seed
+    list when ``space.seed_zoo`` is on (how ``AutoStrategy(search=True,
+    candidates=[...])`` keeps honoring an explicit candidate list).
+
+    Returns a :class:`SearchResult` whose frontier is best-first; the
+    winner's strategy carries its mesh factorization in
+    ``graph_config.mesh_axes``, which ``AutoDist`` honors at lowering.
+    """
+    if not isinstance(resource_spec, ResourceSpec):
+        resource_spec = ResourceSpec(resource_spec)
+    space = space or SearchSpace()
+    cm = cost_model or CostModel(resource_spec, **cost_model_kwargs)
+    stage_structured = getattr(trainable, "num_stages", None) is not None
+
+    configs = enumerate_configs(trainable, resource_spec, space)
+    result = SearchResult(topology=dict(resource_spec.resolved_mesh_shape()),
+                          raw_configs=len(configs))
+
+    # ---- build ------------------------------------------------------- #
+    built: list[Candidate] = []
+    seen_content: set = set()
+    for cfg in configs:
+        if global_batch is not None and cfg.pipeline:
+            repl = cfg.dp_dcn * cfg.dp_ici
+            if global_batch % max(repl * cfg.num_microbatches, 1):
+                result.skipped_unbuildable += 1
+                continue
+        try:
+            derived = resource_spec.with_mesh(cfg.mesh())
+            builder = builder_from_knobs(cfg.knobs(),
+                                         stage_structured=stage_structured)
+            strategy = builder.build(trainable, derived)
+        except ValueError as e:
+            logging.debug("search config %s skipped: %s",
+                          cfg.knob_string(), e)
+            result.skipped_unbuildable += 1
+            continue
+        if not stage_structured and cfg.tp > 1 and not any(
+                nc.partitioner is not None and nc.partitioner.spec
+                and any(const.MODEL_AXIS in (e if isinstance(
+                    e, (list, tuple)) else [e])
+                        for e in nc.partitioner.spec)
+                for nc in strategy.node_configs):
+            # No variable matched the TP rule table: the "tp" plan is a
+            # degenerate replicas=1 replication that idles every device
+            # off the model axis yet prices near-zero comm — the
+            # Pipeline builder raises for the stage analog; synthesized
+            # GSPMD candidates get the same structural rejection here.
+            logging.debug("search config %s skipped: no variable "
+                          "matched the TP rules", cfg.knob_string())
+            result.skipped_unbuildable += 1
+            continue
+        content = json.dumps([n.to_dict() for n in strategy.node_configs]
+                             + [strategy.graph_config.to_dict()],
+                             sort_keys=True)
+        if content in seen_content:
+            result.deduped += 1
+            continue
+        seen_content.add(content)
+        built.append(Candidate(name=cfg.knob_string(), config=cfg,
+                               strategy=strategy, spec=derived))
+
+    # ---- dominance prune (within one mesh factorization) ------------- #
+    # Deliberately AFTER building: only a config that actually builds
+    # may dominate (an unbuildable dominator would orphan a buildable
+    # point).  The build pass is cheap (no compiles; ~1ms/config), so
+    # correctness wins over pruning earlier.
+    st = _stats(trainable, cm)
+    by_mesh: dict = {}
+    for cand in built:
+        by_mesh.setdefault(cand.config.mesh_key(), []).append(cand)
+    survivors: list[Candidate] = []
+    for group in by_mesh.values():
+        proxies = [_proxies(c.config, st) for c in group]
+        for i, cand in enumerate(group):
+            if any(j != i and _dominated(proxies[i], proxies[j])
+                   for j in range(len(group))):
+                result.pruned_dominated += 1
+            else:
+                survivors.append(cand)
+
+    # ---- zoo seeds --------------------------------------------------- #
+    if space.seed_zoo:
+        from autodist_tpu.simulator.auto_strategy import default_candidates
+
+        builders = (list(seed_builders) if seed_builders is not None
+                    else default_candidates())
+        seen_names: dict = {}
+        for builder in builders:
+            name = type(builder).__name__
+            seen_names[name] = seen_names.get(name, 0) + 1
+            if seen_names[name] > 1:
+                name = f"{name}#{seen_names[name]}"
+            if name.startswith("SequenceParallel") \
+                    and not getattr(trainable, "sequence_ready", False):
+                continue   # AutoStrategy's own zoo screen
+            try:
+                strategy = builder.build(trainable, resource_spec)
+            except ValueError:
+                continue
+            if stage_structured != (strategy.graph_config.lowering
+                                    == "pipeline"):
+                # A stage-structured trainable lowers through the
+                # pipeline backend only (and a generic one never does);
+                # a seed that cannot lower must not reach the frontier.
+                continue
+            if (global_batch is not None
+                    and strategy.graph_config.lowering == "pipeline"):
+                M = int(strategy.graph_config.parallel.get(
+                    "num_microbatches", 1))
+                repl = max(strategy.graph_config.replicas, 1)
+                if global_batch % max(repl * M, 1):
+                    continue
+            content = json.dumps(
+                [n.to_dict() for n in strategy.node_configs]
+                + [strategy.graph_config.to_dict()], sort_keys=True)
+            if content in seen_content:
+                result.deduped += 1
+                continue
+            seen_content.add(content)
+            survivors.append(Candidate(name=f"zoo:{name}", config=None,
+                                       strategy=strategy,
+                                       spec=resource_spec))
+
+    # ---- plan lint (ERROR ⇒ pruned, counted, reported) ---------------- #
+    from autodist_tpu.analysis import lint_plan
+
+    linted: list[Candidate] = []
+    for cand in survivors:
+        report = lint_plan(cand.strategy, resource_spec=cand.spec,
+                           trainable=trainable)
+        if report.errors:
+            codes = sorted({d.code for d in report.errors})
+            result.pruned_lint += 1
+            result.lint_pruned.append((cand.name, codes))
+            logging.warning("search candidate %s pruned by plan lint: %s",
+                            cand.name, codes)
+            continue
+        cand.lint_codes = tuple(sorted(report.codes()))
+        linted.append(cand)
+
+    # ---- price ------------------------------------------------------- #
+    # Each candidate prices against a model bound to its OWN mesh
+    # factorization (the cost model reads pp/tp/dcn from its spec, not
+    # from the strategy): pricing a re-factored candidate with the
+    # original spec's model would silently ignore its degrees.  One
+    # model per distinct mesh, cached.
+    models: dict = {cm_key(resource_spec): cm}
+    for cand in linted:
+        key = cm_key(cand.spec)
+        if key not in models:
+            models[key] = cm.with_spec(cand.spec)
+        try:
+            cand.cost = models[key].strategy_cost(trainable,
+                                                  cand.strategy)
+        except SpecMeshMismatch as e:
+            logging.debug("search candidate %s unpriceable: %s",
+                          cand.name, e)
+            result.skipped_unbuildable += 1
+            continue
+        result.priced += 1
+        result.frontier.append(cand)
+    result.frontier.sort(
+        key=lambda c: (c.cost.score, c.cost.num_collectives))
+    return result
+
+
+def program_lint_winner(result: SearchResult, trainable: Trainable,
+                        batch=None, vocab_size: Optional[int] = None
+                        ) -> "object":
+    """Lower + compile the searched winner on its own mesh and run the
+    program linter with the rule set its Strategy IR implies — the
+    same gate ``tools/lint_strategy.py --zoo`` applies to every zoo
+    candidate.  Returns the :class:`~autodist_tpu.analysis.diagnostics.
+    LintReport` (callers gate on ``report.errors``)."""
+    import jax
+
+    from autodist_tpu.analysis import lint_program, rules_for_strategy
+    from autodist_tpu.analysis.facts import compiled_text
+    from autodist_tpu.autodist import AutoDist
+
+    winner = result.winner
+    if winner is None:
+        raise ValueError("search produced no priced candidate")
+    runner = AutoDist(winner.spec, "AllReduce").build(trainable,
+                                                      winner.strategy)
+    try:
+        text = compiled_text(runner.lowered.step_fn, runner.state,
+                             runner._place_batch(batch),
+                             jax.random.PRNGKey(0))
+    finally:
+        runner.close()
+    rules = rules_for_strategy(winner.strategy, vocab_size=vocab_size)
+    return lint_program(text, rules, where=winner.name)

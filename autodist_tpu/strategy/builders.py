@@ -344,3 +344,101 @@ def create(name: str, **kw) -> StrategyBuilder:
             f"unknown strategy builder {name!r}; have "
             f"{sorted(BUILDERS) + ['AutoStrategy', 'Sharded', 'TensorParallel', 'FSDPSharded', 'SequenceParallel', 'Pipeline', 'ExpertParallel']}")
     return BUILDERS[name](**kw)
+
+
+def builder_from_knobs(knobs, *, stage_structured: bool = True
+                       ) -> StrategyBuilder:
+    """Programmatic builder construction from a knob dict — the bridge
+    the topology-aware search (:mod:`autodist_tpu.simulator.search`)
+    uses to turn one point of the ``(pp, tp, vocab_parallel,
+    zero_stage, comm_overlap, collective_precision, num_microbatches,
+    compressor)`` cross-product into a buildable strategy.
+
+    ``knobs`` keys (all optional; sensible no-op defaults): ``pp``,
+    ``tp``, ``virtual_stages``, ``num_microbatches``,
+    ``vocab_parallel``, ``zero_stage``, ``comm_overlap``,
+    ``collective_precision`` (a bare precision string — resolved onto
+    only the boundary classes the knob set actually emits, so the plan
+    linter never sees an orphan slot), ``compressor``.
+
+    Stage-structured trainables map onto :class:`~autodist_tpu.strategy.
+    parallel_builders.Pipeline`; generic trainables onto the
+    collective/GSPMD families (``tp>1`` → ``TensorParallel``,
+    ``zero_stage`` s → ``ZeRO(stage=s)`` — PS / PartitionedAR /
+    PartitionedPS per the classic ladder — else ``AllReduce``).
+    Unrealizable combinations raise ``ValueError`` so a search loop can
+    skip them the way AutoStrategy skips unbuildable zoo candidates.
+    """
+    k = dict(knobs or {})
+    tp = max(int(k.get("tp", 1) or 1), 1)
+    zero_stage = int(k.get("zero_stage", 0) or 0)
+    compressor = k.get("compressor") or "none"
+    vocab_parallel = bool(k.get("vocab_parallel", False))
+    comm_overlap = k.get("comm_overlap") or None
+    prec = k.get("collective_precision") or None
+
+    # Resolve a bare precision string onto only the boundary classes
+    # this knob set emits (a full-slot policy on a plan without the
+    # matching boundary is the ADT020 silent no-op the linter flags).
+    precision = None
+    if prec:
+        slots = {}
+        if tp > 1:
+            slots["tp_psum"] = prec
+            if vocab_parallel:
+                slots["vocab_stats"] = prec
+        if zero_stage >= 3:
+            slots["zero3_gather"] = prec
+        if zero_stage == 0 and compressor == "none":
+            slots["grad"] = prec
+        if not slots:
+            raise ValueError(
+                f"collective_precision={prec!r} touches no boundary of "
+                f"this knob set (tp={tp}, zero_stage={zero_stage}, "
+                f"compressor={compressor!r})")
+        precision = slots
+
+    if stage_structured:
+        from autodist_tpu.strategy.parallel_builders import Pipeline
+
+        return Pipeline(
+            num_microbatches=max(int(k.get("num_microbatches", 1) or 1),
+                                 1),
+            virtual_stages=max(int(k.get("virtual_stages", 1) or 1), 1),
+            tensor_parallel=tp,
+            vocab_parallel=vocab_parallel,
+            comm_overlap=comm_overlap,
+            zero_stage=zero_stage or None,
+            compressor=compressor,
+            collective_precision=precision)
+
+    # Generic (non-stage-structured) trainable: the collective/GSPMD
+    # families.  Knobs with no realization here are rejected, not
+    # silently dropped.
+    for knob, value in (("vocab_parallel", vocab_parallel),
+                        ("comm_overlap", comm_overlap),
+                        ("collective_precision", prec),
+                        ("num_microbatches",
+                         int(k.get("num_microbatches", 1) or 1) > 1)):
+        if value:
+            raise ValueError(
+                f"{knob} has no realization outside the pipeline "
+                "lowering")
+    if tp > 1:
+        from autodist_tpu.strategy.gspmd_builders import TensorParallel
+
+        if zero_stage > 1:
+            raise ValueError(
+                "zero_stage>1 with GSPMD tensor parallelism: use "
+                "ZeRO (tp=1) or the pipeline lowering")
+        if compressor != "none":
+            raise ValueError(
+                "compressor has no realization under GSPMD tensor "
+                "parallelism (XLA owns the emitted collectives)")
+        return TensorParallel(zero_stage=zero_stage or None)
+    if zero_stage:
+        if compressor != "none":
+            raise ValueError("ZeRO sync reduces at full precision; "
+                             "compression is an AllReduce knob")
+        return ZeRO(stage=zero_stage)
+    return AllReduce(compressor=compressor)

@@ -112,10 +112,18 @@ def drift_report(strategy=None, cost_model=None,
                                         0.0),
             "quant_dq_time_s": getattr(predicted, "quant_dq_time_s",
                                        0.0),
+            "dcn_bytes": getattr(predicted, "dcn_bytes", 0.0),
+            "dcn_time_s": getattr(predicted, "dcn_time_s", 0.0),
         }
 
     comm_s = float(predicted.get("comm_time_s") or 0.0)
     overlap_s = float(predicted.get("overlap_time_s") or 0.0)
+    # Per-level comm terms of the hierarchical network model: the
+    # cross-slice (DCN) share of comm_time_s/comm_bytes, broken out so
+    # the calibration fit below can propose dcn_gbps independently of
+    # ici_gbps.
+    dcn_s = float(predicted.get("dcn_time_s") or 0.0)
+    pred_dcn_bytes = float(predicted.get("dcn_bytes") or 0.0)
     pred_wire_saved = float(predicted.get("wire_bytes_saved") or 0.0)
     pred_qdq_s = float(predicted.get("quant_dq_time_s") or 0.0)
     pred_mem = float(predicted.get("mem_bytes_per_device") or 0.0)
@@ -125,10 +133,17 @@ def drift_report(strategy=None, cost_model=None,
 
     compute_s = None
     wire_s = None
+    dcn_wire_s = None
     if cost_model is not None:
         bw_link = float(cost_model.link_profile.get(
             "ici_gbps", cost_model.chip.ici_gbps)) * 1e9
-        wire_s = float(predicted.get("comm_bytes") or 0.0) / bw_link
+        # comm_bytes totals both levels; each level's wire term is fit
+        # against its own bandwidth constant.
+        wire_s = max(float(predicted.get("comm_bytes") or 0.0)
+                     - pred_dcn_bytes, 0.0) / bw_link
+        if pred_dcn_bytes and hasattr(cost_model, "_dcn_link"):
+            bw_dcn, _ = cost_model._dcn_link()
+            dcn_wire_s = pred_dcn_bytes / bw_dcn
         if flops_per_step:
             from autodist_tpu.simulator import cost_model as _cm
 
@@ -162,6 +177,12 @@ def drift_report(strategy=None, cost_model=None,
         # quantized-collectives win.
         "wire_bytes_saved": pred_wire_saved or None,
         "quant_dq_time_s": pred_qdq_s or None,
+        # Per-level comm terms (hierarchical network model): the
+        # cross-slice share of comm_time_s / comm_bytes, priced at the
+        # DCN constants — what a multi-slice hardware window joins
+        # against measured step time to fit dcn_gbps.
+        "comm_time_dcn_s": dcn_s or None,
+        "dcn_bytes": pred_dcn_bytes or None,
         "comm_bytes": predicted.get("comm_bytes"),
         "num_collectives": predicted.get("num_collectives"),
         "feasible": predicted.get("feasible"),
@@ -209,20 +230,37 @@ def drift_report(strategy=None, cost_model=None,
 
     # ---- calibration proposal ---------------------------------------- #
     proposal: dict[str, Any] = {}
-    if (cost_model is not None and wire_s and residual_comm
-            and residual_comm > 0):
-        # First-order bandwidth fit: attribute the whole comm residual to
-        # the wire term.  measured_wire ≈ residual - launch overhead;
+    if (cost_model is not None and residual_comm and residual_comm > 0
+            and comm_s > 0):
+        # First-order per-level bandwidth fit: split the comm residual
+        # across the levels in proportion to their predicted shares,
+        # then attribute each level's residual to its wire term.
+        # measured_wire ≈ residual - launch overhead;
         # bytes/bw_new = residual ⇒ bw_new = bw_old · wire_s/residual.
-        old_ici = float(cost_model.link_profile.get(
-            "ici_gbps", cost_model.chip.ici_gbps))
-        new_ici = old_ici * wire_s / residual_comm
-        if abs(new_ici - old_ici) / old_ici > _PROPOSAL_THRESHOLD:
-            # significant digits, not decimal places: a CPU-mesh fit can
-            # land orders of magnitude below 1 Gbps and must not round
-            # to an (unusable) 0.0
-            proposal.setdefault("link", {})["ici_gbps"] = \
-                float(f"{new_ici:.4g}")
+        # With no dcn term the ici share is 1 — exactly the single-level
+        # fit this report always made.
+        ici_residual = residual_comm * max(comm_s - dcn_s, 0.0) / comm_s
+        if wire_s and ici_residual > 0:
+            old_ici = float(cost_model.link_profile.get(
+                "ici_gbps", cost_model.chip.ici_gbps))
+            new_ici = old_ici * wire_s / ici_residual
+            if abs(new_ici - old_ici) / old_ici > _PROPOSAL_THRESHOLD:
+                # significant digits, not decimal places: a CPU-mesh fit
+                # can land orders of magnitude below 1 Gbps and must not
+                # round to an (unusable) 0.0
+                proposal.setdefault("link", {})["ici_gbps"] = \
+                    float(f"{new_ici:.4g}")
+        dcn_residual = residual_comm * dcn_s / comm_s
+        if dcn_wire_s and dcn_residual > 0:
+            # The dcn analog of the ici fit, proposed the same way —
+            # a two-slice hardware window turns measured grad-sync time
+            # into a measured "link" dcn_gbps mechanically.
+            old_dcn = float(cost_model.link_profile.get(
+                "dcn_gbps", getattr(cost_model.chip, "dcn_gbps", 5.0)))
+            new_dcn = old_dcn * dcn_wire_s / dcn_residual
+            if abs(new_dcn - old_dcn) / old_dcn > _PROPOSAL_THRESHOLD:
+                proposal.setdefault("link", {})["dcn_gbps"] = \
+                    float(f"{new_dcn:.4g}")
     if (cost_model is not None and compute_s and meas_step_s is not None):
         measured_compute = meas_step_s - comm_s
         if measured_compute > 0:
@@ -238,8 +276,9 @@ def drift_report(strategy=None, cost_model=None,
         proposal["note"] = (
             "first-order fit from ONE measured config; merge into "
             "calibration.json's \"link\" section only after a second "
-            "config reproduces it (hop_alpha_s needs two payload sizes "
-            "to separate from bandwidth, and is left untouched)")
+            "config reproduces it (hop_alpha_s/dcn_alpha_s need two "
+            "payload sizes to separate from bandwidth, and are left "
+            "untouched)")
 
     report = {
         "kind": "drift",
@@ -267,6 +306,8 @@ def drift_report(strategy=None, cost_model=None,
         tel.gauge("memory/grad_shard_bytes").set(pred_grad_shard)
     if pred_wire_saved > 0:
         tel.gauge("comm/wire_bytes_saved").set(pred_wire_saved)
+    if pred_dcn_bytes > 0:
+        tel.gauge("comm/dcn_bytes").set(pred_dcn_bytes)
 
     out_dir = out_dir or tel.out_dir
     if out_dir and tel.enabled:

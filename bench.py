@@ -364,6 +364,29 @@ def _bench_quant(dog):
             "provenance": _provenance()}))
         sys.exit(4)
     ratio = dt_fp32 / dt_int8 if dt_int8 > 0 else 0.0
+    # Topology-aware search provenance: what the searched frontier
+    # would elect for this same (trainable, topology) — so a hardware
+    # window can compare the measured config against the search winner
+    # mechanically (tools/lint_strategy.py --search is the CI analog).
+    # Plan-level only (no extra compiles); failure never eats the
+    # measurement.
+    try:
+        from autodist_tpu.simulator.search import search_strategies
+
+        t_search = make_pipeline_lm_trainable(
+            cfg, optax.adam(1e-3), jax.random.PRNGKey(0))
+        t_search.tokens_per_step = batch * cfg.max_len
+        sres = search_strategies(t_search, ResourceSpec(spec),
+                                 global_batch=batch)
+        search_rec = dict(sres.counts())
+        if sres.winner is not None:
+            search_rec["winner"] = sres.winner.name
+            search_rec["winner_comm_time_s"] = round(
+                sres.winner.cost.comm_time_s, 9)
+            search_rec["winner_dcn_time_s"] = round(
+                sres.winner.cost.dcn_time_s, 9)
+    except Exception as e:   # provenance only — never fail the record
+        search_rec = {"error": f"{type(e).__name__}: {e}"}
     record = {
         "metric": "quantized_collectives_speedup",
         "value": round(ratio, 4), "unit": "ratio",
@@ -374,6 +397,7 @@ def _bench_quant(dog):
         "step_ms_int8": round(dt_int8 * 1e3, 3),
         "predicted_wire_bytes_saved": round(cost_q.wire_bytes_saved, 1),
         "predicted_qdq_ms": round(cost_q.quant_dq_time_s * 1e3, 4),
+        "search": search_rec,
         "scored": True, "provenance": _provenance(),
     }
     dog.disarm()

@@ -78,6 +78,21 @@ def main():
                     help="deprecated alias for --zero-stage 1")
     ap.add_argument("--remat", action="store_true",
                     help="jax.checkpoint each chunk (memory for compute)")
+    ap.add_argument("--auto-search", action="store_true",
+                    help="replace the explicit knob flags with the "
+                         "topology-aware strategy search: enumerate "
+                         "the (dp, pp, tp, vocab, zero, overlap, "
+                         "precision, microbatch, compressor) "
+                         "cross-product for the visible topology, "
+                         "print the search report (configs enumerated/"
+                         "pruned/priced, frontier top-10 with "
+                         "per-level comm breakdown, winner knob "
+                         "string), and train the winner")
+    ap.add_argument("--num-slices", type=int, default=1,
+                    help="declare a multi-slice topology (with "
+                         "--auto-search): the outer dp axis rides DCN "
+                         "and the search keeps tp/pp within a slice; "
+                         "simulated CPU meshes lower it too")
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--profile-dir", default=None,
@@ -197,13 +212,42 @@ def main():
     tel_dir = args.telemetry_dir or args.profile_dir
     if tel_dir:
         telemetry.configure(out_dir=tel_dir)
-    ad = AutoDist({"topology": {"num_devices": dp * pp * tp},
-                   "mesh": mesh}, builder)
-    # The strategy is kept in hand (instead of letting build() resolve it
-    # internally) so the drift report below can join the cost model's
-    # prediction for exactly the program that ran.
-    strategy = ad.build_or_load_strategy(trainable)
-    runner = ad.build(trainable, strategy)
+    if args.auto_search:
+        # The search owns the factorization: the spec declares only the
+        # topology (device count, slice count); every (dcn, data, pipe,
+        # model) mesh the search elects carries in the winner
+        # strategy's mesh_axes, which AutoDist honors at lowering.
+        topo = {"num_devices": dp * pp * tp}
+        if args.num_slices > 1:
+            topo["num_slices"] = args.num_slices
+        ad = AutoDist({"topology": topo}, builder)
+        from autodist_tpu.simulator.search import search_strategies
+
+        result = search_strategies(trainable, ad.resource_spec,
+                                   global_batch=args.batch)
+        print(result.report())
+        if result.winner is None:
+            raise SystemExit("auto-search: no candidate priced — "
+                             "widen the SearchSpace or check the "
+                             "topology")
+        if not result.winner.cost.feasible:
+            raise SystemExit(
+                f"auto-search: best candidate {result.winner.name} "
+                f"needs {result.winner.cost.mem_bytes_per_device / 1e9:.2f}"
+                " GB/device — nothing fits in memory")
+        strategy = result.winner.strategy
+        # Lint/price against the winner's own factorization below.
+        cost_spec = result.winner.spec
+        runner = ad.build(trainable, strategy)
+    else:
+        ad = AutoDist({"topology": {"num_devices": dp * pp * tp},
+                       "mesh": mesh}, builder)
+        # The strategy is kept in hand (instead of letting build()
+        # resolve it internally) so the drift report below can join the
+        # cost model's prediction for exactly the program that ran.
+        strategy = ad.build_or_load_strategy(trainable)
+        cost_spec = ad.resource_spec
+        runner = ad.build(trainable, strategy)
 
     # Plan lint at build: every silent degrade (ZeRO on a tp shard,
     # vocab no-op at tp=1, orphan precision slot, ...) surfaces as a
@@ -212,7 +256,7 @@ def main():
     from autodist_tpu import analysis
 
     plan_report = analysis.lint_plan(
-        strategy, resource_spec=ad.resource_spec, trainable=trainable,
+        strategy, resource_spec=cost_spec, trainable=trainable,
         lowered=getattr(runner, "lowered", None))
     if plan_report.diagnostics:
         print(f"plan lint ({len(plan_report.errors)} error(s), "
@@ -222,20 +266,25 @@ def main():
     else:
         print("plan lint: clean")
 
-    print(f"pipe={pp} x virtual={args.virtual_stages} "
-          f"(C={C} chunks), dp={dp}, tp={tp}, M={args.microbatches}, "
-          f"comm_overlap={overlap}, vocab_parallel={args.vocab_parallel}, "
-          f"zero_stage={zero_stage}, "
-          f"collective_precision={precision or 'fp32'}; "
-          f"schedule bubble = "
-          f"{bubble_fraction(args.microbatches, pp, args.virtual_stages):.3f}")
+    if args.auto_search:
+        print(f"auto-search winner: {result.winner.name} "
+              f"(mesh {strategy.graph_config.mesh_axes})")
+    else:
+        print(f"pipe={pp} x virtual={args.virtual_stages} "
+              f"(C={C} chunks), dp={dp}, tp={tp}, M={args.microbatches}, "
+              f"comm_overlap={overlap}, "
+              f"vocab_parallel={args.vocab_parallel}, "
+              f"zero_stage={zero_stage}, "
+              f"collective_precision={precision or 'fp32'}; "
+              f"schedule bubble = "
+              f"{bubble_fraction(args.microbatches, pp, args.virtual_stages):.3f}")
 
     from autodist_tpu.simulator.cost_model import CostModel
 
     # Predicted peak-logits buffer (the memory term vocab parallelism
     # divides by tp) rides every step record + a gauge, so a hardware
     # window's metrics.jsonl can join it against measured HBM.
-    cost = CostModel(ad.resource_spec).strategy_cost(trainable, strategy)
+    cost = CostModel(cost_spec).strategy_cost(trainable, strategy)
     peak_logits = cost.peak_logits_bytes or None
     if peak_logits:
         telemetry.get().gauge("memory/peak_logits_bytes").set(peak_logits)
@@ -289,11 +338,29 @@ def main():
     if tel_dir:
         from autodist_tpu.utils.profiling import memory_summary
 
-        telemetry.annotate(mesh=mesh, microbatches=args.microbatches,
-                           virtual_stages=args.virtual_stages,
-                           comm_overlap=overlap, batch=args.batch,
-                           tensor_parallel=tp, zero_stage=zero_stage,
-                           vocab_parallel=args.vocab_parallel,
+        # The manifest must describe the program that RAN: under
+        # --auto-search the winner's Strategy-IR knobs, not the CLI
+        # flags (which only sized the topology there).
+        if args.auto_search:
+            par = strategy.graph_config.parallel or {}
+            knobs = dict(
+                microbatches=int(par.get("num_microbatches", 1) or 1),
+                virtual_stages=int(par.get("virtual_stages", 1) or 1),
+                comm_overlap=par.get("comm_overlap") or None,
+                tensor_parallel=int(par.get("tensor_parallel", 1) or 1),
+                zero_stage=int(par.get("zero_stage", 0) or 0),
+                vocab_parallel=bool(par.get("vocab_parallel", False)),
+                remat=bool(par.get("remat", False)))
+        else:
+            knobs = dict(microbatches=args.microbatches,
+                         virtual_stages=args.virtual_stages,
+                         comm_overlap=overlap, tensor_parallel=tp,
+                         zero_stage=zero_stage,
+                         vocab_parallel=args.vocab_parallel,
+                         remat=args.remat)
+        telemetry.annotate(mesh=dict(strategy.graph_config.mesh_axes),
+                           auto_search=args.auto_search,
+                           batch=args.batch, **knobs,
                            # The normalized per-boundary dict, so
                            # `tools/telemetry_report.py --check` can
                            # gate the precision/<boundary>_bits gauges
@@ -303,9 +370,9 @@ def main():
                            peak_logits_bytes=peak_logits,
                            param_shard_bytes=cost.param_shard_bytes,
                            grad_shard_bytes=cost.grad_shard_bytes,
-                           remat=args.remat, step_summary=summary)
+                           step_summary=summary)
         report = telemetry.drift_report(
-            strategy, CostModel(ad.resource_spec),
+            strategy, CostModel(cost_spec),
             {"step": summary, "memory": memory_summary(),
              "examples_per_sec": summary.get("examples_per_sec")},
             trainable=trainable)

@@ -145,6 +145,17 @@ def test_pipeline_train_interleaved():
     assert "virtual=2" in out and "bubble" in out and "loss=" in out
 
 
+def test_pipeline_train_auto_search():
+    """--auto-search on a simulated two-slice topology: the search
+    report prints (counts, per-level frontier, winner knob string) and
+    the elected plan trains."""
+    out = run_script("examples/pipeline_train.py", "--steps", "3",
+                     "--stages", "2", "--hidden", "16", "--batch", "16",
+                     "--auto-search", "--num-slices", "2", timeout=300)
+    assert "raw configs" in out and "pruned by dominance" in out
+    assert "auto-search winner: dcn2_" in out and "loss=" in out
+
+
 def test_moe_train_expert_parallel():
     out = run_script("examples/moe_train.py", "--steps", "3",
                      "--experts", "8", "--layers", "1", "--hidden", "32",

@@ -125,10 +125,12 @@ def test_lint_strategy_files_mode(tmp_path):
 
 
 def test_lint_strategy_zoo_plan_sweep_subprocess(tmp_path):
-    """The CI gate: plan-lint the ENTIRE candidate zoo in a fresh
-    process.  Budget guard: --plan-only --no-decode skips every
-    compile (the program level is covered in-process by
-    test_analysis.py over the shared memoized corpus)."""
+    """The CI gate: plan-lint the ENTIRE candidate zoo AND the
+    topology-aware searched frontier in a fresh process.  Budget
+    guard: --plan-only --no-decode skips every compile (the program
+    level is covered in-process by test_analysis.py over the shared
+    memoized corpus, and the searched winner's program lint by
+    test_search.py)."""
     out = tmp_path / "zoo.json"
     env = dict(os.environ)
     env.update({"JAX_PLATFORMS": "cpu",
@@ -136,7 +138,7 @@ def test_lint_strategy_zoo_plan_sweep_subprocess(tmp_path):
                 "PYTHONPATH": REPO})
     proc = subprocess.run(
         [sys.executable, os.path.join(TOOLS, "lint_strategy.py"),
-         "--zoo", "--check", "--plan-only", "--no-decode",
+         "--zoo", "--search", "--check", "--plan-only", "--no-decode",
          "--json", str(out)],
         capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
     assert proc.returncode == 0, (proc.stdout[-2000:],
@@ -151,6 +153,21 @@ def test_lint_strategy_zoo_plan_sweep_subprocess(tmp_path):
         errors = [d for d in rec["plan"]
                   if d["severity"] == "error"]
         assert not errors, (rec["candidate"], errors)
+    # ... and the searched frontier: every fixture topology (incl. the
+    # two-slice one) enumerated a real cross-product, synthesized
+    # nothing unlintable, and elected a winner.
+    fixtures = {r["fixture"]: r for r in report["search"]}
+    assert "pipeline_lm@2slice" in fixtures
+    for rec in fixtures.values():
+        assert rec["counts"]["priced"] > 0, rec
+        assert rec["lint_pruned"] == [], rec
+        assert rec["survivor_errors"] == 0, rec
+        assert rec["winner"], rec
+    assert fixtures["pipeline_lm@2slice"]["counts"]["raw_configs"] >= 300
+    # the two-slice frontier's cross-slice term is priced (at DCN
+    # constants): some candidate carries a nonzero dcn time
+    assert any(c["dcn_time_s"] > 0
+               for c in fixtures["pipeline_lm@2slice"]["frontier"])
 
 
 def test_lint_strategy_max_programs_budget_is_loud():

@@ -215,6 +215,93 @@ def lint_zoo(max_programs=None, plan_only=False, decode=True,
     return n_err, n_warn, results
 
 
+def _search_fixtures():
+    """Topologies the searched-frontier sweep covers: the zoo fixtures'
+    own topologies plus a two-slice variant of the pipeline LM (dcn
+    axis derived from ``num_slices``), so CI gates the hierarchical
+    (DCN) pricing path too."""
+    from autodist_tpu.resource import ResourceSpec
+
+    for name, trainable, spec, batch in _zoo_fixtures():
+        yield name, trainable, spec, batch
+        if name == "pipeline_lm":
+            yield ("pipeline_lm@2slice", trainable,
+                   ResourceSpec({"topology": {"platform": "cpu",
+                                              "num_devices": 8,
+                                              "num_slices": 2}}),
+                   batch)
+
+
+def lint_search(plan_only=False, out=print, top=10) -> tuple[int, int,
+                                                             list]:
+    """Sweep the searched frontier per fixture topology the way
+    ``--zoo`` sweeps the fixed candidate list: the search plan-lints
+    every synthesized candidate internally (lint ERROR ⇒ pruned and
+    counted), so any lint-pruned candidate here is a *synthesis* bug
+    and fails the sweep; every priced survivor is re-linted (belt and
+    braces), and the elected winner's compiled program goes through
+    the program linter.  Returns ``(n_errors, n_warnings, results)``.
+    """
+    import numpy as np
+
+    from autodist_tpu.analysis import lint_plan
+    from autodist_tpu.simulator.search import (program_lint_winner,
+                                               search_strategies)
+
+    results = []
+    n_err = n_warn = 0
+    for name, trainable, spec, batch in _search_fixtures():
+        leaves = list(batch.values())
+        global_batch = int(np.shape(leaves[0])[0])
+        res = search_strategies(trainable, spec,
+                                global_batch=global_batch)
+        rec = {"fixture": name, "counts": res.counts(),
+               "lint_pruned": [{"candidate": cand, "codes": codes}
+                               for cand, codes in res.lint_pruned]}
+        # The search must never synthesize an unlintable plan from a
+        # valid knob point: every lint prune is a bug, not input error.
+        n_err += len(res.lint_pruned)
+        surv_err = 0
+        for cand in res.frontier:
+            rep = lint_plan(cand.strategy, resource_spec=cand.spec,
+                            trainable=trainable)
+            surv_err += len(rep.errors)
+            n_warn += len(rep.warnings)
+        n_err += surv_err
+        rec["survivor_errors"] = surv_err
+        rec["frontier"] = [
+            {"candidate": c.name, "feasible": c.cost.feasible,
+             "comm_time_s": c.cost.comm_time_s,
+             "dcn_time_s": c.cost.dcn_time_s}
+            for c in res.frontier[:top]]
+        winner = res.winner.name if res.winner else None
+        rec["winner"] = winner
+        if not plan_only and res.winner is not None:
+            vocab = ZOO_VOCAB if name.startswith("pipeline_lm") else None
+            try:
+                prog = program_lint_winner(res, trainable, batch,
+                                           vocab_size=vocab)
+            except Exception as e:   # a winner that cannot lower
+                n_err += 1
+                rec["winner_program_error"] = f"{type(e).__name__}: {e}"
+                out(f"{name}: winner {winner} FAILED to "
+                    f"lower/compile — {e}")
+                results.append(rec)
+                continue
+            rec["winner_program"] = [d.to_dict() for d in prog]
+            n_err += len(prog.errors)
+            n_warn += len(prog.warnings)
+        out(f"{name}: {res.raw_configs} raw, "
+            f"{res.skipped_unbuildable} unbuildable, "
+            f"{res.pruned_dominated} dominated, "
+            f"{res.pruned_lint} lint-pruned, {res.priced} priced; "
+            f"winner {winner}"
+            + ("" if plan_only or "winner_program" not in rec
+               else f", program {len([d for d in rec['winner_program'] if d['severity'] == 'error'])}E"))
+        results.append(rec)
+    return n_err, n_warn, results
+
+
 def run_mutation_matrix(out=print) -> tuple[int, list]:
     from autodist_tpu.analysis.mutations import run_mutations
 
@@ -259,6 +346,11 @@ def main(argv=None) -> int:
     ap.add_argument("--zoo", action="store_true",
                     help="sweep every AutoStrategy candidate (plan "
                          "lint + program lint) and the decode configs")
+    ap.add_argument("--search", action="store_true",
+                    help="sweep the topology-aware searched frontier "
+                         "per fixture topology (plan lint on every "
+                         "survivor, program lint on the winner) — the "
+                         "--zoo analog for synthesized candidates")
     ap.add_argument("--mutate", action="store_true",
                     help="run the mutation-test harness (each rule "
                          "must fire on its seeded violation)")
@@ -276,9 +368,9 @@ def main(argv=None) -> int:
                     help="CI mode: compact output, same rc contract "
                          "(rc 1 on any ERROR / non-firing mutation)")
     args = ap.parse_args(argv)
-    if not (args.zoo or args.mutate or args.strategies):
-        ap.error("nothing to do: pass --zoo, --mutate, and/or "
-                 "strategy JSON files")
+    if not (args.zoo or args.search or args.mutate or args.strategies):
+        ap.error("nothing to do: pass --zoo, --search, --mutate, "
+                 "and/or strategy JSON files")
 
     out = (lambda *a, **k: None) if args.check else print
     n_err = 0
@@ -293,6 +385,12 @@ def main(argv=None) -> int:
         n_err += zoo_err
         print(f"zoo sweep: {zoo_err} error(s), {zoo_warn} warning(s) "
               f"across {len(report['zoo'])} candidate(s)")
+    if args.search:
+        s_err, s_warn, report["search"] = lint_search(
+            plan_only=args.plan_only, out=out)
+        n_err += s_err
+        print(f"search sweep: {s_err} error(s), {s_warn} warning(s) "
+              f"across {len(report['search'])} fixture(s)")
     if args.mutate:
         mut_failed, report["mutations"] = run_mutation_matrix(out=out)
         n_err += mut_failed

@@ -143,6 +143,16 @@ def check_schema(run_dir: str) -> list[str]:
             if d.get("kind") != "drift" or not isinstance(
                     d.get("ratios"), dict):
                 problems.append("drift.json: kind/ratios missing")
+            # Per-level comm terms (hierarchical network model) must
+            # come paired: a cross-slice time term without its byte
+            # term means the cost model or the report dropped half the
+            # breakdown — the dcn_gbps proposal would fit garbage.
+            pred = d.get("predicted") or {}
+            if pred.get("comm_time_dcn_s") and not pred.get("dcn_bytes"):
+                problems.append(
+                    "drift.json: predicted.comm_time_dcn_s without "
+                    "predicted.dcn_bytes — per-level comm terms out "
+                    "of sync")
         except ValueError as e:
             problems.append(f"drift.json: invalid ({e})")
     return problems
