@@ -43,6 +43,7 @@ from autodist_tpu.strategy.parallel_builders import (ExpertParallel,
                                                      SequenceParallel)
 from autodist_tpu.strategy.ir import Strategy
 from autodist_tpu.simulator import AutoStrategy
+from autodist_tpu.elastic import ElasticController
 from autodist_tpu.train import fit
 from autodist_tpu.fetches import fetch
 
@@ -54,4 +55,5 @@ __all__ = [
     "Parallax", "ZeRO", "AutoStrategy", "GradAccumulation", "fit",
     "Sharded", "TensorParallel", "FSDPSharded",
     "SequenceParallel", "Pipeline", "ExpertParallel", "fetch",
+    "ElasticController",
 ]

@@ -67,6 +67,11 @@ CODES: dict[str, tuple[str, str]] = {
     "ADT051": (WARNING, "compressor has no data axis to compress over"),
     "ADT060": (ERROR, "model/pipeline sharding rides the cross-slice "
                       "dcn axis (DCN carries only data parallelism)"),
+    "ADT070": (ERROR, "reshard source/target state trees incompatible "
+                      "(leaf set or logical shape/dtype mismatch)"),
+    "ADT071": (WARNING, "compressor error-feedback state not "
+                        "transferable across this reshard "
+                        "(reinitialized on the target)"),
     # --- program lint (optimized HLO) -------------------------------- #
     "ADT101": (ERROR, "step program contains a host transfer"),
     "ADT102": (ERROR, "multi-step window lowered without a fused loop"),

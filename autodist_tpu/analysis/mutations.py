@@ -178,6 +178,78 @@ class ProgramMutation:
                 "description": self.description}
 
 
+@dataclasses.dataclass
+class ReshardMutation:
+    """Doctor an elastic state-codec manifest pair (a hand-edited
+    checkpoint sidecar / a wrong target); the reshard compatibility
+    lint must fire on the doctored pair and stay silent on the honest
+    one."""
+
+    name: str
+    code: str
+    description: str
+    mutate: Callable  # (src_manifest, dst_manifest) -> (src, dst)
+    kind: str = "reshard"
+
+    def run(self) -> dict:
+        import copy
+
+        from autodist_tpu.analysis.plan_rules import lint_reshard
+
+        src_r, dst_r = programs._reshard_pair()
+        src = src_r.lowered.state_manifest(src_r.state)
+        dst = dst_r.lowered.state_manifest(dst_r.state)
+        clean = lint_reshard(src, dst)
+        m_src, m_dst = self.mutate(copy.deepcopy(src), copy.deepcopy(dst))
+        mutated = lint_reshard(m_src, m_dst)
+        return {"name": self.name, "kind": self.kind, "code": self.code,
+                "clean_ok": self.code not in clean.codes(),
+                "fired": self.code in mutated.codes(),
+                "description": self.description}
+
+
+def _reshard_mutations() -> list[ReshardMutation]:
+    def drop_leaf(src, dst):
+        dst["leaves"].pop("params/b")
+        return src, dst
+
+    def flip_dtype(src, dst):
+        dst["leaves"]["params/w"]["dtype"] = "bfloat16"
+        return src, dst
+
+    def flip_shape(src, dst):
+        dst["leaves"]["params/w"]["logical_shape"][0] += 1
+        return src, dst
+
+    def orphan_sync(src, dst):
+        src["sync"]["sync_state/g0:bf16_ef"] = {
+            "rows": 8, "width": 16, "compressor": "bf16_ef"}
+        src["leaves"]["sync_state/g0:bf16_ef"] = {
+            "stored_shape": [8, 16], "logical_shape": [8, 16],
+            "dtype": "float32", "ops": []}
+        return src, dst
+
+    return [
+        ReshardMutation(
+            "reshard_leaf_dropped", "ADT070",
+            "a target state leaf vanishes (different optimizer / "
+            "edited sidecar) — coded error, not a mid-reshard tree "
+            "error", drop_leaf),
+        ReshardMutation(
+            "reshard_dtype_flipped", "ADT070",
+            "source/target logical dtypes disagree on one leaf",
+            flip_dtype),
+        ReshardMutation(
+            "reshard_shape_flipped", "ADT070",
+            "source/target logical shapes disagree on one leaf",
+            flip_shape),
+        ReshardMutation(
+            "reshard_ef_state_dropped", "ADT071",
+            "source error-feedback rows have no home in the target "
+            "layout (re-seeded, warned)", orphan_sync),
+    ]
+
+
 def _set_node(d: dict, suffix: str, **updates) -> dict:
     """Update the first node config whose var_name ends with suffix."""
     for nc in d["node_configs"]:
@@ -501,6 +573,15 @@ def _program_mutations() -> list[ProgramMutation]:
             _inject("  %fg = f32[1000000]{0} all-gather(f32[500000]{0} "
                     "%p), dimensions={0}")),
         ProgramMutation(
+            "reshard_full_gather", "ADT110",
+            "a reshard program stages through full-array "
+            "materialization (the program a gather-to-replicated "
+            "route compiles to) instead of shard-to-shard collective "
+            "routes",
+            lambda: P.reshard_step_text(),
+            lambda: R.rules_for_reshard(P.reshard_budget()),
+            lambda t: P.reshard_step_text(naive=True)),
+        ProgramMutation(
             "kv_write_scatterized", "ADT111",
             "the in-place KV write lowers to something other than "
             "dynamic-update-slice",
@@ -536,7 +617,7 @@ def _program_mutations() -> list[ProgramMutation]:
 
 
 def all_mutations() -> list:
-    return _plan_mutations() + _program_mutations()
+    return _plan_mutations() + _program_mutations() + _reshard_mutations()
 
 
 def run_mutations(names=None, kinds=None) -> list[dict]:

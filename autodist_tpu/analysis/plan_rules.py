@@ -546,6 +546,79 @@ def rule_compressor_without_data_axis(ctx: PlanContext):
 
 
 # --------------------------------------------------------------------------- #
+# Reshard compatibility lint (elastic resharding, ADT070/ADT071)
+# --------------------------------------------------------------------------- #
+def sync_rows_transferable(source: dict, target: dict) -> bool:
+    """One rule for when compressor error-feedback rows move verbatim:
+    same layout (rows x width) AND same compressor semantics — bf16_ef
+    residuals mean nothing to an int8 compressor even at identical
+    shapes.  A manifest family that did not record the compressor
+    (``"unknown"``) gates on layout alone."""
+    if source["rows"] != target["rows"] \
+            or source["width"] != target["width"]:
+        return False
+    s, t = source.get("compressor"), target.get("compressor")
+    return s == t or "unknown" in (s, t)
+
+
+def lint_reshard(source_manifest: dict, target_manifest: dict) -> LintReport:
+    """Check two elastic state-codec manifests (``Lowered.
+    state_manifest``, or a checkpoint sidecar's copy) for reshard
+    compatibility BEFORE any data moves: the source and target state
+    trees must agree leaf-for-leaf on *logical* shape and dtype.  Any
+    mismatch is a coded ADT070 ERROR naming the leaf — never a
+    mid-reshard tree/broadcast error buried in a jit traceback.
+    Non-transferable compressor error-feedback rows (row count or
+    width changed, e.g. a dp-degree change — residuals are per-device
+    quantization errors with no cross-degree meaning) are an ADT071
+    WARNING: the reshard proceeds and re-seeds them on the target.
+    """
+    report = LintReport()
+    src = source_manifest.get("leaves", {})
+    dst = target_manifest.get("leaves", {})
+    src_sync = set(source_manifest.get("sync", {}))
+    dst_sync = set(target_manifest.get("sync", {}))
+    fix = ("the reshard engine moves state between layouts of the SAME "
+           "(trainable, optimizer); rebuild the target from the same "
+           "model, or restore params-only via restore_portable")
+    for path in sorted(set(src) - set(dst) - src_sync):
+        report.extend([Diagnostic(
+            "ADT070", "source state leaf has no counterpart in the "
+            "target layout", where=path, fix=fix)])
+    for path in sorted(set(dst) - set(src) - dst_sync):
+        report.extend([Diagnostic(
+            "ADT070", "target state leaf has no counterpart in the "
+            "source layout", where=path, fix=fix)])
+    for path in sorted(set(src) & set(dst)):
+        if path in src_sync or path in dst_sync:
+            continue
+        s, d = src[path], dst[path]
+        if list(s["logical_shape"]) != list(d["logical_shape"]):
+            report.extend([Diagnostic(
+                "ADT070",
+                f"logical shape {s['logical_shape']} (source) != "
+                f"{d['logical_shape']} (target)", where=path, fix=fix)])
+        if s["dtype"] != d["dtype"]:
+            report.extend([Diagnostic(
+                "ADT070",
+                f"dtype {s['dtype']} (source) != {d['dtype']} (target)",
+                where=path, fix=fix)])
+    for path in sorted(src_sync | dst_sync):
+        s = source_manifest.get("sync", {}).get(path)
+        d = target_manifest.get("sync", {}).get(path)
+        if s is None or d is None or not sync_rows_transferable(s, d):
+            report.extend([Diagnostic(
+                "ADT071",
+                "error-feedback rows change layout across this reshard "
+                f"(source {s}, target {d}); the target re-seeds them "
+                "from the compressor's init state", where=path,
+                fix="expect a short re-warm of the error-feedback "
+                    "residuals; trajectories stay convergent but are "
+                    "not bit-identical through the switch")])
+    return report.sorted()
+
+
+# --------------------------------------------------------------------------- #
 # Entry point
 # --------------------------------------------------------------------------- #
 def lint_plan(strategy: Strategy, resource_spec=None, trainable=None,

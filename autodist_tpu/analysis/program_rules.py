@@ -364,6 +364,22 @@ def rules_for_strategy(strategy, *, vocab_size: Optional[int] = None,
     return rules
 
 
+def rules_for_reshard(max_shard_elems: int) -> list[Rule]:
+    """The structural contract of a compiled reshard program (elastic
+    resharding, :mod:`autodist_tpu.elastic.reshard`): redistribution
+    must route shard-to-shard through collectives — it must never
+    gather a full array (ADT110: no all-gather result beyond the
+    largest per-device stored shard, with slack for padding) and never
+    stage through the host (ADT101).  This is the memory-efficient
+    redistribution claim of arxiv 2112.01075, checked on the optimized
+    HLO: peak transfer buffers stay at shard granularity.
+
+    ``max_shard_elems``: the largest per-device stored-shard element
+    count across the source and target layouts (see
+    ``elastic.reshard.shard_budget``)."""
+    return [no_host_transfer(), no_full_gather(max_shard_elems)]
+
+
 def rules_for_decode(tensor_parallel: int, vocab_parallel: bool, *,
                      vocab_size: int, max_len: int, num_layers: int,
                      num_slots: int, heads_local: int,

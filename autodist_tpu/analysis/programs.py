@@ -239,6 +239,97 @@ def zero_step_text(zero_stage: int, collective_precision=None) -> str:
 
 
 # --------------------------------------------------------------------------- #
+# Elastic reshard programs
+# --------------------------------------------------------------------------- #
+# Distinctive dim of the resharded matrix (no other tensor dimension
+# equals it) and the two layouts the corpus reshard moves between:
+# axis-0 shards -> axis-1 shards of the same 8-device data mesh — a
+# transition whose every element changes owner, so the compiled route
+# is a genuine redistribution (all-to-alls at per-pair payloads), not
+# a local relabel.
+RS_DIM = 61
+RS_ROWS = 64
+
+
+def _reshard_trainable():
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from autodist_tpu import Trainable
+
+    r = np.random.RandomState(0)
+    params = {"w": jnp.asarray(r.randn(RS_ROWS, RS_DIM) * 0.1,
+                               jnp.float32),
+              "b": jnp.zeros((RS_DIM,), jnp.float32)}
+
+    def loss_fn(p, batch):
+        return jnp.mean((batch["x"] @ p["w"] - batch["y"]) ** 2) \
+            + 0.0 * jnp.sum(p["b"])
+
+    return Trainable.from_loss_fn(loss_fn, params, optax.adam(1e-2))
+
+
+def _reshard_strategy(split_axis: int):
+    from autodist_tpu.strategy.ir import (GraphConfig, NodeConfig,
+                                          PartitionerConfig,
+                                          PSSynchronizer, Strategy)
+
+    part = "8,1" if split_axis == 0 else "1,8"
+    return Strategy(node_configs=[
+        NodeConfig("w", PSSynchronizer(),
+                   PartitionerConfig(partition_str=part)),
+        NodeConfig("b", PSSynchronizer()),
+    ], graph_config=GraphConfig(replicas=8))
+
+
+@functools.lru_cache(maxsize=None)
+def _reshard_pair():
+    from autodist_tpu import AutoDist
+
+    spec = {"topology": {"platform": "cpu", "num_devices": 8}}
+    src = AutoDist(spec).build(_reshard_trainable(),
+                               _reshard_strategy(0))
+    dst = AutoDist(spec).build(_reshard_trainable(),
+                               _reshard_strategy(1))
+    return src, dst
+
+
+def reshard_budget() -> int:
+    """The ADT110 gather budget of the corpus reshard: the largest
+    per-device stored shard of the TARGET layout."""
+    from autodist_tpu.elastic.reshard import shard_budget
+
+    _, dst = _reshard_pair()
+    return shard_budget((dst.lowered, dst.state))
+
+
+@functools.lru_cache(maxsize=None)
+def reshard_step_text(naive: bool = False) -> str:
+    """Optimized HLO of the corpus reshard program: FSDP axis-0 shards
+    re-laid as axis-1 shards on the same 8-device mesh, as the ONE
+    compiled program the fast path runs.  ``naive=True`` compiles the
+    program a full-materialization staging route produces instead —
+    the same transfer with every output replicated first — whose
+    full-array gathers the ADT110 reshard rule must catch."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from autodist_tpu.elastic.reshard import build_convert_fn
+
+    src, dst = _reshard_pair()
+    convert, _ = build_convert_fn(src.lowered, src.state, dst.lowered)
+    if naive:
+        raw = getattr(convert, "__wrapped__", convert)
+        replicated = jax.tree.map(
+            lambda s: NamedSharding(dst.lowered.mesh, P()),
+            dst.lowered.state_shardings)
+        fn = jax.jit(raw, out_shardings=replicated)
+        return compiled_text(fn, src.state)
+    return compiled_text(convert, src.state)
+
+
+# --------------------------------------------------------------------------- #
 # Serving decode programs
 # --------------------------------------------------------------------------- #
 # Decode-probe geometry: T (cache max_len) and V (vocab) are chosen

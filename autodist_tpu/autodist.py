@@ -229,6 +229,7 @@ class AutoDist:
             cached = (take_cached(strategy.id)
                       if not runner_kwargs and rng is None else None)
             if cached is not None:
+                cached.strategy = strategy
                 return cached
             # Cache bypassed (custom rng/runner kwargs, or a different
             # strategy id): release the measured winner's compiled runner
@@ -245,10 +246,17 @@ class AutoDist:
             from autodist_tpu.runner import AsyncPSRunner
             staleness = max((nc.synchronizer.staleness
                              for nc in async_nodes), default=0)
-            return AsyncPSRunner(trainable, staleness=staleness, rng=rng,
-                                 **runner_kwargs)
-        return DistributedRunner(trainable, self.lower(trainable, strategy),
-                                 rng=rng, **runner_kwargs)
+            runner = AsyncPSRunner(trainable, staleness=staleness, rng=rng,
+                                   **runner_kwargs)
+        else:
+            runner = DistributedRunner(trainable,
+                                       self.lower(trainable, strategy),
+                                       rng=rng, **runner_kwargs)
+        # The runner carries its Strategy so checkpoint saves can bind
+        # layout to weights (the elastic sidecar) without the caller
+        # threading it through.
+        runner.strategy = strategy
+        return runner
 
     # Convenience one-shot (≙ the experimental ``autodist.function``,
     # reference ``autodist.py:252-289``).

@@ -36,6 +36,34 @@ _META_FILE = "meta.json"
 _PARAMS_DIR = "params"
 
 
+def parse_dtype(name) -> np.dtype:
+    """Rebuild the exact dtype a ``meta.json``/sidecar string names.
+
+    ``np.dtype("bfloat16")`` only resolves once ``ml_dtypes`` has
+    registered its extension types with numpy — which importing jax
+    does, but a bare-numpy consumer of an exported artifact (the
+    'loadable without this framework' contract) may not have done.
+    Resolve the ml_dtypes names explicitly first, then fall back to
+    numpy; an unparseable string raises a ``ValueError`` naming it
+    (instead of numpy's bare ``TypeError``)."""
+    if isinstance(name, np.dtype):
+        return name
+    name = str(name)
+    try:
+        import ml_dtypes
+        extension = getattr(ml_dtypes, name, None)
+        if extension is not None:
+            return np.dtype(extension)
+    except ImportError:  # pragma: no cover - jax hard-depends on it
+        pass
+    try:
+        return np.dtype(name)
+    except TypeError as e:
+        raise ValueError(
+            f"meta.json names dtype {name!r}, which neither numpy nor "
+            f"ml_dtypes can rebuild: {e}") from e
+
+
 def export_model(path: str, apply_fn: Callable, params: Any,
                  sample_inputs: Sequence[Any], *,
                  runner: Optional[Any] = None,
@@ -101,7 +129,7 @@ def _params_target(meta: dict):
         return None
     return jax.tree.map(
         lambda d: jax.ShapeDtypeStruct(tuple(d["shape"]),
-                                       np.dtype(d["dtype"])),
+                                       parse_dtype(d["dtype"])),
         spec, is_leaf=lambda d: isinstance(d, dict)
         and set(d) == {"shape", "dtype"})
 

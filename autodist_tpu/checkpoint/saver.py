@@ -21,6 +21,7 @@ restores under pure DP and vice versa.
 """
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, Optional
 
@@ -29,6 +30,14 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from autodist_tpu.utils import logging
+
+# Per-step elastic sidecar directory (inside the checkpoint root; orbax
+# ignores non-step-shaped entries).  Each full save drops
+# ``elastic/<step>.json``: the Strategy IR + mesh factorization + the
+# per-leaf stored↔logical recipes of the writing lowering, so a later
+# restore can re-lay the state onto ANY mesh without the source mesh —
+# or even the source strategy object — still existing.
+_SIDECAR_DIR = "elastic"
 
 
 class Saver:
@@ -73,6 +82,7 @@ class Saver:
         payload = {k: v for k, v in payload.items() if v is not None}
         self._mgr.save(step, args=ocp.args.StandardSave(payload),
                        force=force)
+        self._write_sidecar(runner, step, portable=portable)
         block = (not self._async) if blocking is None else blocking
         if block:
             self._mgr.wait_until_finished()
@@ -82,6 +92,69 @@ class Saver:
             logging.info("checkpoint step %d staged (async) for %s "
                          "(portable=%s)", step, self.directory, portable)
         return step
+
+    # -------------------- elastic sidecar ------------------------------ #
+    def _sidecar_path(self, step: int) -> str:
+        return os.path.join(self.directory, _SIDECAR_DIR, f"{step}.json")
+
+    def _write_sidecar(self, runner, step: int, *, portable: bool):
+        """Persist the checkpoint↔strategy binding: Strategy IR JSON +
+        mesh factorization + the state-codec manifest, next to the
+        weights (the ``meta.json`` pattern of ``checkpoint/export.py``,
+        upgraded with the recipes elastic restore decodes through).
+        Best-effort: an unwritable sidecar degrades to a pre-elastic
+        checkpoint (restore_elastic then reports layout-unknown), it
+        never fails the save."""
+        lowered = getattr(runner, "lowered", None)
+        if portable or lowered is None \
+                or not hasattr(lowered, "state_manifest"):
+            return
+        strategy = getattr(runner, "strategy", None)
+        try:
+            manifest = lowered.state_manifest(runner.state)
+            mesh_axes = {a: int(s)
+                         for a, s in dict(lowered.mesh.shape).items()}
+            record = {
+                "kind": "elastic_meta",
+                "step": int(step),
+                "strategy": (json.loads(strategy.to_json())
+                             if strategy is not None else None),
+                "mesh_axes": mesh_axes,
+                "manifest": manifest,
+            }
+            os.makedirs(os.path.join(self.directory, _SIDECAR_DIR),
+                        exist_ok=True)
+            with open(self._sidecar_path(step), "w") as f:
+                json.dump(record, f)
+            self._prune_sidecars(keep=step)
+        except Exception as e:   # noqa: BLE001 — contract: a sidecar
+            # failure (including a bug in a lowering's state_manifest
+            # closure) degrades to a pre-elastic checkpoint; it must
+            # never abort the save that just committed the weights.
+            logging.warning(
+                "could not write the elastic sidecar for step %d "
+                "(%s: %s); this checkpoint restores onto its own "
+                "layout only", step, type(e).__name__, e)
+
+    def _prune_sidecars(self, keep: int):
+        """Drop sidecars whose checkpoints the manager's ``max_to_keep``
+        already garbage-collected (``keep``: the step just written —
+        its save may still be in flight, so it is always retained)."""
+        live = set(self._mgr.all_steps()) | {keep}
+        side_dir = os.path.join(self.directory, _SIDECAR_DIR)
+        for name in os.listdir(side_dir):
+            stem, _, ext = name.partition(".")
+            if ext == "json" and stem.isdigit() and int(stem) not in live:
+                os.remove(os.path.join(side_dir, name))
+
+    def read_sidecar(self, step: int) -> Optional[dict]:
+        """The elastic sidecar for ``step`` (``None`` for pre-elastic
+        checkpoints)."""
+        path = self._sidecar_path(step)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
 
     def wait(self):
         """Join any in-flight async save (no-op when idle)."""
@@ -109,6 +182,104 @@ class Saver:
         runner.state = state
         logging.info("restored checkpoint step %d", step)
         return runner
+
+    def restore_elastic(self, runner, step: Optional[int] = None, *,
+                        strategy=None):
+        """Restore a FULL checkpoint (optimizer state included) into a
+        runner whose strategy/mesh may differ arbitrarily from the one
+        that wrote it — the elastic-resharding restore.
+
+        The per-leaf decode recipes come from the checkpoint's elastic
+        sidecar (written by every post-elastic :meth:`save`).  A
+        checkpoint written before the sidecar existed is
+        layout-unknown: pass ``strategy=`` (the Strategy the writer
+        ran) so the source layout can be rebuilt — silently guessing a
+        replicated layout would corrupt sharded state.  Source/target
+        compatibility is linted up front (ADT070/ADT071).  On top of
+        the restored checkpoint's own host residency (one copy, like
+        any orbax restore), the decode/re-encode working set is one
+        leaf at a time — each stored leaf is released as soon as its
+        target form is placed — and the whole footprint is recorded as
+        the reshard record's ``peak_host_bytes``.
+        """
+        from autodist_tpu.elastic import reshard as _reshard
+
+        self.wait()
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        sidecar = self.read_sidecar(step)
+        if sidecar is not None:
+            src_manifest = sidecar["manifest"]
+        elif strategy is not None:
+            src_manifest = self._manifest_from_strategy(runner, strategy)
+        else:
+            raise ValueError(
+                f"checkpoint step {step} in {self.directory} carries no "
+                "elastic sidecar (written before elastic resharding "
+                "existed): source layout-unknown — restoring under a "
+                "guessed layout would silently corrupt sharded state. "
+                "Pass strategy= (the Strategy IR the writer ran) to "
+                "rebuild the layout, restore with restore() on the "
+                "original strategy/mesh, or use restore_portable for a "
+                "params-only portable checkpoint.")
+        meta = self._mgr.item_metadata(step)
+        template = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype), meta)
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(template))
+        from autodist_tpu.kernel.common import flatten_with_names
+        stored_by_path = dict(flatten_with_names(restored))
+        del restored   # assemble_state consumes the leaves one by one
+        missing = [p for p in src_manifest["leaves"]
+                   if p not in stored_by_path]
+        if missing:
+            raise ValueError(
+                f"checkpoint step {step} does not carry the full "
+                f"training state the source layout declares (missing "
+                f"e.g. {missing[0]!r}, {len(missing)} leaf/leaves "
+                "total) — a portable (params-only) checkpoint restores "
+                "via restore_portable; restore_elastic needs a FULL "
+                "save. (Caught before assembly — never a mid-reshard "
+                "tree error.)")
+        resident = sum(int(np.asarray(v).nbytes)
+                       for v in stored_by_path.values())
+        runner.state = _reshard.assemble_state(
+            runner.lowered, stored_by_path, src_manifest,
+            peak_base=resident)
+        logging.info("restored checkpoint step %d elastically onto mesh "
+                     "%s", step, dict(runner.lowered.mesh.shape))
+        return runner
+
+    def _manifest_from_strategy(self, runner, strategy) -> dict:
+        """Rebuild a pre-elastic checkpoint's state-codec manifest by
+        re-lowering its Strategy on a mesh of the recorded
+        factorization (needs that many visible devices — the
+        simulated-mesh escape hatch for old checkpoints)."""
+        from autodist_tpu.autodist import AutoDist
+        from autodist_tpu.elastic.reshard import spec_for_layout
+
+        mesh_axes = dict(strategy.graph_config.mesh_axes or {})
+        try:
+            ad = AutoDist(spec_for_layout(
+                mesh_axes,
+                fallback_devices=strategy.graph_config.replicas))
+            lowered = ad._lower(runner.trainable, strategy)
+        except (ValueError, RuntimeError) as e:
+            raise ValueError(
+                f"cannot rebuild the source layout for strategy "
+                f"{strategy.id} (mesh {mesh_axes or 'data-only'}): {e}. "
+                "The "
+                "source mesh needs that many visible devices; restore "
+                "on a host that has them, or re-save the checkpoint "
+                "with a current Saver (which writes the sidecar).")
+        import jax.numpy as jnp
+        abstract = jax.eval_shape(
+            lowered.init_fn,
+            jax.tree.map(lambda p: jax.ShapeDtypeStruct(
+                np.shape(p), jnp.result_type(p)), runner.trainable.params),
+            runner.trainable.extra)
+        return lowered.state_manifest(abstract)
 
     def restore_params(self, step: Optional[int] = None) -> dict:
         """Load a portable checkpoint as plain host arrays (≙ restoring an
@@ -139,38 +310,71 @@ class Saver:
         return runner
 
     def install_preemption_hook(self, runner, *, signals=None,
-                                portable: bool = False):
+                                portable: bool = False,
+                                exit_after: bool = True,
+                                on_preempted=None):
         """Checkpoint on termination signals (TPU-VM preemptions deliver
         SIGTERM) before the default handling proceeds — the natural
         extension of the reference's fail-fast-then-restart-from-
         checkpoint model (SURVEY.md §5.3: detection only, no recovery;
         here the checkpoint that makes the restart cheap is guaranteed).
 
+        ``runner`` may also be a zero-arg callable returning the
+        CURRENT runner — an elastic job swaps runners across resumes,
+        and a runner captured at install time would checkpoint stale
+        pre-resume state on the next preemption.  ``exit_after=False``
+        returns control to the process after the checkpoint (the
+        elastic path: survivors re-elect and resume in-process) instead
+        of chaining to the previous handling; a FAILED save there is
+        logged and reported through the callback instead of raising
+        into whatever main-thread frame the signal interrupted — the
+        preemption still happened, and recovery falls back to the last
+        good checkpoint.  ``on_preempted(saved: bool)`` runs after the
+        save attempt (the elastic controller's preempted flag).
+
         Returns the previous handlers so callers can uninstall."""
         import signal as _signal
 
         signals = signals or (_signal.SIGTERM,)
         previous = {}
+        get_runner = runner if callable(runner) else (lambda: runner)
 
         def handler(signum, frame):
+            live = get_runner()
             logging.warning(
                 "signal %d: writing preemption checkpoint at step %d",
-                signum, runner.step_count)
+                signum, live.step_count)
             try:
-                self.save(runner, portable=portable, force=True,
-                          blocking=True)
+                saved = False
+                try:
+                    self.save(live, portable=portable, force=True,
+                              blocking=True)
+                    saved = True
+                except Exception as e:
+                    logging.error(
+                        "preemption checkpoint at step %d FAILED (%s); "
+                        "recovery must fall back to the last good "
+                        "checkpoint (step %s)", live.step_count, e,
+                        self._mgr.latest_step())
+                    if exit_after:
+                        raise  # the process dies anyway; keep the trace
+                if on_preempted is not None:
+                    on_preempted(saved)
             finally:
-                prev = previous.get(signum)
-                if callable(prev):
-                    prev(signum, frame)
-                elif prev == _signal.SIG_IGN:
-                    pass  # the process was ignoring this signal: keep that
-                else:
-                    # SIG_DFL, or None (handler installed from C — not
-                    # callable from Python): fall back to default
-                    # termination so the signal is never swallowed.
-                    _signal.signal(signum, _signal.SIG_DFL)
-                    _signal.raise_signal(signum)
+                if exit_after:
+                    prev = previous.get(signum)
+                    if callable(prev):
+                        prev(signum, frame)
+                    elif prev == _signal.SIG_IGN:
+                        pass  # the process was ignoring this signal:
+                        #       keep that
+                    else:
+                        # SIG_DFL, or None (handler installed from C —
+                        # not callable from Python): fall back to
+                        # default termination so the signal is never
+                        # swallowed.
+                        _signal.signal(signum, _signal.SIG_DFL)
+                        _signal.raise_signal(signum)
 
         for sig in signals:
             previous[sig] = _signal.signal(sig, handler)

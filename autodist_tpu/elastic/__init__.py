@@ -1,0 +1,39 @@
+"""Elastic resharding: restore any checkpoint onto any mesh, survive
+preemption live.
+
+A production fleet preempts, resizes, and upgrades; a checkpoint must
+not stay married to the (dp, pp, tp) layout that wrote it.  This
+subsystem decouples them:
+
+* every :class:`~autodist_tpu.checkpoint.saver.Saver` full save now
+  carries a **sidecar**: the Strategy IR + mesh factorization + the
+  per-leaf stored↔logical *recipes* of the writing lowering
+  (``Lowered.state_manifest``), so the stored bytes stay decodable
+  after the source mesh is gone;
+* :mod:`~autodist_tpu.elastic.reshard` computes per-leaf
+  redistribution routes between any two layouts — same-sharding fast
+  path, collective slice-exchange on the union mesh (the
+  memory-efficient redistribution of arxiv 2112.01075: ONE compiled
+  program, no host staging, peak buffers at shard granularity —
+  program-linted by ADT110), ZeRO-3 flat-shard ↔ logical conversion,
+  vocab re-padding when tp changes — with source/target compatibility
+  checked up front as coded ADT070/ADT071 diagnostics;
+* :mod:`~autodist_tpu.elastic.controller` drives the live loop: on
+  preemption checkpoint, shrink to the surviving topology, re-run the
+  topology-aware search (:mod:`autodist_tpu.simulator.search`) on the
+  survivors, reshard onto the new winner, resume — and grow back
+  symmetrically.
+
+See ``docs/usage/elasticity.md``.
+"""
+from autodist_tpu.elastic.reshard import (ReshardError,  # noqa: F401
+                                          ReshardPlan, apply_ops,
+                                          invert_ops, plan_reshard,
+                                          reshard_state, shard_budget)
+from autodist_tpu.elastic.controller import ElasticController  # noqa: F401
+
+__all__ = [
+    "ReshardError", "ReshardPlan", "apply_ops", "invert_ops",
+    "plan_reshard", "reshard_state", "shard_budget",
+    "ElasticController",
+]

@@ -1,0 +1,167 @@
+"""Elastic controller: survive preemption live, grow back later.
+
+The live loop on top of the reshard engine:
+
+1. **preempt** — a termination signal (TPU-VM preemptions deliver
+   SIGTERM) triggers a blocking full checkpoint (the
+   ``install_preemption_hook`` signal path of
+   :mod:`autodist_tpu.checkpoint.saver`, minus the dying: an elastic
+   job's surviving processes carry on);
+2. **shrink** — re-run the topology-aware search
+   (:mod:`autodist_tpu.simulator.search`) on the surviving topology
+   and elect a new winner (the winner's mesh factorization travels in
+   its Strategy IR, which ``AutoDist._mesh_for`` honors at lowering);
+3. **reshard + resume** — restore the checkpoint elastically onto the
+   winner's layout (``Saver.restore_elastic``) and keep training;
+4. **grow** — symmetric: when capacity returns, re-elect on the larger
+   topology and reshard back up.
+
+``hot_swap`` is the in-place variant for mid-run re-elections (e.g.
+the calibration loop): same devices, new strategy, state moved by the
+single-compiled-program fast path — no checkpoint round-trip.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from autodist_tpu import telemetry
+from autodist_tpu.utils import logging
+
+
+class ElasticController:
+    """Owns the preemption → checkpoint → re-elect → reshard → resume
+    loop for one (trainable, Saver) pair."""
+
+    def __init__(self, trainable, saver, *,
+                 search_space: Optional[Any] = None,
+                 global_batch: Optional[int] = None):
+        self.trainable = trainable
+        self.saver = saver
+        self.search_space = search_space
+        self.global_batch = global_batch
+        self._preempted = threading.Event()
+        self._runner = None        # the CURRENT runner the hook saves
+        self.last_result = None    # the most recent SearchResult
+
+    # ------------------------------------------------------------------ #
+    @property
+    def preempted(self) -> bool:
+        """Set once a preemption signal has been handled; the training
+        loop checks this between steps and hands off to
+        :meth:`resume`."""
+        return self._preempted.is_set()
+
+    def install(self, runner, *, signals=None, exit_after: bool = False):
+        """Install the preemption handler (the Saver's hook — ONE copy
+        of the signal-chaining logic — pointed at whatever runner this
+        controller currently owns): on signal, write a blocking full
+        checkpoint and mark :attr:`preempted`.
+
+        ``exit_after=False`` (default) returns control to the process —
+        the elastic path: survivors re-elect and resume in-process (or
+        a supervisor restarts shrunk).  ``exit_after=True`` chains to
+        the previous handling so the process still dies after the
+        checkpoint (the pre-elastic fail-fast behavior).  Returns the
+        previous handlers so callers can uninstall."""
+        self._runner = runner
+
+        def on_preempted(saved: bool):
+            telemetry.counter("elastic/preemptions").inc()
+            if not saved:
+                # The preemption still happened: hand off regardless —
+                # resume() falls back to the last good checkpoint (the
+                # saver already logged the failure).
+                telemetry.counter("elastic/preemption_save_failures").inc()
+            self._preempted.set()
+
+        return self.saver.install_preemption_hook(
+            lambda: self._runner, signals=signals,
+            exit_after=exit_after, on_preempted=on_preempted)
+
+    # ------------------------------------------------------------------ #
+    def elect(self, topology):
+        """Run the topology-aware search on ``topology`` (a spec dict's
+        ``topology`` section, a device count, or a ResourceSpec) and
+        return ``(strategy, spec)`` for the winner."""
+        from autodist_tpu.resource import ResourceSpec
+        from autodist_tpu.simulator.search import search_strategies
+
+        if isinstance(topology, int):
+            topology = {"num_devices": topology}
+        spec = topology if isinstance(topology, ResourceSpec) \
+            else ResourceSpec({"topology": dict(topology)})
+        result = search_strategies(self.trainable, spec,
+                                   self.search_space,
+                                   global_batch=self.global_batch)
+        self.last_result = result
+        if result.winner is None:
+            raise RuntimeError(
+                f"elastic re-election on {spec.resolved_mesh_shape()} "
+                "priced no candidate; widen the SearchSpace or check "
+                "the surviving topology")
+        logging.info("elastic re-election winner: %s", result.winner.name)
+        return result.winner.strategy, result.winner.spec
+
+    def resume(self, topology, *, step: Optional[int] = None,
+               strategy=None, spec=None):
+        """Re-elect on ``topology`` (unless ``strategy``/``spec`` pin
+        the choice), build the new runner, and restore the latest (or
+        ``step``'s) checkpoint elastically onto it.  This is both the
+        shrink path (surviving topology smaller) and the grow path
+        (capacity returned) — the reshard engine is direction-
+        agnostic."""
+        from autodist_tpu.autodist import AutoDist
+
+        if strategy is None or spec is None:
+            strategy, spec = self.elect(topology)
+        if self._runner is not None:
+            # The checkpoint is the source of truth from here: release
+            # the old runner's device state BEFORE the new build, or
+            # the pre-shrink state doubles residency exactly when the
+            # surviving devices' memory is tightest.
+            self._runner.close()
+            self._runner = None
+        ad = AutoDist(spec)
+        runner = ad.build(self.trainable, strategy)
+        self.saver.restore_elastic(runner, step=step)
+        self._runner = runner    # the preemption hook follows the swap
+        telemetry.counter("elastic/resumes").inc()
+        self._preempted.clear()
+        logging.info(
+            "elastic resume at step %d on mesh %s (strategy %s)",
+            runner.step_count, dict(runner.lowered.mesh.shape),
+            strategy.id)
+        return runner
+
+    shrink = resume   # shrink/grow are the same re-elect + reshard flow
+    grow = resume
+
+    # ------------------------------------------------------------------ #
+    def hot_swap(self, runner, topology=None, *, strategy=None,
+                 spec=None):
+        """Mid-run re-election on the SAME devices: elect (or take) a
+        new strategy, build its runner, and move the live state across
+        via the single-compiled-program fast path — no checkpoint
+        round-trip.  Returns the new runner (the old one is closed)."""
+        from autodist_tpu.autodist import AutoDist
+        from autodist_tpu.elastic.reshard import reshard_state
+        from autodist_tpu.resource import ResourceSpec
+
+        if strategy is None or spec is None:
+            if topology is None:
+                n = len(list(runner.mesh.devices.flat))
+                topology = ResourceSpec({"topology": {"num_devices": n}})
+            strategy, spec = self.elect(topology)
+        ad = AutoDist(spec)
+        new_runner = ad.build(self.trainable, strategy,
+                              rng=getattr(runner, "rng", None))
+        new_runner.state = reshard_state(runner.lowered, runner.state,
+                                         new_runner.lowered)
+        new_runner._host_step = getattr(runner, "_host_step", 0)
+        runner.close()
+        if self._runner is runner:
+            self._runner = new_runner   # the hook must not checkpoint
+            #                             the closed runner
+        telemetry.counter("elastic/hot_swaps").inc()
+        return new_runner

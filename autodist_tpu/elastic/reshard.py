@@ -1,0 +1,455 @@
+"""The reshard engine: move training state between strategy layouts.
+
+Given a source layout (a live ``Lowered`` or a checkpoint sidecar's
+manifest) and a target ``Lowered``, compute per-leaf redistribution
+routes and execute them:
+
+* **fast path** (source and target meshes cover the same devices —
+  the live hot-swap after a mid-run re-election): the whole transfer
+  is ONE compiled program per state tree — every leaf's stored →
+  logical → target-stored recipe chain composed inside a single
+  ``jit`` whose ``out_shardings`` are the target layout.  XLA/GSPMD
+  lowers the redistribution to collective routes (collective-permute /
+  all-to-all / bounded gathers) per arxiv 2112.01075 — no host ever
+  materializes an array, and peak transfer buffers stay at shard
+  granularity.  ``rules_for_reshard`` (ADT110 + ADT101) lints exactly
+  this program's optimized HLO.
+* **staged path** (device sets differ — restore after a shrink/grow,
+  or a checkpoint decoded long after its mesh died): leaves stream
+  through the host ONE AT A TIME and land via ``device_put`` into the
+  target sharding.  The decode/re-encode working set is one leaf —
+  never a second whole-model host copy on top of whatever source
+  residency the caller holds (a live runner's stored leaves stay on
+  device; a checkpoint restore holds the restored tree like any orbax
+  restore does, and that residency is counted into the recorded
+  ``peak_host_bytes``).
+
+Compatibility is checked BEFORE any data moves:
+:func:`autodist_tpu.analysis.lint_reshard` turns a leaf-set or
+logical shape/dtype mismatch into coded ADT070 ERRORs (and
+non-transferable compressor error-feedback rows into ADT071
+warnings), raising :class:`ReshardError` instead of a mid-reshard
+tree error.
+
+Recipes (the per-leaf op lists) are produced by each lowering's
+``state_manifest`` — see the codec comment in
+:mod:`autodist_tpu.kernel.lowering`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from autodist_tpu import telemetry
+from autodist_tpu.capture import path_to_name
+from autodist_tpu.kernel import common
+from autodist_tpu.utils import logging
+
+
+def spec_for_layout(mesh_axes, fallback_devices: int = 1):
+    """The :class:`~autodist_tpu.resource.ResourceSpec` a recorded
+    mesh factorization (a sidecar's ``mesh_axes`` /
+    ``strategy.graph_config.mesh_axes``) lowers on: device count =
+    the axis product; empty axes fall back to a pure-data mesh of
+    ``fallback_devices``.  The ONE place checkpoint-side layout
+    reconstruction builds its spec (Saver and tools/reshard_ckpt.py
+    share it)."""
+    from autodist_tpu.resource import ResourceSpec
+
+    mesh_axes = dict(mesh_axes or {})
+    n = math.prod(mesh_axes.values()) if mesh_axes \
+        else max(int(fallback_devices), 1)
+    spec = {"topology": {"num_devices": n}}
+    if mesh_axes:
+        spec["mesh"] = mesh_axes
+    return ResourceSpec(spec)
+
+
+class ReshardError(ValueError):
+    """Source/target layouts are incompatible (carries the
+    :class:`~autodist_tpu.analysis.diagnostics.LintReport`)."""
+
+    def __init__(self, report):
+        self.report = report
+        super().__init__(report.render(title="reshard compatibility"))
+
+
+# --------------------------------------------------------------------------- #
+# Recipe-op interpreter (forward = stored → logical) and its inverse.
+# Ops are plain dicts built by kernel.lowering's _op_* helpers; the
+# interpreter runs identically on numpy (host staging, checkpoint
+# decode) and jnp (inside the compiled fast-path program).
+# --------------------------------------------------------------------------- #
+def _pad_to(arr, shape, xp):
+    pads = [(0, int(t) - int(s)) for s, t in zip(arr.shape, shape)]
+    if not any(p[1] for p in pads):
+        return arr
+    return xp.pad(arr, pads)
+
+
+def apply_ops(arr, ops, xp=None):
+    """Apply a recipe-op chain to ``arr`` (numpy in → numpy out, jax
+    in → traced jax out)."""
+    if xp is None:
+        xp = np if isinstance(arr, np.ndarray) else jnp
+    for op in ops:
+        kind = op["op"]
+        if kind == "reshape":
+            arr = arr.reshape(tuple(op["shape"]))
+        elif kind == "slice":
+            arr = arr[tuple(slice(0, int(s)) for s in op["shape"])]
+        elif kind == "index0":
+            arr = arr[xp.asarray(op["indices"], dtype=np.int32)]
+        elif kind == "flat_slice":
+            arr = arr.reshape(-1)[: int(op["size"])]
+        elif kind == "pad":
+            arr = _pad_to(arr, op["shape"], xp)
+        elif kind == "pad_flat":
+            shape = tuple(op["shape"])
+            size = math.prod(shape) if shape else 1
+            arr = _pad_to(arr.reshape(-1), (size,), xp).reshape(shape)
+        else:
+            raise ValueError(f"unknown recipe op {kind!r}")
+    return arr
+
+
+def invert_ops(ops) -> list:
+    """The logical → stored chain of a stored → logical recipe.
+    Mechanical: every op recorded its input shape; padding the inverse
+    re-inserts is zero (the repo-wide invariant that storage padding
+    lanes carry zeros)."""
+    inv = []
+    for op in reversed(list(ops)):
+        kind = op["op"]
+        if kind == "reshape":
+            inv.append({"op": "reshape", "shape": list(op["in_shape"])})
+        elif kind == "slice":
+            inv.append({"op": "pad", "shape": list(op["in_shape"])})
+        elif kind == "index0":
+            order = np.argsort(np.asarray(op["indices"], dtype=np.int64))
+            inv.append({"op": "index0",
+                        "indices": [int(i) for i in order]})
+        elif kind == "flat_slice":
+            inv.append({"op": "pad_flat", "shape": list(op["in_shape"])})
+        else:
+            raise ValueError(f"recipe op {kind!r} is not invertible")
+    return inv
+
+
+# --------------------------------------------------------------------------- #
+# Planning
+# --------------------------------------------------------------------------- #
+@dataclasses.dataclass
+class ReshardPlan:
+    """Per-leaf routes + the compatibility report, computed before any
+    data moves."""
+
+    report: Any                  # analysis LintReport
+    routes: dict                 # path -> "noop" | "recode"
+    sync_transfer: set           # sync_state paths moved verbatim
+    sync_reinit: set             # sync_state paths re-seeded on target
+    bytes_logical: int = 0       # total logical payload bytes
+
+    @property
+    def ok(self) -> bool:
+        return self.report.ok
+
+    def require_ok(self):
+        if not self.report.ok:
+            raise ReshardError(self.report)
+        return self
+
+
+def plan_reshard(source_manifest: dict, target_manifest: dict
+                 ) -> ReshardPlan:
+    """Lint source/target manifests (ADT070/ADT071) and classify every
+    leaf's route.  Raises nothing — callers gate on
+    :meth:`ReshardPlan.require_ok` so one call surfaces ALL
+    findings."""
+    from autodist_tpu.analysis import lint_reshard
+
+    report = lint_reshard(source_manifest, target_manifest)
+    src = source_manifest["leaves"]
+    dst = target_manifest["leaves"]
+    src_sync = source_manifest.get("sync", {})
+    dst_sync = target_manifest.get("sync", {})
+    routes: dict = {}
+    transfer: set = set()
+    reinit: set = set()
+    bytes_logical = 0
+    from autodist_tpu.analysis.plan_rules import sync_rows_transferable
+
+    for path in sorted(set(src) & set(dst)):
+        s, d = src[path], dst[path]
+        if path in dst_sync:
+            same = (path in src_sync and sync_rows_transferable(
+                src_sync[path], dst_sync[path]))
+            (transfer if same else reinit).add(path)
+            continue
+        routes[path] = ("noop" if s["ops"] == d["ops"]
+                        and s["stored_shape"] == d["stored_shape"]
+                        else "recode")
+        elems = math.prod(s["logical_shape"]) if s["logical_shape"] else 1
+        bytes_logical += elems * np.dtype(_parse_dtype(s["dtype"])).itemsize
+    reinit |= set(dst_sync) - set(src_sync) - transfer
+    return ReshardPlan(report=report, routes=routes,
+                       sync_transfer=transfer, sync_reinit=reinit,
+                       bytes_logical=int(bytes_logical))
+
+
+def _parse_dtype(s):
+    from autodist_tpu.checkpoint.export import parse_dtype
+    return parse_dtype(s)
+
+
+# --------------------------------------------------------------------------- #
+# Budgets (the ADT110 shard-granularity bound)
+# --------------------------------------------------------------------------- #
+def shard_budget(*lowered_state_pairs) -> int:
+    """The largest per-device stored-shard element count across the
+    given ``(lowered, state)`` pairs — the ADT110 budget a compiled
+    reshard program's gathers must stay under.  Pass the TARGET layout
+    (legitimate routing materializes at most one target shard per
+    participant — a replicated target leaf legitimately gathers in
+    full, and its budget entry says so; anything larger is a
+    full-array staging the engine promises to avoid).  Add the source
+    only when its shards should also be allowed to materialize."""
+    budget = 1
+    for lowered, state in lowered_state_pairs:
+        shardings = dict(common.flatten_with_names(lowered.state_shardings))
+        for name, leaf in common.flatten_with_names(state):
+            shape = tuple(int(d) for d in np.shape(leaf))
+            sharding = shardings.get(name)
+            if sharding is None:
+                continue
+            local = sharding.shard_shape(shape)
+            budget = max(budget, int(math.prod(local)) if local else 1)
+    return budget
+
+
+# --------------------------------------------------------------------------- #
+# Execution
+# --------------------------------------------------------------------------- #
+def _sync_init_row(lowered, path: str, rec: dict):
+    key = path.split("/", 1)[1]
+    row = (lowered.sync_init or {}).get(key)
+    if row is None:
+        # Last resort: a zero residual (every shipped stateful
+        # compressor initializes its EF residual at zero).
+        return np.zeros((rec["width"],), np.float32)
+    return np.asarray(row, np.float32)
+
+
+def build_convert_fn(src_lowered, src_state, dst_lowered, *,
+                     plan: Optional[ReshardPlan] = None):
+    """The fast-path transfer as ONE jittable function
+    ``convert(src_state) -> dst_state`` with the target layout as
+    ``out_shardings`` — also the program the ADT110 reshard lint
+    compiles.  Requires both meshes to cover the same devices."""
+    src_m = src_lowered.state_manifest(src_state)
+    dst_m, _ = _target_manifest(dst_lowered, src_m)
+    plan = plan or plan_reshard(src_m, dst_m)
+    plan.require_ok()
+    dst_sync = dst_m.get("sync", {})
+
+    def convert(state):
+        flat = dict(common.flatten_with_names(state))
+
+        def build(path, _sharding):
+            name = path_to_name(path)
+            if name in dst_sync:
+                if name in plan.sync_transfer:
+                    return flat[name]
+                rec = dst_sync[name]
+                row = _sync_init_row(dst_lowered, name, rec)
+                return jnp.tile(jnp.asarray(row)[None], (rec["rows"], 1))
+            rec_s, rec_d = src_m["leaves"][name], dst_m["leaves"][name]
+            arr = flat[name]
+            if plan.routes.get(name) != "noop":
+                arr = apply_ops(arr, rec_s["ops"], jnp)
+                arr = apply_ops(arr, invert_ops(rec_d["ops"]), jnp)
+            return arr.astype(_parse_dtype(rec_d["dtype"]))
+
+        return jax.tree_util.tree_map_with_path(
+            build, dst_lowered.state_shardings)
+
+    jitted = jax.jit(convert, out_shardings=dst_lowered.state_shardings)
+    return jitted, plan
+
+
+def _target_manifest(dst_lowered, src_manifest):
+    """The target manifest, from an abstract target state shaped like
+    the source's logical tree run through the target's own init.  A
+    params/extra leaf the source cannot supply is a coded ADT070 error
+    here (the target template cannot even be shaped without it)."""
+    from autodist_tpu.analysis import Diagnostic, LintReport
+
+    leaves = src_manifest["leaves"]
+    missing: list = []
+
+    def abstract(prefix, sub):
+        def leaf(path, _s):
+            name = prefix + path_to_name(path)
+            rec = leaves.get(name)
+            if rec is None:
+                missing.append(name)
+                return jax.ShapeDtypeStruct((), jnp.float32)
+            return jax.ShapeDtypeStruct(tuple(rec["logical_shape"]),
+                                        _parse_dtype(rec["dtype"]))
+        return jax.tree_util.tree_map_with_path(leaf, sub)
+
+    shardings = dst_lowered.state_shardings
+    params = abstract("params/", shardings["params"])
+    extra = abstract("extra/", shardings.get("extra")) \
+        if shardings.get("extra") is not None else None
+    if missing:
+        raise ReshardError(LintReport([Diagnostic(
+            "ADT070", "target state leaf has no counterpart in the "
+            "source layout (target template cannot be shaped)",
+            where=name) for name in missing]))
+    template = jax.eval_shape(dst_lowered.init_fn, params, extra)
+    return dst_lowered.state_manifest(template), template
+
+
+def _same_devices(mesh_a, mesh_b) -> bool:
+    ids_a = sorted(d.id for d in np.asarray(mesh_a.devices).flat)
+    ids_b = sorted(d.id for d in np.asarray(mesh_b.devices).flat)
+    return ids_a == ids_b
+
+
+def reshard_state(src_lowered, src_state, dst_lowered, *,
+                  force_staged: bool = False):
+    """Move ``src_state`` (the source lowering's stored layout) onto
+    the target lowering's layout; returns the target state tree.
+
+    Same-device meshes take the single-compiled-program fast path;
+    different device sets stream leaves through the host one at a
+    time (see the module docstring for the memory bounds).
+    """
+    t0 = time.perf_counter()
+    same = _same_devices(src_lowered.mesh, dst_lowered.mesh)
+    if same and not force_staged:
+        convert, plan = build_convert_fn(src_lowered, src_state,
+                                         dst_lowered)
+        out = convert(src_state)
+        _record(plan, "compiled", t0, peak_host=0)
+        return out
+    src_m = src_lowered.state_manifest(src_state)
+    dst_m, _ = _target_manifest(dst_lowered, src_m)
+    plan = plan_reshard(src_m, dst_m).require_ok()
+    stored = {name: leaf
+              for name, leaf in common.flatten_with_names(src_state)}
+    out = assemble_state(dst_lowered, stored, src_m, dst_m=dst_m,
+                         plan=plan, t0=t0)
+    return out
+
+
+def assemble_state(dst_lowered, stored_by_path: dict, src_manifest: dict,
+                   *, dst_m: Optional[dict] = None,
+                   plan: Optional[ReshardPlan] = None,
+                   t0: Optional[float] = None, peak_base: int = 0):
+    """The staged route: decode source stored leaves to logical one at
+    a time, run the target's own init on the logical params (so target
+    storage transforms have exactly one implementation), then
+    overwrite step/opt/sync leaf-wise through the inverse target
+    recipes.
+
+    ``stored_by_path`` maps state paths to source stored leaves —
+    device arrays from a live runner, or host numpy from a checkpoint
+    restore.  The mapping is CONSUMED: each leaf is popped after its
+    single use, so its host copy is releasable as soon as it is
+    placed.  The decode/re-encode working set on top of the source
+    residency is one leaf at a time — never a second whole-model copy
+    on the host.  ``peak_base`` is the source residency the caller
+    already holds on the host (a checkpoint restore passes the
+    restored tree's total bytes; a live runner passes 0 — its stored
+    leaves live on device), so the recorded ``peak_host_bytes`` is
+    honest, not per-leaf wishful.
+    """
+    t0 = t0 if t0 is not None else time.perf_counter()
+    if dst_m is None:
+        dst_m, _ = _target_manifest(dst_lowered, src_manifest)
+    plan = (plan or plan_reshard(src_manifest, dst_m)).require_ok()
+    src_leaves = src_manifest["leaves"]
+    # Host high-water accounting: `resident` = what of peak_base is
+    # still held as leaves pop (a popped leaf's bytes move into the
+    # `arr` term — counting both would double-count the in-flight
+    # leaf); `held` = decoded logical leaves awaiting consumption (the
+    # params/extra trees are held together until the target's init
+    # consumes them — the live cross-device path's real footprint).
+    peak = int(peak_base)
+    resident = int(peak_base)
+    held = 0
+
+    def logical(name, hold=False):
+        nonlocal peak, resident, held
+        arr = np.asarray(jax.device_get(stored_by_path.pop(name)))
+        if peak_base:
+            resident = max(resident - int(arr.nbytes), 0)
+        out = np.asarray(apply_ops(arr, src_leaves[name]["ops"], np))
+        peak = max(peak,
+                   resident + held + int(arr.nbytes) + int(out.nbytes))
+        if hold:
+            held += int(out.nbytes)
+        return out
+
+    shardings = dst_lowered.state_shardings
+
+    def subtree(prefix, sub):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _s: logical(prefix + path_to_name(path),
+                                     hold=True), sub)
+
+    params = subtree("params/", shardings["params"])
+    extra = subtree("extra/", shardings.get("extra")) \
+        if shardings.get("extra") is not None else None
+    state = dst_lowered.init_state(params=params, extra=extra)
+    del params, extra
+    held = 0       # init consumed (placed) the decoded params/extra
+
+    dst_sync = dst_m.get("sync", {})
+    flat_shardings = dict(common.flatten_with_names(shardings))
+
+    def place(name, arr):
+        return jax.device_put(arr, flat_shardings[name])
+
+    def overwrite(path, leaf):
+        name = path_to_name(path)
+        if name.startswith("params/") or name.startswith("extra/"):
+            return leaf  # init already stored the logical values
+        if name in dst_sync:
+            if name in plan.sync_transfer:
+                return place(name, np.asarray(
+                    jax.device_get(stored_by_path.pop(name))))
+            return leaf  # init's fresh rows
+        rec_d = dst_m["leaves"][name]
+        arr = apply_ops(logical(name), invert_ops(rec_d["ops"]), np)
+        return place(name, arr.astype(_parse_dtype(rec_d["dtype"])))
+
+    state = jax.tree_util.tree_map_with_path(overwrite, state)
+    _record(plan, "staged", t0, peak_host=peak)
+    return state
+
+
+def _record(plan: ReshardPlan, route: str, t0: float, *, peak_host: int):
+    dt = time.perf_counter() - t0
+    telemetry.gauge("reshard/bytes_moved").set(plan.bytes_logical)
+    telemetry.gauge("reshard/peak_host_bytes").set(peak_host)
+    telemetry.record_event(
+        "reshard", route=route, leaves=len(plan.routes),
+        recoded=sum(1 for r in plan.routes.values() if r == "recode"),
+        bytes_moved=plan.bytes_logical, peak_host_bytes=peak_host,
+        sync_reinit=len(plan.sync_reinit), duration_ms=dt * 1e3)
+    logging.info(
+        "reshard (%s route): %d leaves (%d recoded), %.1f MB logical, "
+        "peak host %.1f MB, %d EF bucket(s) re-seeded, %.0f ms",
+        route, len(plan.routes),
+        sum(1 for r in plan.routes.values() if r == "recode"),
+        plan.bytes_logical / 1e6, peak_host / 1e6,
+        len(plan.sync_reinit), dt * 1e3)

@@ -434,6 +434,70 @@ def _reduce_metrics(tree, data_axis: str):
     return jax.tree.map(red, tree)
 
 
+# --------------------------------------------------------------------------- #
+# State-codec recipes: the declarative stored↔logical transform record.
+#
+# Every lowering stores training state in its own layout (padding, flat
+# ZeRO shards, interleave permutations).  A *recipe* is a per-leaf list
+# of invertible primitive ops mapping the stored leaf to its logical
+# (strategy-free) form — plain data, so the elastic-resharding engine
+# (:mod:`autodist_tpu.elastic.reshard`) can apply it traced on device,
+# on host numpy, or invert it mechanically for the target layout, and a
+# checkpoint sidecar can serialize it and decode the stored bytes years
+# later without rebuilding the source mesh.  Ops (forward = stored →
+# logical; each records its input shape so inversion is mechanical,
+# padding re-inserted by the inverse is zero — the repo-wide invariant
+# that padding lanes carry zeros):
+#
+# * ``reshape``   — to ``shape``
+# * ``slice``     — leading ``[0:s]`` per dim to ``shape`` (inverse: pad)
+# * ``index0``    — ``arr[indices]`` along axis 0 (inverse: argsort)
+# * ``flat_slice``— ``arr.reshape(-1)[:size]`` (inverse: pad + reshape)
+# --------------------------------------------------------------------------- #
+def _op_reshape(in_shape, shape):
+    return {"op": "reshape", "in_shape": [int(d) for d in in_shape],
+            "shape": [int(d) for d in shape]}
+
+
+def _op_slice(in_shape, shape):
+    return {"op": "slice", "in_shape": [int(d) for d in in_shape],
+            "shape": [int(d) for d in shape]}
+
+
+def _op_index0(in_shape, indices):
+    return {"op": "index0", "in_shape": [int(d) for d in in_shape],
+            "indices": [int(i) for i in indices]}
+
+
+def _op_flat_slice(in_shape, size):
+    return {"op": "flat_slice", "in_shape": [int(d) for d in in_shape],
+            "size": int(size)}
+
+
+def leaf_record(shape, dtype, ops=()) -> dict:
+    """One manifest leaf: stored shape/dtype + the stored→logical ops.
+    ``logical_shape`` is derived by replaying the ops on shapes alone."""
+    shape = [int(d) for d in shape]
+    logical = list(shape)
+    for op in ops:
+        if op["op"] in ("reshape", "slice"):
+            logical = list(op["shape"])
+        elif op["op"] == "index0":
+            logical = [len(op["indices"])] + logical[1:]
+        elif op["op"] == "flat_slice":
+            logical = [op["size"]]
+    return {"stored_shape": shape, "logical_shape": logical,
+            "dtype": str(np.dtype(jnp.result_type(dtype))
+                         if not isinstance(dtype, str) else dtype),
+            "ops": list(ops)}
+
+
+def _shape_dtype(leaf):
+    return (tuple(int(d) for d in np.shape(leaf)),
+            jnp.result_type(leaf) if hasattr(leaf, "dtype")
+            else np.asarray(leaf).dtype)
+
+
 @dataclasses.dataclass
 class Lowered:
     """Compiled artifacts: jitted init and train-step functions plus the
@@ -447,6 +511,9 @@ class Lowered:
     state_shardings: Any  # pytree of NamedSharding
     batch_spec: Any
     eval_fn: Any = None   # (state, batch, rng) -> metrics (no update)
+    # Compressor error-feedback init rows (bucket key -> host row):
+    # what a resharder re-seeds non-transferable sync_state from.
+    sync_init: Any = None
 
     def init_state(self, params=None, extra=None, trainable=None):
         params = params if params is not None else trainable.params
@@ -472,6 +539,48 @@ class Lowered:
         batched leaves split, scalars duplicate)."""
         return common.batch_specs(batch, self.batch_spec)
 
+    def state_manifest(self, state) -> dict:
+        """The elastic state-codec manifest: per-leaf stored↔logical
+        recipes for every leaf of ``state`` (real arrays or
+        ``ShapeDtypeStruct``s — only shapes/dtypes are read).  See the
+        recipe-ops comment above; consumed by
+        :mod:`autodist_tpu.elastic.reshard` and serialized into the
+        checkpoint sidecar by :class:`~autodist_tpu.checkpoint.saver.
+        Saver`."""
+        plan = self.plan
+        n = plan.num_replicas
+        var_names = list(plan.var_plans)
+        leaves: dict = {}
+        sync: dict = {}
+        for name, leaf in common.flatten_with_names(state):
+            shape, dtype = _shape_dtype(leaf)
+            ops: list = []
+            if name.startswith("params/"):
+                vp = plan.var_plans.get(name[len("params/"):])
+                if vp is not None and vp.stored_sharded \
+                        and shape != tuple(vp.shape):
+                    ops = [_op_slice(shape, vp.shape)]
+            elif name.startswith("opt_state/"):
+                var = common.match_var_by_suffix(
+                    name, var_names,
+                    shape_ok=lambda v: shape
+                    == tuple(plan.var_plans[v].update_shape(n)))
+                if var is not None:
+                    vp = plan.var_plans[var]
+                    if vp.update == U_FLAT and shape != tuple(vp.shape):
+                        size = math.prod(vp.shape) if vp.shape else 1
+                        ops = [_op_flat_slice(shape, size),
+                               _op_reshape((size,), vp.shape)]
+                    elif vp.update == U_AXIS and shape != tuple(vp.shape):
+                        ops = [_op_slice(shape, vp.shape)]
+            elif name.startswith("sync_state/"):
+                key = name[len("sync_state/"):]
+                sync[name] = {
+                    "rows": int(shape[0]), "width": int(shape[1]),
+                    "compressor": plan.bucket_compressor.get(key, "none")}
+            leaves[name] = leaf_record(shape, dtype, ops)
+        return {"family": "collective", "leaves": leaves, "sync": sync}
+
 
 @dataclasses.dataclass
 class SimpleLowered:
@@ -495,6 +604,8 @@ class SimpleLowered:
     # gate is lowering-agnostic, so parallel/gspmd lowerings carry the
     # bound here instead of a Plan.
     ssp_staleness: int = 0
+    # Compressor error-feedback init rows (see Lowered.sync_init).
+    sync_init: Any = None
 
     def init_state(self, params=None, extra=None, trainable=None):
         params = params if params is not None else trainable.params
@@ -509,6 +620,22 @@ class SimpleLowered:
         if self.batch_spec_fn is not None:
             return self.batch_spec_fn(batch)
         return common.batch_specs(batch, self.batch_spec)
+
+    def state_manifest(self, state) -> dict:
+        """Elastic state-codec manifest (see :meth:`Lowered.
+        state_manifest`): these lowerings store every leaf at its
+        logical shape, so every recipe is the identity; sync_state rows
+        carry their transfer metadata."""
+        leaves: dict = {}
+        sync: dict = {}
+        for name, leaf in common.flatten_with_names(state):
+            shape, dtype = _shape_dtype(leaf)
+            if name.startswith("sync_state/") and len(shape) == 2:
+                sync[name] = {"rows": int(shape[0]),
+                              "width": int(shape[1]),
+                              "compressor": "unknown"}
+            leaves[name] = leaf_record(shape, dtype)
+        return {"family": "simple", "leaves": leaves, "sync": sync}
 
 
 def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
@@ -709,4 +836,5 @@ def lower(trainable: Trainable, strategy: Strategy, mesh) -> Lowered:
 
     return Lowered(plan=plan, mesh=mesh, init_fn=init_fn, step_fn=step_fn,
                    state_specs=state_specs, state_shardings=state_shardings,
-                   batch_spec=batch_spec, eval_fn=eval_fn)
+                   batch_spec=batch_spec, eval_fn=eval_fn,
+                   sync_init=dict(sync_init))

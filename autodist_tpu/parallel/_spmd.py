@@ -217,6 +217,14 @@ class ZeroLowered(SimpleLowered):
     # (param already sharded): the plan record that replaced the old
     # warn-and-degrade logging.
     zero_degraded: dict = None
+    # Elastic state-codec builder (closure over build_replicated_spmd's
+    # ZeRO bookkeeping): state tree -> per-leaf stored↔logical recipes.
+    state_manifest_fn: Callable = None
+
+    def state_manifest(self, state) -> dict:
+        if self.state_manifest_fn is None:
+            return super().state_manifest(state)
+        return self.state_manifest_fn(state)
 
     def unpad_params(self, params):
         shapes = self.zero3_shapes or {}
@@ -518,10 +526,52 @@ def build_replicated_spmd(trainable, mesh, *, sync_axes: tuple,
 
     zero3_shapes = {name: tuple(shapes_by_name[name])
                     for name in policies if zero3(name)}
+
+    # --- elastic state-codec manifest (kernel.lowering recipe ops) --------- #
+    def _state_manifest(state):
+        from autodist_tpu.kernel.lowering import (_op_flat_slice,
+                                                  _op_reshape,
+                                                  _shape_dtype, leaf_record)
+
+        def flat_ops(name, shape):
+            logical = tuple(shapes_by_name[name])
+            size = max(int(np.prod(logical)), 1) if logical else 1
+            if shape == logical:
+                return []
+            return [_op_flat_slice(shape, size),
+                    _op_reshape((size,), logical)]
+
+        leaves: dict = {}
+        sync: dict = {}
+        for path_name, leaf in common.flatten_with_names(state):
+            shape, dtype = _shape_dtype(leaf)
+            ops: list = []
+            if path_name.startswith("params/"):
+                name = path_name[len("params/"):]
+                if zero3(name):
+                    ops = flat_ops(name, shape)
+            elif path_name.startswith("opt_state/"):
+                var = common.match_var_by_suffix(
+                    path_name, spec_by_name,
+                    shape_ok=lambda v: shape == u_shape(v))
+                if var is not None and zero_n(var) > 1:
+                    ops = flat_ops(var, shape)
+            elif path_name.startswith("sync_state/"):
+                key = path_name[len("sync_state/"):]
+                pol = policies.get(key)
+                sync[path_name] = {
+                    "rows": int(shape[0]), "width": int(shape[1]),
+                    "compressor": pol.compressor if pol else "none"}
+            leaves[path_name] = leaf_record(shape, dtype, ops)
+        return {"family": "replicated_spmd", "leaves": leaves,
+                "sync": sync}
+
     return ZeroLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
                        state_specs=state_specs,
                        state_shardings=state_shardings,
                        batch_spec=batch_spec, eval_fn=eval_fn,
                        batch_spec_fn=batch_spec_fn,
                        zero3_shapes=zero3_shapes,
-                       zero_degraded=dict(zero_degraded or {}))
+                       zero_degraded=dict(zero_degraded or {}),
+                       state_manifest_fn=_state_manifest,
+                       sync_init=dict(sync_rows))

@@ -279,6 +279,14 @@ class _PipelineLowered(SimpleLowered):
     # everywhere) — the plan record a caller can audit without
     # re-deriving the graph/per-variable adoption rules.
     precision: Any = None
+    # Elastic state-codec builder (closure over _build_pipeline's layout
+    # bookkeeping): state tree -> per-leaf stored↔logical recipes.
+    state_manifest_fn: Any = None
+
+    def state_manifest(self, state) -> dict:
+        if self.state_manifest_fn is None:
+            return super().state_manifest(state)
+        return self.state_manifest_fn(state)
 
     def unpad_params(self, params):
         if self.perm_inv is None:
@@ -1136,6 +1144,99 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     zero3_shapes = {name: tuple(np.shape(leaf))
                     for name, leaf in leaves_by_name.items()
                     if zero3(name)}
+
+    # --- elastic state-codec manifest (kernel.lowering recipe ops) --------- #
+    # One int-listified inverse chunk permutation, shared by every leaf
+    # recipe (state_manifest runs per save/reshard over every leaf).
+    _inv_chunks = [int(i) for i in np.asarray(perm_inv)]
+
+    def _param_ops(name, shape):
+        """Stored→logical ops for one params leaf (``name`` is the full
+        variable name; ``shape`` its stored shape)."""
+        from autodist_tpu.kernel.lowering import (_op_index0, _op_reshape,
+                                                  _op_slice, _op_flat_slice)
+        inv = _inv_chunks
+        logical = tuple(np.shape(leaves_by_name[name]))
+        if is_stage_var(name):
+            if zero3(name):
+                elems = chunk_elems(name)
+                return [_op_slice(shape, (C, elems)),
+                        _op_reshape((C, elems), logical),
+                        _op_index0(logical, inv)]
+            return [_op_index0(shape, inv)]
+        if zero3(name):
+            size = max(int(np.prod(logical)), 1)
+            return [_op_flat_slice(shape, size),
+                    _op_reshape((size,), logical)]
+        if shape != logical:   # vocab-padded shared storage
+            return [_op_slice(shape, logical)]
+        return []
+
+    def _opt_ops(name, shape):
+        """Stored→logical ops for one optimizer-state leaf matched to
+        variable ``name`` (``shape`` = the leaf's stored/u-space
+        shape)."""
+        from autodist_tpu.kernel.lowering import (_op_index0, _op_reshape,
+                                                  _op_slice, _op_flat_slice)
+        pol = zero_pol(name)
+        if pol is None or zero3(name):
+            # Shards-with-the-parameter state (tp/vocab-sharded vars and
+            # plain stacked leaves) and ZeRO-3 storage transform exactly
+            # like the parameter.
+            return _param_ops(name, shape)
+        nz = zero_count(pol)
+        padded = common.padded_flat_size(local_sizes[name], nz)
+        local = local_sizes[name]
+        inv = _inv_chunks
+        logical = tuple(np.shape(leaves_by_name[name]))
+        if is_stage_var(name):
+            stacked = tuple(np.shape(leaves_by_name[name]))
+            return [_op_reshape(shape, (n, padded)),
+                    _op_slice((n, padded), (n, local)),
+                    _op_reshape((n, local), stacked),
+                    _op_index0(stacked, inv)]
+        if name in shared_specs:
+            tp_n = shared_shards(name)
+            padded_shape = shared_padded_shape(name, logical)
+            ops = [_op_reshape(shape, (tp_n, padded)),
+                   _op_slice((tp_n, padded), (tp_n, local)),
+                   _op_reshape((tp_n, local), padded_shape)]
+            if tuple(padded_shape) != logical:
+                ops.append(_op_slice(padded_shape, logical))
+            return ops
+        size = max(int(np.prod(logical)), 1)
+        return [_op_flat_slice(shape, size), _op_reshape((size,), logical)]
+
+    def _state_manifest(state):
+        from autodist_tpu.kernel.lowering import (_op_index0, _shape_dtype,
+                                                  leaf_record)
+        u_by_name = {k: u_shape(k) for k in leaves_by_name}
+        inv = _inv_chunks
+        leaves: dict = {}
+        sync: dict = {}
+        for path_name, leaf in common.flatten_with_names(state):
+            shape, dtype = _shape_dtype(leaf)
+            ops: list = []
+            if path_name.startswith("params/"):
+                ops = _param_ops(path_name[len("params/"):], shape)
+            elif path_name.startswith("opt_state/"):
+                var = common.match_var_by_suffix(
+                    path_name, u_by_name,
+                    shape_ok=lambda v: shape == tuple(u_by_name[v]))
+                if var is not None:
+                    ops = _opt_ops(var, shape)
+                elif len(shape) > 0 and shape and shape[0] == C:
+                    # the opt_specs_tree stacked-leaf heuristic: a
+                    # [C, ...] leaf is pipe-stacked in storage order
+                    ops = [_op_index0(shape, inv)]
+            elif path_name.startswith("sync_state/"):
+                key = path_name[len("sync_state/"):]
+                pol = comp_policies.get(key)
+                sync[path_name] = {
+                    "rows": int(shape[0]), "width": int(shape[1]),
+                    "compressor": pol.compressor if pol else "none"}
+            leaves[path_name] = leaf_record(shape, dtype, ops)
+        return {"family": "pipeline", "leaves": leaves, "sync": sync}
     return _PipelineLowered(mesh=mesh, init_fn=init_fn, step_fn=step_fn,
                             state_specs=state_specs,
                             state_shardings=state_shardings,
@@ -1144,7 +1245,9 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                             shared_orig_shapes=shared_orig_shapes,
                             zero3_shapes=zero3_shapes,
                             zero_degraded=zero_degraded,
-                            precision=dict(precision))
+                            precision=dict(precision),
+                            state_manifest_fn=_state_manifest,
+                            sync_init=dict(sync_rows))
 
 
 def lower_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,

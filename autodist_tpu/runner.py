@@ -48,6 +48,9 @@ class DistributedRunner:
         self.trainable = trainable
         self.lowered = lowered
         self.mesh = lowered.mesh
+        # The Strategy this runner was built from (set by AutoDist._build;
+        # the checkpoint Saver binds it into the elastic sidecar).
+        self.strategy = None
         self.state = lowered.init_state(trainable=trainable)
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._step_times: list[float] = []

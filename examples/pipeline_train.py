@@ -88,6 +88,17 @@ def main():
                          "pruned/priced, frontier top-10 with "
                          "per-level comm breakdown, winner knob "
                          "string), and train the winner")
+    ap.add_argument("--preempt-demo", action="store_true",
+                    help="simulate a mid-run preemption: at the halfway "
+                         "step a SIGTERM triggers a blocking elastic "
+                         "checkpoint, the run shrinks to half the "
+                         "devices, the topology-aware search re-elects "
+                         "a winner on the survivors, the checkpoint is "
+                         "resharded onto it, and training resumes "
+                         "(docs/usage/elasticity.md)")
+    ap.add_argument("--preempt-ckpt-dir", default=None,
+                    help="checkpoint directory for --preempt-demo "
+                         "(default: a temp dir)")
     ap.add_argument("--num-slices", type=int, default=1,
                     help="declare a multi-slice topology (with "
                          "--auto-search): the outer dp axis rides DCN "
@@ -310,8 +321,36 @@ def main():
                 else nullcontext())
     import time
 
+    controller = None
+    if args.preempt_demo:
+        import tempfile
+
+        from autodist_tpu.checkpoint.saver import Saver
+        from autodist_tpu.elastic import ElasticController
+
+        ckpt_dir = args.preempt_ckpt_dir or tempfile.mkdtemp(
+            prefix="elastic_ckpt_")
+        controller = ElasticController(trainable, Saver(ckpt_dir),
+                                       global_batch=args.batch)
+        controller.install(runner)
+
     with trace_cm:
         for step in range(args.steps):
+            if controller is not None and step == max(args.steps // 2, 1):
+                # Simulated preemption: the SIGTERM handler writes a
+                # blocking elastic checkpoint; the survivors (here:
+                # half the devices) re-elect via the topology-aware
+                # search and resume from the resharded checkpoint.
+                import signal as _signal
+
+                os.kill(os.getpid(), _signal.SIGTERM)
+                assert controller.preempted
+                survivors = max(jax.device_count() // 2, 1)
+                runner = controller.resume({"num_devices": survivors})
+                print(f"preemption at step {step}: resumed on "
+                      f"{survivors} device(s), mesh "
+                      f"{dict(runner.lowered.mesh.shape)}, strategy "
+                      f"{controller.last_result.winner.name}")
             batch = make_batch()
             t_step = time.perf_counter()
             with timer:

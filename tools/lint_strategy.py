@@ -133,7 +133,7 @@ def _train_program_text(strategy, spec, trainable, batch) -> str:
 
 
 def lint_zoo(max_programs=None, plan_only=False, decode=True,
-             out=print) -> tuple[int, int, list]:
+             reshard=True, out=print) -> tuple[int, int, list]:
     """Sweep the zoo; returns ``(n_errors, n_warnings, results)``."""
     from autodist_tpu.analysis import (lint_plan, lint_program,
                                        rules_for_decode,
@@ -209,6 +209,33 @@ def lint_zoo(max_programs=None, plan_only=False, decode=True,
             n_warn += len(prog.warnings)
             out(f"{name}: program {len(prog.errors)}E/"
                 f"{len(prog.warnings)}W ({len(rules)} rules)")
+            results.append({"candidate": name,
+                            "program": [d.to_dict() for d in prog],
+                            "program_rules": [r.name for r in rules]})
+
+    if reshard and not plan_only:
+        # The elastic reshard program: FSDP axis-0 shards re-laid as
+        # axis-1 shards, ONE compiled program — its contract (ADT110:
+        # no gather beyond the target-shard budget; ADT101: no host
+        # staging) is the memory-efficient-redistribution claim.
+        from autodist_tpu.analysis import rules_for_reshard
+
+        name = "reshard/axis0->axis1"
+        if max_programs is not None and compiled >= max_programs:
+            out(f"{name}: SKIPPED (--max-programs budget)")
+            results.append({"candidate": name,
+                            "program": "skipped (--max-programs "
+                                       "budget)"})
+        else:
+            compiled += 1
+            text = programs.reshard_step_text()
+            rules = rules_for_reshard(programs.reshard_budget())
+            prog = lint_program(text, rules, where=name)
+            n_err += len(prog.errors)
+            n_warn += len(prog.warnings)
+            out(f"{name}: program {len(prog.errors)}E/"
+                f"{len(prog.warnings)}W (gather budget "
+                f"{programs.reshard_budget()} elems)")
             results.append({"candidate": name,
                             "program": [d.to_dict() for d in prog],
                             "program_rules": [r.name for r in rules]})
@@ -358,6 +385,8 @@ def main(argv=None) -> int:
                     help="skip the program compiles (plan lint only)")
     ap.add_argument("--no-decode", action="store_true",
                     help="skip the decode-window programs")
+    ap.add_argument("--no-reshard", action="store_true",
+                    help="skip the elastic reshard program")
     ap.add_argument("--max-programs", type=int, default=None,
                     metavar="N",
                     help="compile at most N programs (CI budget "
@@ -381,7 +410,8 @@ def main(argv=None) -> int:
     if args.zoo:
         zoo_err, zoo_warn, report["zoo"] = lint_zoo(
             max_programs=args.max_programs, plan_only=args.plan_only,
-            decode=not args.no_decode, out=out)
+            decode=not args.no_decode, reshard=not args.no_reshard,
+            out=out)
         n_err += zoo_err
         print(f"zoo sweep: {zoo_err} error(s), {zoo_warn} warning(s) "
               f"across {len(report['zoo'])} candidate(s)")

@@ -30,7 +30,13 @@ _PRECISION_BITS = {"fp32": 32, "bf16": 16, "int8": 8}
 # Per-request serving records (autodist_tpu/serving/batcher.py): the
 # latency facts the serving section aggregates.
 _SERVE_KEYS = {"kind", "request", "tokens", "ttft_ms", "tokens_per_sec"}
-_KINDS = ("step", "serve", "counter", "gauge", "histogram")
+# Per-reshard records (autodist_tpu/elastic/reshard.py): one per
+# executed reshard — route taken (compiled fast path vs host-staged),
+# payload moved, and the host-memory high-water mark the staged route
+# is bounded by.
+_RESHARD_KEYS = {"kind", "route", "leaves", "bytes_moved",
+                 "peak_host_bytes", "duration_ms"}
+_KINDS = ("step", "serve", "reshard", "counter", "gauge", "histogram")
 
 
 def load_jsonl(path: str) -> list[dict]:
@@ -76,6 +82,19 @@ def check_schema(run_dir: str) -> list[str]:
                 problems.append(
                     f"metrics.jsonl:{i + 1}: serve record missing "
                     f"{sorted(missing)}")
+        elif kind == "reshard":
+            missing = _RESHARD_KEYS - set(rec)
+            if missing:
+                problems.append(
+                    f"metrics.jsonl:{i + 1}: reshard record missing "
+                    f"{sorted(missing)}")
+            elif rec["route"] == "compiled" \
+                    and rec.get("peak_host_bytes"):
+                problems.append(
+                    f"metrics.jsonl:{i + 1}: compiled-route reshard "
+                    f"claims peak_host_bytes="
+                    f"{rec['peak_host_bytes']} — the fast path must "
+                    "never stage through the host")
         elif "name" not in rec:
             problems.append(f"metrics.jsonl:{i + 1}: {kind} without name")
         elif kind == "histogram" and "count" not in rec:
@@ -171,6 +190,7 @@ def render(run_dir: str) -> str:
     records = load_jsonl(os.path.join(run_dir, "metrics.jsonl"))
     steps = [r for r in records if r.get("kind") == "step"]
     serves = [r for r in records if r.get("kind") == "serve"]
+    reshards = [r for r in records if r.get("kind") == "reshard"]
     counters = [r for r in records if r.get("kind") == "counter"]
     gauges = [r for r in records if r.get("kind") == "gauge"]
     hists = [r for r in records if r.get("kind") == "histogram"]
@@ -239,6 +259,18 @@ def render(run_dir: str) -> str:
                   f"| {_fmt(itl['p99'] if itl else None)} "
                   f"| {_fmt(float(np.percentile(rates, 50)) if rates else None)} "
                   f"| {_fmt(depth)} |", ""]
+
+    if reshards:
+        lines += ["## reshards", "",
+                  "| route | leaves | MB moved | peak host MB | ms |",
+                  "|---|---|---|---|---|"]
+        for r in reshards:
+            lines.append(
+                f"| {r['route']} | {r['leaves']} "
+                f"| {_fmt(r['bytes_moved'] / 1e6)} "
+                f"| {_fmt(r['peak_host_bytes'] / 1e6)} "
+                f"| {_fmt(r['duration_ms'])} |")
+        lines.append("")
 
     if counters or gauges:
         lines += ["## counters / gauges", "", "| name | value |", "|---|---|"]
