@@ -72,6 +72,14 @@ CODES: dict[str, tuple[str, str]] = {
     "ADT071": (WARNING, "compressor error-feedback state not "
                         "transferable across this reshard "
                         "(reinitialized on the target)"),
+    "ADT080": (ERROR, "supervised escalation with no saver attached "
+                      "(shrink-to-survivors would resume from nothing: "
+                      "silent state loss)"),
+    "ADT081": (ERROR, "heartbeat interval >= heartbeat timeout (every "
+                      "healthy worker is declared dead between beats)"),
+    "ADT082": (WARNING, "worst-case restart backoff exceeds the SSP "
+                        "staleness window (every peer stalls at the "
+                        "gate while the worker restarts)"),
     # --- program lint (optimized HLO) -------------------------------- #
     "ADT101": (ERROR, "step program contains a host transfer"),
     "ADT102": (ERROR, "multi-step window lowered without a fused loop"),

@@ -208,6 +208,76 @@ class ReshardMutation:
                 "description": self.description}
 
 
+def _supervision_fixture():
+    """A CLEAN supervised-recovery config (saver attached, sane
+    heartbeat cadence, restart backoff inside the SSP window) over a
+    staleness-2 SSP strategy — the base every ADT08x mutation doctors."""
+    from autodist_tpu.runtime.cluster import SupervisionConfig
+    from autodist_tpu.runtime.retry import RetryPolicy
+    from autodist_tpu.strategy.ir import (GraphConfig, NodeConfig,
+                                          PSSynchronizer, Strategy)
+
+    strategy = Strategy(
+        node_configs=[NodeConfig(var_name="w",
+                                 synchronizer=PSSynchronizer(staleness=2))],
+        graph_config=GraphConfig(replicas=1))
+    config = SupervisionConfig(
+        max_restarts=1,
+        restart_backoff=RetryPolicy(max_attempts=2, base_delay_s=0.2,
+                                    cap_delay_s=0.2, jitter=0.5),
+        heartbeat_interval_s=0.5, heartbeat_timeout_s=3.0,
+        escalate=True, saver=object(), step_time_estimate_s=1.0)
+    return config, strategy
+
+
+@dataclasses.dataclass
+class SupervisionMutation:
+    """Doctor a clean SupervisionConfig; the supervision lint must fire
+    ``code`` on the doctored config and stay silent on the honest one."""
+
+    name: str
+    code: str
+    description: str
+    mutate: Callable  # (SupervisionConfig) -> SupervisionConfig
+    kind: str = "supervision"
+
+    def run(self) -> dict:
+        from autodist_tpu.analysis.plan_rules import lint_supervision
+
+        config, strategy = _supervision_fixture()
+        clean = lint_supervision(config, strategy=strategy)
+        mutated = lint_supervision(self.mutate(config), strategy=strategy)
+        return {"name": self.name, "kind": self.kind, "code": self.code,
+                "clean_ok": self.code not in clean.codes(),
+                "fired": self.code in mutated.codes(),
+                "description": self.description}
+
+
+def _supervision_mutations() -> list[SupervisionMutation]:
+    import dataclasses as dc
+
+    from autodist_tpu.runtime.retry import RetryPolicy
+
+    return [
+        SupervisionMutation(
+            "escalation_without_saver", "ADT080",
+            "escalate=True with the saver detached — shrink-to-"
+            "survivors would resume from nothing (silent state loss)",
+            lambda c: dc.replace(c, saver=None)),
+        SupervisionMutation(
+            "heartbeat_interval_beyond_timeout", "ADT081",
+            "heartbeat interval raised past the timeout — every "
+            "healthy worker declared dead between beats",
+            lambda c: dc.replace(c, heartbeat_interval_s=5.0)),
+        SupervisionMutation(
+            "restart_backoff_outlasts_ssp_window", "ADT082",
+            "restart backoff cap raised beyond the SSP staleness "
+            "window — peers stall at the gate on every restart",
+            lambda c: dc.replace(c, restart_backoff=RetryPolicy(
+                max_attempts=6, base_delay_s=2.0, cap_delay_s=30.0))),
+    ]
+
+
 def _reshard_mutations() -> list[ReshardMutation]:
     def drop_leaf(src, dst):
         dst["leaves"].pop("params/b")
@@ -617,7 +687,8 @@ def _program_mutations() -> list[ProgramMutation]:
 
 
 def all_mutations() -> list:
-    return _plan_mutations() + _program_mutations() + _reshard_mutations()
+    return (_plan_mutations() + _program_mutations()
+            + _reshard_mutations() + _supervision_mutations())
 
 
 def run_mutations(names=None, kinds=None) -> list[dict]:

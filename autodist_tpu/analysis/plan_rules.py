@@ -619,6 +619,87 @@ def lint_reshard(source_manifest: dict, target_manifest: dict) -> LintReport:
 
 
 # --------------------------------------------------------------------------- #
+# Supervision lint (chaos-hardened runtime, ADT080-ADT082)
+# --------------------------------------------------------------------------- #
+def _max_ssp_staleness(strategy) -> int:
+    """The largest SSP staleness any synchronizer in the plan declares
+    (0 = bulk-synchronous; no SSP gate to stall)."""
+    stale = 0
+    if strategy is None:
+        return stale
+    for nc in strategy.node_configs:
+        stale = max(stale, int(getattr(nc.synchronizer, "staleness", 0)
+                               or 0))
+    return stale
+
+
+def lint_supervision(config, strategy: Optional[Strategy] = None
+                     ) -> LintReport:
+    """Check a :class:`~autodist_tpu.runtime.cluster.SupervisionConfig`
+    (or its ``to_dict`` form) for the misconfigurations that turn
+    supervised recovery into silent damage — BEFORE any worker is
+    launched, like every other plan-level lint.  Pass the job's
+    ``strategy`` so SSP-dependent rules see the staleness the plan
+    actually runs with.
+
+    * **ADT080** (error): escalation enabled with no saver attached —
+      shrink-to-survivors "resumes" from nothing, silently dropping all
+      training state.
+    * **ADT081** (error): heartbeat interval >= heartbeat timeout — a
+      perfectly healthy worker is declared dead between two beats.
+    * **ADT082** (warning): the restart backoff's worst case outlasts
+      the SSP staleness window (``staleness x step_time_estimate_s``) —
+      every peer blocks at the SSP gate for the overhang, so the
+      restart budget quietly serializes the whole fleet.
+    """
+    d = config.to_dict() if hasattr(config, "to_dict") else dict(config)
+    report = LintReport()
+    if d.get("escalate") and not d.get("has_saver"):
+        report.extend([Diagnostic(
+            "ADT080",
+            "escalate=True but no saver attached: the survivor set "
+            "would re-elect and resume with NO checkpoint to restore — "
+            "all training state silently lost",
+            where="supervision.saver",
+            fix="pass saver=Saver(ckpt_dir) in the SupervisionConfig "
+                "(the store ElasticController.resume restores from)")])
+    interval = d.get("heartbeat_interval_s")
+    timeout = d.get("heartbeat_timeout_s")
+    if interval is not None and timeout is not None and interval >= timeout:
+        report.extend([Diagnostic(
+            "ADT081",
+            f"heartbeat_interval_s={interval} >= "
+            f"heartbeat_timeout_s={timeout}: a healthy worker's counter "
+            "looks stalled between two scheduled beats",
+            where="supervision.heartbeat_interval_s",
+            fix="keep the interval well under the timeout (3-5 beats "
+                "per timeout window absorbs scheduler jitter)")])
+    stale = _max_ssp_staleness(strategy)
+    backoff = d.get("restart_backoff") or {}
+    if stale > 0 and backoff:
+        try:
+            from autodist_tpu.runtime.retry import RetryPolicy
+
+            policy = config.restart_backoff if hasattr(
+                config, "restart_backoff") else RetryPolicy(**backoff)
+            worst = policy.max_total_delay_s()
+        except (TypeError, ValueError):
+            worst = None
+        window = stale * float(d.get("step_time_estimate_s", 1.0) or 1.0)
+        if worst is not None and worst > window:
+            report.extend([Diagnostic(
+                "ADT082",
+                f"worst-case restart backoff {worst:.1f}s exceeds the "
+                f"SSP staleness window {window:.1f}s "
+                f"(staleness={stale}): every peer stalls at the SSP "
+                "gate for the overhang on each restart",
+                where="supervision.restart_backoff",
+                fix="lower cap_delay_s/max_attempts, or raise the SSP "
+                    "staleness so a restarting worker fits the window")])
+    return report.sorted()
+
+
+# --------------------------------------------------------------------------- #
 # Entry point
 # --------------------------------------------------------------------------- #
 def lint_plan(strategy: Strategy, resource_spec=None, trainable=None,

@@ -29,7 +29,24 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from autodist_tpu.runtime.retry import RetryError, RetryPolicy
 from autodist_tpu.utils import logging
+
+
+class CheckpointSaveError(RuntimeError):
+    """A checkpoint write failed (sync after retries, or an async
+    commit surfacing at the next join point); ``step`` is the step
+    whose save failed — never "an arbitrary later orbax call"."""
+
+    def __init__(self, message: str, *, step: Optional[int] = None):
+        super().__init__(message)
+        self.step = step
+
+
+def _fault_target() -> str:
+    from autodist_tpu.runtime.faults import fault_target
+
+    return fault_target()
 
 # Per-step elastic sidecar directory (inside the checkpoint root; orbax
 # ignores non-step-shaped entries).  Each full save drops
@@ -44,29 +61,83 @@ class Saver:
     """Save/restore for :class:`~autodist_tpu.runner.DistributedRunner`
     state (≙ reference ``autodist.checkpoint.saver.Saver``)."""
 
-    def __init__(self, directory: str, *, async_save: bool = False):
+    def __init__(self, directory: str, *, async_save: bool = False,
+                 retry: Optional[RetryPolicy] = None,
+                 degrade_on_failure: bool = False):
         """``async_save=True`` returns from :meth:`save` as soon as state
         is staged off the devices (Orbax copies device→host synchronously,
         then commits to disk in background), so checkpointing overlaps the
         next training steps — safe with buffer donation, since the staged
         copy no longer aliases device memory.  :meth:`wait` (or the next
-        save/restore/close) joins the in-flight write."""
+        save/restore/close) joins the in-flight write.
+
+        ``retry`` bounds re-attempts of a failed write (the shared
+        :class:`RetryPolicy`; ``None`` = one attempt, today's exact
+        behavior).  ``degrade_on_failure=True`` turns a write that still
+        fails after retries into a *coded degrade* instead of an
+        exception: the failure is counted (``ckpt/save_failures`` /
+        ``ckpt/async_save_failures``), recorded as a ``kind="fault"``
+        telemetry event, and training continues on the last good
+        checkpoint — a long-running job must not die because one
+        checkpoint rotation hit a full disk."""
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._async = async_save
+        self._retry = retry
+        self._degrade = degrade_on_failure
+        self._inflight_step: Optional[int] = None
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=5,
                                                  create=True))
 
     # ------------------------------------------------------------------ #
+    def _join_inflight(self):
+        """Join any in-flight async commit.  A failed background write
+        surfaces HERE, attributed to the step that staged it — as a
+        typed :class:`CheckpointSaveError` (or a coded degrade under
+        ``degrade_on_failure``) — instead of leaking out of whichever
+        orbax call happened to trip over it later."""
+        step, self._inflight_step = self._inflight_step, None
+        try:
+            self._mgr.wait_until_finished()
+        except Exception as e:  # noqa: BLE001 — orbax surfaces arbitrary
+            # exception types from the background commit thread
+            from autodist_tpu import telemetry
+
+            telemetry.counter("ckpt/async_save_failures").inc()
+            if not self._degrade:
+                raise CheckpointSaveError(
+                    f"async checkpoint save of step {step} failed: "
+                    f"{type(e).__name__}: {e}", step=step) from e
+            last_good = self._last_good_step()
+            telemetry.record_event(
+                "fault", fault="ckpt_write_fail", target=_fault_target(),
+                phase="degraded", step=step,
+                action="continue_on_last_good", last_good_step=last_good)
+            logging.error(
+                "async checkpoint save of step %s failed (%s); training "
+                "continues on the last good checkpoint (step %s)",
+                step, e, last_good)
+
+    def _last_good_step(self) -> Optional[int]:
+        try:
+            steps = self._mgr.all_steps()
+            return max(steps) if steps else None
+        except Exception:  # noqa: BLE001 — best-effort diagnostics only
+            return None
+
     def save(self, runner, *, portable: bool = False, force: bool = False,
              blocking: Optional[bool] = None):
         """Write a checkpoint at the runner's current step.
 
         ``blocking`` overrides the constructor's ``async_save`` for this
         call (the preemption hook forces ``blocking=True`` — the process
-        is about to die)."""
+        is about to die).  Returns the step written, or ``None`` when a
+        failed write degraded (``degrade_on_failure``) — the last good
+        checkpoint stands and training goes on."""
+        self._join_inflight()   # a failed async save surfaces first,
+        #                         with ITS step number
         step = runner.step_count
         if portable:
             # Host arrays: the portable layout is sharding-free on disk
@@ -80,15 +151,47 @@ class Saver:
         else:
             payload = dict(runner.state)
         payload = {k: v for k, v in payload.items() if v is not None}
-        self._mgr.save(step, args=ocp.args.StandardSave(payload),
-                       force=force)
-        self._write_sidecar(runner, step, portable=portable)
         block = (not self._async) if blocking is None else blocking
+
+        def write():
+            self._mgr.save(step, args=ocp.args.StandardSave(payload),
+                           force=force)
+            if block:
+                self._mgr.wait_until_finished()
+
+        try:
+            if self._retry is not None:
+                self._retry.call(write, describe=f"ckpt save step {step}")
+            else:
+                write()
+        except Exception as e:  # noqa: BLE001 — deliberately broad: a
+            # write failure is whatever the filesystem/orbax raised
+            # (RetryError included); the classification of *retryable*
+            # already happened inside the policy, this is the terminal
+            # outcome
+            from autodist_tpu import telemetry
+
+            telemetry.counter("ckpt/save_failures").inc()
+            if not self._degrade:
+                raise CheckpointSaveError(
+                    f"checkpoint save of step {step} failed: "
+                    f"{type(e).__name__}: {e}", step=step) from e
+            last_good = self._last_good_step()
+            telemetry.record_event(
+                "fault", fault="ckpt_write_fail", target=_fault_target(),
+                phase="degraded", step=step,
+                action="continue_on_last_good", last_good_step=last_good)
+            logging.error(
+                "checkpoint save of step %d FAILED after retries (%s); "
+                "training continues on the last good checkpoint "
+                "(step %s)", step, e, last_good)
+            return None
+        self._write_sidecar(runner, step, portable=portable)
         if block:
-            self._mgr.wait_until_finished()
             logging.info("checkpoint step %d saved to %s (portable=%s)",
                          step, self.directory, portable)
         else:  # commit still in flight — "saved" would be premature
+            self._inflight_step = step
             logging.info("checkpoint step %d staged (async) for %s "
                          "(portable=%s)", step, self.directory, portable)
         return step
@@ -157,11 +260,14 @@ class Saver:
             return json.load(f)
 
     def wait(self):
-        """Join any in-flight async save (no-op when idle)."""
-        self._mgr.wait_until_finished()
+        """Join any in-flight async save (no-op when idle).  A failed
+        background commit surfaces here as
+        :class:`CheckpointSaveError` carrying the failed step (or as a
+        coded degrade under ``degrade_on_failure``)."""
+        self._join_inflight()
 
     def latest_step(self) -> Optional[int]:
-        self._mgr.wait_until_finished()
+        self._join_inflight()
         return self._mgr.latest_step()
 
     def restore(self, runner, step: Optional[int] = None):
@@ -381,4 +487,7 @@ class Saver:
         return previous
 
     def close(self):
+        self._join_inflight()   # a failed async save surfaces with its
+        #                         step even when close() is the first
+        #                         join point after it
         self._mgr.close()

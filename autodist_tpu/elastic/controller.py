@@ -113,6 +113,7 @@ class ElasticController:
         agnostic."""
         from autodist_tpu.autodist import AutoDist
 
+        preempted = self._preempted.is_set()
         if strategy is None or spec is None:
             strategy, spec = self.elect(topology)
         if self._runner is not None:
@@ -127,6 +128,17 @@ class ElasticController:
         self.saver.restore_elastic(runner, step=step)
         self._runner = runner    # the preemption hook follows the swap
         telemetry.counter("elastic/resumes").inc()
+        if preempted:
+            # Close the fault-record loop: a preemption-driven resume IS
+            # the recovery of the injected/real preempt_signal — the
+            # telemetry report pairs this with the injection record.
+            from autodist_tpu.runtime.faults import fault_target
+
+            telemetry.record_event(
+                "fault", fault="preempt_signal", target=fault_target(),
+                phase="recovered", action="shrink_resume",
+                step=runner.step_count,
+                mesh=dict(runner.lowered.mesh.shape))
         self._preempted.clear()
         logging.info(
             "elastic resume at step %d on mesh %s (strategy %s)",

@@ -1,10 +1,28 @@
-"""Multi-host runtime: cluster launcher, native host-coordination service."""
-from autodist_tpu.runtime.cluster import (Cluster, Coordinator, WorkerHandle,
-                                          make_global_batch)
-from autodist_tpu.runtime.coordination import (CoordClient, CoordServer,
-                                               SSPController, service_client)
+"""Multi-host runtime: cluster launcher, native host-coordination
+service, shared retry/backoff policy, and the chaos/fault-injection
+subsystem that proves the recovery paths work."""
+from autodist_tpu.runtime.cluster import (Cluster, Coordinator,  # noqa: F401
+                                          HeartbeatMonitor, LocalCluster,
+                                          SupervisionConfig, WorkerHandle,
+                                          heartbeat, make_global_batch)
+from autodist_tpu.runtime.coordination import (CoordClient,  # noqa: F401
+                                               CoordServer,
+                                               CoordUnavailableError,
+                                               SSPController,
+                                               service_client)
+from autodist_tpu.runtime.faults import (FAULT_KINDS, FaultInjector,  # noqa: F401,E501
+                                         FaultPlan, FaultSpec,
+                                         install_ckpt_write_fail,
+                                         load_fault_plan)
+from autodist_tpu.runtime.retry import (RetryError, RetryPolicy,  # noqa: F401
+                                        backoff_delay)
 
 __all__ = [
-    "Cluster", "Coordinator", "WorkerHandle", "make_global_batch",
-    "CoordClient", "CoordServer", "SSPController", "service_client",
+    "Cluster", "Coordinator", "HeartbeatMonitor", "LocalCluster",
+    "SupervisionConfig", "WorkerHandle", "heartbeat", "make_global_batch",
+    "CoordClient", "CoordServer", "CoordUnavailableError", "SSPController",
+    "service_client",
+    "FAULT_KINDS", "FaultInjector", "FaultPlan", "FaultSpec",
+    "install_ckpt_write_fail", "load_fault_plan",
+    "RetryError", "RetryPolicy", "backoff_delay",
 ]

@@ -27,11 +27,27 @@ import weakref
 from typing import Optional
 
 from autodist_tpu import const
+from autodist_tpu.runtime.retry import RetryError, RetryPolicy
 from autodist_tpu.utils import logging
 
 _lib = None
 
 OK, TIMEOUT, ERROR = 0, 1, 2
+
+
+class CoordUnavailableError(OSError):
+    """The coordination service stayed unreachable through the client's
+    whole reconnect-and-retry budget.  Typed (instead of the ambiguous
+    bare ``OSError``/``None`` a single failed call used to produce) so
+    callers can distinguish "the control plane is gone" from "this one
+    request failed" and hand off to supervised recovery."""
+
+
+# Reconnect budget for a CoordClient call that hits a dropped/stale
+# socket (a chief restart, a bounced server, a TCP reset): a few quick
+# attempts spanning ~10s.  The happy path never touches it.
+DEFAULT_COORD_RETRY = RetryPolicy(max_attempts=4, base_delay_s=0.25,
+                                  cap_delay_s=2.0, deadline_s=30.0)
 
 
 def _load():
@@ -147,17 +163,83 @@ class CoordClient:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  connect_timeout_ms: int = 10000,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 retry: Optional[RetryPolicy] = DEFAULT_COORD_RETRY):
         self._lib = _load()
         self._shutdown = False
         if token is None:
             token = const.ENV.AUTODIST_TPU_COORD_TOKEN.val
+        self._host, self._port = host, port
+        self._token = token or ""
+        self._connect_timeout_ms = connect_timeout_ms
+        self._retry = retry
         self._handle = self._lib.coord_client_connect(
             host.encode(), port, connect_timeout_ms, (token or "").encode())
         if not self._handle:
             raise OSError(
                 f"could not connect to coordinator {host}:{port} "
                 "(unreachable or token rejected)")
+
+    def _reconnect(self):
+        """Drop the (presumed dead) native client and dial again with
+        the connection parameters of the original connect."""
+        if self._handle:
+            self._lib.coord_client_close(self._handle)
+            self._handle = None
+        handle = self._lib.coord_client_connect(
+            self._host.encode(), self._port, self._connect_timeout_ms,
+            self._token.encode())
+        if not handle:
+            raise OSError(
+                f"could not reconnect to coordinator "
+                f"{self._host}:{self._port}")
+        self._handle = handle
+
+    def _call(self, op: "Callable", describe: str):
+        """Run one RPC closure; a failed call (dropped socket, server
+        bounce, stale connection) reconnects and retries under the
+        client's :class:`RetryPolicy`, surfacing
+        :class:`CoordUnavailableError` when the budget is exhausted.
+        The happy path is the single native call it always was.
+
+        Retried ops are **at-least-once**: a request the server
+        processed whose OK response died with the socket is re-sent
+        after reconnect, so a ``counter_add``/``queue_put``/``barrier``
+        may land twice across a reconnect race.  The control-plane uses
+        here tolerate that (heartbeat counters are freshness signals,
+        KV puts are idempotent, barriers are generation-keyed); a
+        caller needing at-most-once passes ``retry=None`` and handles
+        the raw ``OSError`` itself."""
+        from autodist_tpu import telemetry
+
+        try:
+            return op()
+        except OSError:
+            if self._retry is None or self._shutdown or not self._handle:
+                raise    # opted out, or a deliberate cross-thread wake
+
+            def reconnect_and_retry():
+                if self._shutdown:   # woken mid-retry: stop dialing
+                    raise RetryError(f"{describe}: client shut down",
+                                     attempts=0)
+                self._reconnect()
+                return op()
+
+            telemetry.counter("coord/reconnects").inc()
+            try:
+                result = self._retry.call(reconnect_and_retry,
+                                          describe=describe)
+            except RetryError as e:
+                telemetry.counter("coord/unavailable").inc()
+                raise CoordUnavailableError(
+                    f"coordination service {self._host}:{self._port} "
+                    f"unavailable: {e}") from e
+            except OSError as e:     # non-retryable by classification
+                raise CoordUnavailableError(
+                    f"coordination service {self._host}:{self._port} "
+                    f"unavailable: {e}") from e
+            telemetry.counter("coord/reconnect_successes").inc()
+            return result
 
     def close(self):
         """Free the native client.  Only the owning thread may call this:
@@ -188,67 +270,118 @@ class CoordClient:
 
     # ------------------------------------------------------------------ #
     def put(self, key: str, value: bytes):
-        if self._lib.coord_put(self._handle, key.encode(), value,
-                               len(value)) != OK:
-            raise OSError(f"put({key}) failed")
+        def op():
+            if self._lib.coord_put(self._handle, key.encode(), value,
+                                   len(value)) != OK:
+                raise OSError(f"put({key}) failed")
+        return self._call(op, f"put({key})")
 
     def get(self, key: str, timeout_ms: int = 0) -> Optional[bytes]:
         """Returns the value, blocking up to ``timeout_ms`` (-1 = forever)
-        for it to appear; None on timeout."""
-        out = ctypes.c_void_p()
-        out_len = ctypes.c_uint32()
-        st = self._lib.coord_get(self._handle, key.encode(), timeout_ms,
-                                 ctypes.byref(out), ctypes.byref(out_len))
-        if st == TIMEOUT:
-            return None
-        if st != OK:
-            raise OSError(f"get({key}) failed")
-        return self._take(out, out_len)
+        for it to appear; None on a genuine timeout.  A *premature*
+        timeout — the server answers ``TIMEOUT`` to every blocked get
+        when it is shutting down — is treated as a dropped connection
+        (reconnect-and-retry with the remaining budget), not silently
+        returned as the ambiguous ``None`` it used to be."""
+        import time as _time
+
+        deadline = None if timeout_ms < 0 \
+            else _time.monotonic() + timeout_ms / 1e3
+
+        def op():
+            remaining = timeout_ms if deadline is None else max(
+                int((deadline - _time.monotonic()) * 1e3), 0)
+            out = ctypes.c_void_p()
+            out_len = ctypes.c_uint32()
+            st = self._lib.coord_get(self._handle, key.encode(), remaining,
+                                     ctypes.byref(out),
+                                     ctypes.byref(out_len))
+            if st == TIMEOUT:
+                if deadline is None \
+                        or _time.monotonic() < deadline - 0.05:
+                    raise OSError(f"get({key}): premature timeout "
+                                  "(server shutting down?)")
+                return None
+            if st != OK:
+                raise OSError(f"get({key}) failed")
+            return self._take(out, out_len)
+        return self._call(op, f"get({key})")
 
     def barrier(self, name: str, num_participants: int,
                 timeout_ms: int = -1) -> bool:
-        st = self._lib.coord_barrier(self._handle, name.encode(),
-                                     num_participants, timeout_ms)
-        if st == ERROR:
-            raise OSError(f"barrier({name}) failed")
-        return st == OK
+        def op():
+            st = self._lib.coord_barrier(self._handle, name.encode(),
+                                         num_participants, timeout_ms)
+            if st == ERROR:
+                raise OSError(f"barrier({name}) failed")
+            return st == OK
+        return self._call(op, f"barrier({name})")
 
     def counter_add(self, key: str, delta: int = 1) -> int:
-        out = ctypes.c_int64()
-        if self._lib.coord_counter_add(self._handle, key.encode(), delta,
-                                       ctypes.byref(out)) != OK:
-            raise OSError(f"counter_add({key}) failed")
-        return out.value
+        def op():
+            out = ctypes.c_int64()
+            if self._lib.coord_counter_add(self._handle, key.encode(),
+                                           delta, ctypes.byref(out)) != OK:
+                raise OSError(f"counter_add({key}) failed")
+            return out.value
+        return self._call(op, f"counter_add({key})")
 
     def queue_put(self, key: str, value: bytes):
-        if self._lib.coord_queue_put(self._handle, key.encode(), value,
-                                     len(value)) != OK:
-            raise OSError(f"queue_put({key}) failed")
+        def op():
+            if self._lib.coord_queue_put(self._handle, key.encode(), value,
+                                         len(value)) != OK:
+                raise OSError(f"queue_put({key}) failed")
+        return self._call(op, f"queue_put({key})")
 
     def queue_get(self, key: str, timeout_ms: int = -1) -> Optional[bytes]:
-        out = ctypes.c_void_p()
-        out_len = ctypes.c_uint32()
-        st = self._lib.coord_queue_get(self._handle, key.encode(), timeout_ms,
-                                       ctypes.byref(out),
-                                       ctypes.byref(out_len))
-        if st == TIMEOUT:
-            return None
-        if st != OK:
-            raise OSError(f"queue_get({key}) failed")
-        return self._take(out, out_len)
+        import time as _time
+
+        deadline = None if timeout_ms < 0 \
+            else _time.monotonic() + timeout_ms / 1e3
+
+        def op():
+            remaining = timeout_ms if deadline is None else max(
+                int((deadline - _time.monotonic()) * 1e3), 0)
+            out = ctypes.c_void_p()
+            out_len = ctypes.c_uint32()
+            st = self._lib.coord_queue_get(self._handle, key.encode(),
+                                           remaining, ctypes.byref(out),
+                                           ctypes.byref(out_len))
+            if st == TIMEOUT:
+                # Same premature-timeout discipline as get(): a
+                # shutting-down server answers TIMEOUT to blocked pops.
+                if deadline is None \
+                        or _time.monotonic() < deadline - 0.05:
+                    raise OSError(f"queue_get({key}): premature timeout "
+                                  "(server shutting down?)")
+                return None
+            if st != OK:
+                raise OSError(f"queue_get({key}) failed")
+            return self._take(out, out_len)
+        return self._call(op, f"queue_get({key})")
 
     def ssp_register(self, worker: str):
-        if self._lib.coord_ssp_register(self._handle, worker.encode()) != OK:
-            raise OSError("ssp_register failed")
+        def op():
+            if self._lib.coord_ssp_register(self._handle,
+                                            worker.encode()) != OK:
+                raise OSError("ssp_register failed")
+        return self._call(op, "ssp_register")
 
     def ssp_report(self, worker: str, step: int):
-        if self._lib.coord_ssp_report(self._handle, worker.encode(),
-                                      step) != OK:
-            raise OSError("ssp_report failed")
+        def op():
+            if self._lib.coord_ssp_report(self._handle, worker.encode(),
+                                          step) != OK:
+                raise OSError("ssp_report failed")
+        return self._call(op, "ssp_report")
 
     def ssp_wait(self, step: int, staleness: int) -> bool:
         """Block until every registered worker has completed step
-        ``step - 1 - staleness``; returns False on (10-minute) timeout."""
+        ``step - 1 - staleness``; returns False on (10-minute) timeout.
+
+        Note: ssp_wait is NOT retried through a reconnect — the server
+        tracks per-connection SSP registration, so a reconnected client
+        would wait on a roster it is no longer part of; callers see the
+        raw failure and re-register."""
         st = self._lib.coord_ssp_wait(self._handle, step, staleness)
         if st == ERROR:
             raise OSError("ssp_wait failed")

@@ -1,0 +1,344 @@
+"""Deterministic chaos/fault injection for the runtime planes.
+
+A fleet-scale runtime must *prove* it survives the ways real fleets
+die — worker crash, worker hang (SIGSTOP), slow host, dropped
+coordination socket, failed checkpoint write, preemption — so every
+supervised-recovery path in this repo is pinned in CI by an *injected*
+fault, not by hope.  The vocabulary:
+
+* :class:`FaultSpec` — one fault: a ``kind`` from :data:`FAULT_KINDS`,
+  a ``target`` (a worker name, ``"chief"``, or ``"coord"``), and a
+  trigger (``at_step`` — fire when the target's loop reaches that
+  step — or ``at_s`` — wall-clock seconds after the injector starts).
+* :class:`FaultPlan` — a seedable, JSON-serializable list of specs.
+  The chief ships it to workers via the ``AUTODIST_TPU_FAULT_PLAN``
+  env var (inline JSON, or ``@/path/to/plan.json``) for
+  *self-injection*; process-level faults (kill/STOP another process,
+  bounce the coordination server) execute chief-side.
+* :class:`FaultInjector` — polls the plan from a step loop
+  (``injector.maybe_fire(step)``) and executes due specs.
+
+Every injection — and every detected/recovered/degraded/escalated
+outcome, emitted by the supervision, checkpoint, and coordination
+layers — is a ``kind="fault"`` telemetry record;
+``tools/telemetry_report.py --check`` schema-gates them and fails a run
+whose injections have no matching recovery/teardown record.
+``tools/chaos_run.py --matrix`` sweeps every kind against a
+``LocalCluster`` training job.  See ``docs/usage/robustness.md``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from autodist_tpu.utils import logging
+
+FAULT_KINDS = ("worker_crash", "worker_hang", "slow_host", "coord_drop",
+               "ckpt_write_fail", "preempt_signal")
+
+# The lifecycle vocabulary of kind="fault" records; the report's schema
+# gate keys on it.  injected -> one of the terminal phases.
+FAULT_PHASES = ("injected", "detected", "recovered", "degraded",
+                "escalated", "teardown")
+TERMINAL_PHASES = ("recovered", "degraded", "escalated", "teardown")
+
+ENV_VAR = "AUTODIST_TPU_FAULT_PLAN"
+
+
+def fault_target() -> str:
+    """This process's name in the fault-record vocabulary — matches the
+    FaultPlan targeting convention: workers carry their host marker
+    (``AUTODIST_TPU_WORKER``), the chief is ``"chief"``.  Recovery
+    records emitted by the checkpoint/elastic layers use it so the
+    report's injection↔outcome pairing lines up."""
+    from autodist_tpu import const
+
+    return const.ENV.AUTODIST_TPU_WORKER.val or "chief"
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One fault to inject.
+
+    ``duration_s`` scopes the transient kinds (hang/slow/coord_drop);
+    ``exit_code`` the crash; ``times`` how many checkpoint writes fail
+    before the store heals (``times`` beyond the Saver's retry budget
+    exercises the degrade path)."""
+
+    kind: str
+    target: str = "chief"
+    at_step: Optional[int] = None
+    at_s: Optional[float] = None
+    duration_s: float = 0.5
+    exit_code: int = 17
+    times: int = 1
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; expected "
+                             f"one of {list(FAULT_KINDS)}")
+        if (self.at_step is None) == (self.at_s is None):
+            raise ValueError(
+                f"{self.kind} needs exactly one trigger: at_step "
+                f"(loop step) or at_s (wall-clock seconds)")
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultSpec":
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A seedable set of faults, shippable through the env plane."""
+
+    faults: list = dataclasses.field(default_factory=list)
+    seed: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps({"kind": "fault_plan", "seed": self.seed,
+                           "faults": [f.to_dict() for f in self.faults]})
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        d = json.loads(text)
+        if d.get("kind") not in (None, "fault_plan"):
+            raise ValueError(f"not a fault plan: kind={d.get('kind')!r}")
+        return cls(faults=[FaultSpec.from_dict(f)
+                           for f in d.get("faults", [])],
+                   seed=int(d.get("seed", 0)))
+
+    def for_target(self, target: str) -> list:
+        return [f for f in self.faults if f.target == target]
+
+    def ship(self, env: Optional[dict] = None) -> dict:
+        """Return ``env`` (or a new dict) with the plan on
+        ``AUTODIST_TPU_FAULT_PLAN`` — the chief adds this to every
+        worker launch so workers self-inject their own faults."""
+        env = env if env is not None else {}
+        env[ENV_VAR] = self.to_json()
+        return env
+
+
+def load_fault_plan(value: Optional[str] = None) -> Optional[FaultPlan]:
+    """The plan from ``AUTODIST_TPU_FAULT_PLAN`` (or an explicit
+    ``value``): inline JSON, or ``@/path`` to a JSON file.  ``None``
+    when unset — chaos is strictly opt-in."""
+    value = value if value is not None else os.environ.get(ENV_VAR, "")
+    if not value:
+        return None
+    if value.startswith("@"):
+        with open(value[1:]) as f:
+            value = f.read()
+    return FaultPlan.from_json(value)
+
+
+def install_ckpt_write_fail(saver, times: int = 1,
+                            where: str = "save") -> dict:
+    """Arm a :class:`~autodist_tpu.checkpoint.saver.Saver` so its next
+    ``times`` checkpoint operations raise an injected I/O error —
+    ``where="save"`` fails the write call itself (the sync path the
+    retry policy wraps), ``where="commit"`` fails the async
+    commit-join (the path that must surface with the failed step
+    number).  Returns the countdown dict ({"left": n}) so tests can
+    assert exhaustion."""
+    mgr = saver._mgr
+    countdown = {"left": int(times)}
+    if where == "save":
+        orig = mgr.save
+
+        def failing_save(*args, **kwargs):
+            if countdown["left"] > 0:
+                countdown["left"] -= 1
+                raise OSError(
+                    f"injected ckpt_write_fail "
+                    f"({countdown['left']} more to come)")
+            return orig(*args, **kwargs)
+
+        mgr.save = failing_save
+    elif where == "commit":
+        orig = mgr.wait_until_finished
+
+        def failing_commit(*args, **kwargs):
+            if countdown["left"] > 0:
+                countdown["left"] -= 1
+                raise OSError("injected ckpt_write_fail (async commit)")
+            return orig(*args, **kwargs)
+
+        mgr.wait_until_finished = failing_commit
+    else:
+        raise ValueError(f"where={where!r}; expected 'save' or 'commit'")
+    return countdown
+
+
+class FaultInjector:
+    """Executes a :class:`FaultPlan` from a step loop.
+
+    One injector per process.  ``self_target`` names this process in
+    the plan (a worker name, or ``"chief"``); specs targeting it are
+    self-injected.  A chief additionally passes ``workers`` (name →
+    :class:`~autodist_tpu.runtime.cluster.WorkerHandle`, or a zero-arg
+    callable returning that mapping) to execute process-level faults on
+    its workers, ``saver`` to arm checkpoint faults, and
+    ``coord_bounce`` (a ``fn(down_s)`` — e.g.
+    ``Cluster.bounce_coord_service``) for ``coord_drop``.
+
+    Call :meth:`maybe_fire` once per loop iteration; each due spec
+    fires exactly once and emits its ``kind="fault"`` record *before*
+    executing (a crash must not lose its own injection record).
+    """
+
+    def __init__(self, plan: FaultPlan, self_target: str = "chief", *,
+                 workers: Any = None, saver: Any = None,
+                 coord_bounce: Optional[Callable[[float], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.plan = plan
+        self.self_target = self_target
+        self._workers = workers
+        self._saver = saver
+        self._coord_bounce = coord_bounce
+        self._clock = clock
+        self._t0 = clock()
+        self._pending = list(plan.faults)
+        self.fired: list[FaultSpec] = []
+
+    # ------------------------------------------------------------------ #
+    def _worker_map(self) -> dict:
+        w = self._workers
+        if w is None:
+            return {}
+        if callable(w):
+            w = w()
+        return dict(w)
+
+    def _due(self, spec: FaultSpec, step: Optional[int],
+             elapsed: float) -> bool:
+        if spec.at_step is not None:
+            return step is not None and step >= spec.at_step
+        return elapsed >= spec.at_s
+
+    def _owns(self, spec: FaultSpec) -> bool:
+        if spec.target == self.self_target:
+            return True
+        if spec.kind == "coord_drop" and self._coord_bounce is not None:
+            return True
+        return spec.target in self._worker_map()
+
+    def maybe_fire(self, step: Optional[int] = None) -> list:
+        """Fire every due spec this process owns; returns the specs
+        fired this call."""
+        elapsed = self._clock() - self._t0
+        due = [s for s in self._pending
+               if self._owns(s) and self._due(s, step, elapsed)]
+        for spec in due:
+            self._pending.remove(spec)
+            self.fired.append(spec)
+            self._fire(spec, step, elapsed)
+        return due
+
+    def drain_pending(self, step: Optional[int] = None):
+        """Block until every wall-clock-triggered spec this process owns
+        has fired (the end of a short loop must not silently skip a
+        late ``at_s`` trigger — a skipped injection would green-light a
+        recovery that never ran)."""
+        while any(self._owns(s) and s.at_s is not None
+                  for s in self._pending):
+            time.sleep(0.05)
+            self.maybe_fire(step)
+
+    # ------------------------------------------------------------------ #
+    def _record(self, spec: FaultSpec, phase: str,
+                step: Optional[int], elapsed: float, **extra):
+        from autodist_tpu import telemetry
+
+        telemetry.counter(f"fault/{spec.kind}").inc()
+        telemetry.record_event(
+            "fault", fault=spec.kind, target=spec.target, phase=phase,
+            step=step, t_s=round(elapsed, 3), seed=self.plan.seed,
+            **extra)
+
+    def _fire(self, spec: FaultSpec, step: Optional[int], elapsed: float):
+        logging.warning("chaos: injecting %s on %s (step=%s, t=%.2fs)",
+                        spec.kind, spec.target, step, elapsed)
+        self._record(spec, "injected", step, elapsed)
+        handler = getattr(self, f"_fire_{spec.kind}")
+        handler(spec, step, elapsed)
+
+    def _flush_for_death(self):
+        """The process is about to vanish (exit, or SIGSTOP →
+        supervisor SIGKILL): flush so the injection record survives
+        it."""
+        from autodist_tpu import telemetry
+
+        try:
+            if telemetry.get().out_dir:
+                telemetry.flush()
+        except OSError:
+            pass
+
+    # ---- the six kinds ------------------------------------------------ #
+    def _fire_worker_crash(self, spec, step, elapsed):
+        if spec.target == self.self_target:
+            self._flush_for_death()
+            os._exit(spec.exit_code)
+        self._worker_map()[spec.target].kill()
+
+    def _fire_worker_hang(self, spec, step, elapsed):
+        if spec.target == self.self_target:
+            self._flush_for_death()
+            os.kill(os.getpid(), signal.SIGSTOP)
+            return   # resumed only if someone sends SIGCONT
+        handle = self._worker_map()[spec.target]
+        os.killpg(os.getpgid(handle.proc.pid), signal.SIGSTOP)
+
+    def _fire_slow_host(self, spec, step, elapsed):
+        if spec.target == self.self_target:
+            time.sleep(spec.duration_s)
+            self._record(spec, "recovered", step,
+                         self._clock() - self._t0, action="resumed",
+                         slow_s=spec.duration_s)
+            return
+        # Chief-side transient: STOP the worker, CONT it after the
+        # window — a host that went slow and came back.
+        handle = self._worker_map()[spec.target]
+        pgid = os.getpgid(handle.proc.pid)
+        os.killpg(pgid, signal.SIGSTOP)
+
+        def resume():
+            time.sleep(spec.duration_s)
+            try:
+                os.killpg(pgid, signal.SIGCONT)
+                self._record(spec, "recovered", step,
+                             self._clock() - self._t0, action="resumed",
+                             slow_s=spec.duration_s)
+            except ProcessLookupError:
+                pass   # supervision already reaped it as a hang
+
+        threading.Thread(target=resume, daemon=True).start()
+
+    def _fire_coord_drop(self, spec, step, elapsed):
+        if self._coord_bounce is None:
+            raise RuntimeError(
+                "coord_drop fired on a process with no coord_bounce "
+                "hook (only the chief owns the coordination server)")
+        self._coord_bounce(spec.duration_s)
+        self._record(spec, "recovered", step, self._clock() - self._t0,
+                     action="server_restarted", down_s=spec.duration_s)
+
+    def _fire_ckpt_write_fail(self, spec, step, elapsed):
+        if self._saver is None:
+            raise RuntimeError(
+                "ckpt_write_fail fired on a process with no saver "
+                "attached (pass saver= to the FaultInjector)")
+        install_ckpt_write_fail(self._saver, times=spec.times)
+
+    def _fire_preempt_signal(self, spec, step, elapsed):
+        os.kill(os.getpid(), signal.SIGTERM)

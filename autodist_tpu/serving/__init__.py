@@ -22,13 +22,15 @@ Typical use (see ``docs/usage/serving.md`` / ``examples/serve.py``)::
     rid = batcher.submit([1, 5, 3], max_new_tokens=32, eos_id=2)
     out = batcher.run()[rid].tokens
 """
-from autodist_tpu.serving.batcher import (Completion, ContinuousBatcher,
-                                          Request)
+from autodist_tpu.serving.batcher import (FINISH_REASONS, Completion,
+                                          ContinuousBatcher,
+                                          OverloadedError, Request)
 from autodist_tpu.serving.engine import ServingEngine, serving_param_specs
 from autodist_tpu.serving.kv_cache import KVCache, init_cache
 
 __all__ = [
     "ServingEngine", "ContinuousBatcher", "Request", "Completion",
+    "FINISH_REASONS", "OverloadedError",
     "KVCache", "init_cache", "serve", "serving_param_specs",
 ]
 

@@ -38,6 +38,22 @@ import numpy as np
 from autodist_tpu import telemetry
 
 
+class OverloadedError(RuntimeError):
+    """The admission queue is full: the request was *shed* (coded —
+    ``serve/shed`` counter) instead of queued into unbounded latency.
+    Callers back off and resubmit; a router routes to another replica."""
+
+    code = "serve/overloaded"
+
+
+# Every way a request can end.  The first three are the classic decode
+# terminals; the rest are the graceful-degradation terminals (deadline
+# pressure, overload shedding, engine drain) — absent entirely when no
+# deadline/queue-bound/drain is in play.
+FINISH_REASONS = ("eos", "max_tokens", "max_len", "deadline_exceeded",
+                  "shed", "drained")
+
+
 @dataclasses.dataclass
 class Request:
     """One generation request (token ids in, token ids out)."""
@@ -47,6 +63,7 @@ class Request:
     max_new_tokens: int
     eos_id: Optional[int] = None
     submit_s: float = 0.0
+    deadline_s: Optional[float] = None   # absolute (perf_counter) deadline
 
 
 @dataclasses.dataclass
@@ -55,7 +72,7 @@ class Completion:
 
     rid: str
     tokens: list                 # generated ids (EOS included when hit)
-    finish_reason: str           # "eos" | "max_tokens" | "max_len"
+    finish_reason: str           # one of FINISH_REASONS
     ttft_s: float                # submit -> first token available
     queue_wait_s: float          # submit -> slot admission
     decode_s: float              # first token -> last token
@@ -82,20 +99,33 @@ class ContinuousBatcher:
     """Drives a :class:`~autodist_tpu.serving.engine.ServingEngine`
     from a request queue with slot allocation and eviction."""
 
-    def __init__(self, engine):
+    def __init__(self, engine, *, max_queue: Optional[int] = None):
+        """``max_queue`` bounds the admission queue: a submit beyond it
+        is shed with a coded :class:`OverloadedError` (+ ``serve/shed``
+        counter) instead of queueing into unbounded latency.  ``None``
+        (default) keeps today's unbounded queue byte-identically."""
         self.engine = engine
+        self.max_queue = max_queue
         self._queue: deque[Request] = deque()
         self._slots: list[Optional[_Slot]] = [None] * engine.num_slots
         self._ids = itertools.count()
+        self._draining = False
         self.completions: dict[str, Completion] = {}
 
     # ------------------------------------------------------------------ #
     def submit(self, prompt, *, max_new_tokens: int = 16,
-               eos_id: Optional[int] = None, rid: Optional[str] = None) -> str:
+               eos_id: Optional[int] = None, rid: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> str:
         """Queue one request; returns its id.  Prompts must fit the
         engine's prompt bucket; a budget exceeding the cache capacity
         is accepted but the request truncates at capacity
-        (``finish_reason="max_len"``)."""
+        (``finish_reason="max_len"``).
+
+        ``deadline_s`` (seconds from now) bounds the request's total
+        latency: a request still queued — or still decoding — past its
+        deadline completes with ``finish_reason="deadline_exceeded"``
+        and whatever tokens it has (queued requests get none), instead
+        of silently burning slot time nobody is waiting for."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -105,11 +135,27 @@ class ContinuousBatcher:
                 f"prefill_len={self.engine.prefill_len}")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0")
+        if self._draining:
+            telemetry.counter("serve/shed").inc()
+            raise OverloadedError(
+                f"[{OverloadedError.code}] batcher is draining; "
+                "resubmit to another replica")
+        if self.max_queue is not None \
+                and len(self._queue) >= self.max_queue:
+            telemetry.counter("serve/shed").inc()
+            raise OverloadedError(
+                f"[{OverloadedError.code}] admission queue full "
+                f"({len(self._queue)}/{self.max_queue}); backing off "
+                "and resubmitting is the caller's move")
         rid = rid if rid is not None else f"req-{next(self._ids)}"
-        self._queue.append(Request(rid=rid, prompt=prompt,
-                                   max_new_tokens=int(max_new_tokens),
-                                   eos_id=eos_id,
-                                   submit_s=time.perf_counter()))
+        now = time.perf_counter()
+        self._queue.append(Request(
+            rid=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
+            eos_id=eos_id, submit_s=now,
+            deadline_s=now + deadline_s if deadline_s is not None
+            else None))
         telemetry.gauge("serve/queue_depth").set(len(self._queue))
         return rid
 
@@ -118,8 +164,42 @@ class ContinuousBatcher:
         return sum(s is not None for s in self._slots)
 
     # ------------------------------------------------------------------ #
+    def _expire_queued(self):
+        """Complete queued requests already past their deadline — a
+        request nobody is waiting for anymore must not win a slot over
+        one somebody is.  No-op when no request carries a deadline."""
+        now = time.perf_counter()
+        kept: deque[Request] = deque()
+        expired = False
+        for req in self._queue:
+            if req.deadline_s is not None and now >= req.deadline_s:
+                expired = True
+                telemetry.counter("serve/deadline_exceeded").inc()
+                self._finish(req, tokens=[], reason="deadline_exceeded",
+                             ttft_s=now - req.submit_s,
+                             queue_wait_s=now - req.submit_s,
+                             decode_s=0.0, inter_token_ms=[])
+            else:
+                kept.append(req)
+        if expired:
+            self._queue = kept
+            telemetry.gauge("serve/queue_depth").set(len(self._queue))
+
+    def _expire_slots(self):
+        """Mark in-flight slots past their deadline terminal (tokens
+        decoded so far are kept — partial output beats none at the
+        deadline)."""
+        now = time.perf_counter()
+        for slot in self._slots:
+            if slot is not None and slot.done is None \
+                    and slot.req.deadline_s is not None \
+                    and now >= slot.req.deadline_s:
+                telemetry.counter("serve/deadline_exceeded").inc()
+                slot.done = "deadline_exceeded"
+
     def _admit(self):
         """Fill free slots from the queue with ONE batched prefill."""
+        self._expire_queued()
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free or not self._queue:
             return
@@ -171,19 +251,20 @@ class ContinuousBatcher:
             slot.done = ("max_tokens" if limit == req.max_new_tokens
                          else "max_len")
 
-    def _evict(self, i: int):
-        slot = self._slots[i]
-        req = slot.req
-        t_end = time.perf_counter()
+    def _finish(self, req: Request, *, tokens: list, reason: str,
+                ttft_s: float, queue_wait_s: float, decode_s: float,
+                inter_token_ms: list) -> Completion:
+        """The ONE completion path: record, count, and file the
+        :class:`Completion` — used by slot eviction, queued-deadline
+        expiry, and drain shedding alike, so every request that ever
+        entered ``submit`` leaves exactly one completion + one
+        ``kind="serve"`` record (no in-flight request is ever
+        stranded)."""
         comp = Completion(
-            rid=req.rid, tokens=list(slot.tokens),
-            finish_reason=slot.done,
-            ttft_s=slot.first_tok_s - req.submit_s,
-            queue_wait_s=slot.admitted_s - req.submit_s,
-            decode_s=t_end - slot.first_tok_s,
-            inter_token_ms=list(slot.inter_token_ms))
+            rid=req.rid, tokens=list(tokens), finish_reason=reason,
+            ttft_s=ttft_s, queue_wait_s=queue_wait_s, decode_s=decode_s,
+            inter_token_ms=list(inter_token_ms))
         self.completions[req.rid] = comp
-        self._slots[i] = None
         telemetry.counter("serve/requests").inc()
         itl = np.asarray(comp.inter_token_ms) if comp.inter_token_ms \
             else None
@@ -198,6 +279,18 @@ class ContinuousBatcher:
             inter_token_p99_ms=(float(np.percentile(itl, 99))
                                 if itl is not None else None),
             tokens_per_sec=comp.tokens_per_sec)
+        return comp
+
+    def _evict(self, i: int):
+        slot = self._slots[i]
+        req = slot.req
+        t_end = time.perf_counter()
+        self._slots[i] = None
+        self._finish(req, tokens=slot.tokens, reason=slot.done,
+                     ttft_s=slot.first_tok_s - req.submit_s,
+                     queue_wait_s=slot.admitted_s - req.submit_s,
+                     decode_s=t_end - slot.first_tok_s,
+                     inter_token_ms=slot.inter_token_ms)
 
     def _decode_window(self):
         """One fused decode dispatch; distribute tokens, evict terminal
@@ -231,11 +324,14 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------------ #
     def step(self):
-        """One scheduler round: evict finished, admit, decode."""
+        """One scheduler round: expire deadlines, evict finished,
+        admit, decode."""
+        self._expire_slots()
         for i, slot in enumerate(self._slots):
             if slot is not None and slot.done is not None:
                 self._evict(i)
-        self._admit()
+        if not self._draining:
+            self._admit()
         self._decode_window()
 
     def run(self) -> dict[str, Completion]:
@@ -247,5 +343,41 @@ class ContinuousBatcher:
         before = set(self.completions)
         while self._queue or self.active_slots:
             self.step()
+        return {rid: c for rid, c in self.completions.items()
+                if rid not in before}
+
+    def drain(self, *, finish_in_flight: bool = True
+              ) -> dict[str, Completion]:
+        """Wind the batcher down without admitting new work — the
+        explicit semantics for evicting an engine (a re-election, a
+        preemption, a rolling restart): queued-but-unadmitted requests
+        complete as ``"shed"`` (resubmittable elsewhere — no token was
+        ever produced for them), in-flight slots either decode to their
+        natural terminal (``finish_in_flight=True``) or are cut at
+        their current token as ``"drained"``.  Either way NO in-flight
+        slot is stranded: every submitted request ends in exactly one
+        completion.  Subsequent ``submit`` calls shed with
+        :class:`OverloadedError`.  Returns the completions this call
+        produced."""
+        before = set(self.completions)
+        self._draining = True
+        now = time.perf_counter()
+        while self._queue:
+            req = self._queue.popleft()
+            telemetry.counter("serve/shed").inc()
+            self._finish(req, tokens=[], reason="shed",
+                         ttft_s=now - req.submit_s,
+                         queue_wait_s=now - req.submit_s,
+                         decode_s=0.0, inter_token_ms=[])
+        telemetry.gauge("serve/queue_depth").set(0)
+        if finish_in_flight:
+            while self.active_slots:
+                self.step()
+        else:
+            for i, slot in enumerate(self._slots):
+                if slot is not None:
+                    if slot.done is None:
+                        slot.done = "drained"
+                    self._evict(i)
         return {rid: c for rid, c in self.completions.items()
                 if rid not in before}

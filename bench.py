@@ -201,8 +201,14 @@ _MAX_ATTEMPTS = 3
 
 def _backoff_delay(attempt: int, base: float = 5.0,
                    cap: float = 60.0) -> float:
-    """Capped exponential backoff: 5s, 10s, ... <= 60s."""
-    return min(base * (2 ** (attempt - 1)), cap)
+    """Capped exponential backoff: 5s, 10s, ... <= 60s — the shared
+    implementation (``runtime/retry.py``); only the bench defaults live
+    here.  The fresh-process re-exec loop itself cannot ride
+    ``RetryPolicy.call`` (each attempt is a new interpreter, threaded
+    through ``AUTODIST_TPU_BENCH_ATTEMPT``)."""
+    from autodist_tpu.runtime.retry import backoff_delay
+
+    return backoff_delay(attempt, base_s=base, cap_s=cap)
 
 
 def _unavailable_exit(msg: str):

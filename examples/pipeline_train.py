@@ -99,6 +99,14 @@ def main():
     ap.add_argument("--preempt-ckpt-dir", default=None,
                     help="checkpoint directory for --preempt-demo "
                          "(default: a temp dir)")
+    ap.add_argument("--chaos", default=None, metavar="PLAN_JSON",
+                    help="run under a fault plan (runtime/faults.py "
+                         "JSON: ckpt_write_fail retries/degrades on the "
+                         "Saver, preempt_signal takes the elastic "
+                         "shrink-resume path, slow_host stalls the "
+                         "chief) — the single-process demo of what "
+                         "tools/chaos_run.py sweeps against a "
+                         "LocalCluster; docs/usage/robustness.md")
     ap.add_argument("--num-slices", type=int, default=1,
                     help="declare a multi-slice topology (with "
                          "--auto-search): the outer dp axis rides DCN "
@@ -322,21 +330,52 @@ def main():
     import time
 
     controller = None
-    if args.preempt_demo:
+    injector = None
+    if args.preempt_demo or args.chaos:
         import tempfile
 
         from autodist_tpu.checkpoint.saver import Saver
         from autodist_tpu.elastic import ElasticController
+        from autodist_tpu.runtime.retry import RetryPolicy
 
         ckpt_dir = args.preempt_ckpt_dir or tempfile.mkdtemp(
             prefix="elastic_ckpt_")
-        controller = ElasticController(trainable, Saver(ckpt_dir),
+        saver = Saver(ckpt_dir,
+                      retry=RetryPolicy(max_attempts=2, base_delay_s=0.1,
+                                        cap_delay_s=1.0),
+                      degrade_on_failure=bool(args.chaos))
+        controller = ElasticController(trainable, saver,
                                        global_batch=args.batch)
         controller.install(runner)
+    if args.chaos:
+        from autodist_tpu.runtime.faults import FaultInjector, load_fault_plan
+
+        plan = load_fault_plan("@" + args.chaos)
+        # Baseline checkpoint BEFORE any fault can fire: every degrade/
+        # recovery path falls back to "the last good checkpoint", so a
+        # chaos-armed run must have one from step 0.
+        saver.save(runner)
+        injector = FaultInjector(plan, self_target="chief", saver=saver)
+        print(f"chaos plan armed: {[f.kind for f in plan.faults]} "
+              f"(seed {plan.seed})")
 
     with trace_cm:
         for step in range(args.steps):
-            if controller is not None and step == max(args.steps // 2, 1):
+            if injector is not None:
+                injector.maybe_fire(step)
+                if controller.preempted:
+                    survivors = max(jax.device_count() // 2, 1)
+                    runner = controller.resume({"num_devices": survivors})
+                    print(f"chaos preemption at step {step}: resumed on "
+                          f"{survivors} device(s)")
+                if step % 5 == 2:
+                    # Periodic checkpoints give the armed
+                    # ckpt_write_fail something to hit (and every later
+                    # fault a fresher "last good" to fall back to); the
+                    # cadence avoids the mid-run preemption step so the
+                    # two saves never collide on one step number.
+                    saver.save(runner)
+            if args.preempt_demo and step == max(args.steps // 2, 1):
                 # Simulated preemption: the SIGTERM handler writes a
                 # blocking elastic checkpoint; the survivors (here:
                 # half the devices) re-elect via the topology-aware

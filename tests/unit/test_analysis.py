@@ -295,3 +295,31 @@ def test_program_mutations_fire():
     assert results
     bad = [r for r in results if not r["ok"]]
     assert not bad, bad
+
+
+def test_supervision_mutations_fire():
+    """The ADT08x matrix: every supervision rule fires on its doctored
+    config and stays silent on the honest one (escalation without a
+    saver, heartbeat interval >= timeout, restart backoff beyond the
+    SSP staleness window)."""
+    results = run_mutations(kinds=["supervision"])
+    assert {r["code"] for r in results} == {"ADT080", "ADT081", "ADT082"}
+    bad = [r for r in results if not r["ok"]]
+    assert not bad, bad
+
+
+def test_lint_supervision_clean_config_is_clean():
+    from autodist_tpu.analysis import lint_supervision
+    from autodist_tpu.analysis.mutations import _supervision_fixture
+
+    config, strategy = _supervision_fixture()
+    assert lint_supervision(config, strategy=strategy).ok
+    # dict form (a serialized config) lints identically
+    assert lint_supervision(config.to_dict(), strategy=strategy).ok
+    # ADT082 needs SSP in the plan: without a strategy the backoff rule
+    # cannot fire, the others still do
+    import dataclasses as dc
+
+    broken = dc.replace(config, saver=None)
+    report = lint_supervision(broken)
+    assert "ADT080" in report.codes() and not report.ok

@@ -1,0 +1,187 @@
+"""Chaos subsystem tests.
+
+Fast tier: the FaultPlan/FaultSpec vocabulary (JSON + env shipping,
+trigger semantics under a fake clock), the injector's record contract,
+and the telemetry report's fault schema gate (an injection without a
+matching recovery record FAILS --check).  The subprocess matrix —
+``tools/chaos_run.py --matrix``, every fault kind against a real
+LocalCluster pipeline-LM run — is ``slow``-marked.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from autodist_tpu import telemetry
+from autodist_tpu.runtime.faults import (FAULT_KINDS, FaultInjector,
+                                         FaultPlan, FaultSpec,
+                                         install_ckpt_write_fail,
+                                         load_fault_plan)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+# --------------------------------------------------------------------------- #
+# Plan vocabulary
+# --------------------------------------------------------------------------- #
+def test_fault_spec_validation():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec("disk_melt", at_step=1)
+    with pytest.raises(ValueError, match="exactly one trigger"):
+        FaultSpec("worker_crash")                       # no trigger
+    with pytest.raises(ValueError, match="exactly one trigger"):
+        FaultSpec("worker_crash", at_step=1, at_s=1.0)  # two triggers
+
+
+def test_plan_json_roundtrip_and_env_shipping(tmp_path, monkeypatch):
+    plan = FaultPlan(faults=[
+        FaultSpec("worker_crash", target="worker-1", at_s=1.0,
+                  exit_code=3),
+        FaultSpec("ckpt_write_fail", target="chief", at_step=4, times=2),
+    ], seed=99)
+    back = FaultPlan.from_json(plan.to_json())
+    assert back.seed == 99 and len(back.faults) == 2
+    assert back.faults[0].kind == "worker_crash"
+    assert back.faults[0].exit_code == 3
+    assert back.for_target("chief")[0].times == 2
+    # env shipping: inline JSON ...
+    env = plan.ship({})
+    monkeypatch.setenv("AUTODIST_TPU_FAULT_PLAN",
+                       env["AUTODIST_TPU_FAULT_PLAN"])
+    assert load_fault_plan().seed == 99
+    # ... and @file indirection (the pipeline_train --chaos form)
+    path = tmp_path / "plan.json"
+    path.write_text(plan.to_json())
+    assert load_fault_plan(f"@{path}").faults[1].at_step == 4
+    monkeypatch.delenv("AUTODIST_TPU_FAULT_PLAN")
+    assert load_fault_plan() is None       # chaos is strictly opt-in
+
+
+def test_injector_triggers_once_on_step_and_walltime():
+    telemetry.reset()
+    t = {"now": 0.0}
+    plan = FaultPlan(faults=[
+        FaultSpec("slow_host", target="chief", at_step=3,
+                  duration_s=0.0),
+        FaultSpec("slow_host", target="chief", at_s=5.0, duration_s=0.0),
+    ])
+    inj = FaultInjector(plan, self_target="chief",
+                        clock=lambda: t["now"])
+    assert inj.maybe_fire(0) == []
+    t["now"] = 1.0
+    assert [s.at_step for s in inj.maybe_fire(3)] == [3]   # step trigger
+    assert inj.maybe_fire(3) == []                         # fires ONCE
+    t["now"] = 6.0
+    assert [s.at_s for s in inj.maybe_fire(4)] == [5.0]    # wall trigger
+    assert inj.maybe_fire(99) == []
+    recs = [r for r in telemetry.get().step_records()
+            if r.get("kind") == "fault"]
+    # each slow_host injection paired with its own recovery record
+    assert sum(r["phase"] == "injected" for r in recs) == 2
+    assert sum(r["phase"] == "recovered" for r in recs) == 2
+
+
+def test_injector_ignores_other_targets():
+    plan = FaultPlan(faults=[FaultSpec("worker_crash", target="worker-2",
+                                       at_step=0)])
+    inj = FaultInjector(plan, self_target="chief")   # no workers map
+    assert inj.maybe_fire(10) == []                  # not ours: no fire
+
+
+def test_ckpt_write_fail_injection_counts_down(tmp_path):
+    from autodist_tpu.checkpoint.saver import Saver
+
+    saver = Saver(str(tmp_path))
+    countdown = install_ckpt_write_fail(saver, times=2)
+    for _ in range(2):
+        with pytest.raises(OSError, match="injected ckpt_write_fail"):
+            saver._mgr.save(0, args=None)
+    assert countdown["left"] == 0
+
+
+# --------------------------------------------------------------------------- #
+# The report's fault schema gate
+# --------------------------------------------------------------------------- #
+def _check(tmp_path, records):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from telemetry_report import check_schema
+
+    with open(os.path.join(tmp_path, "metrics.jsonl"), "w") as f:
+        f.write("\n".join(json.dumps(r) for r in records) + "\n")
+    return check_schema(str(tmp_path))
+
+
+def test_report_gates_unrecovered_injection(tmp_path):
+    inj = {"kind": "fault", "fault": "worker_crash", "target": "worker-1",
+           "phase": "injected"}
+    rec = {"kind": "fault", "fault": "worker_crash", "target": "worker-1",
+           "phase": "recovered", "action": "restart"}
+    problems = _check(tmp_path, [inj])
+    assert any("no matching recovery" in p for p in problems)
+    assert _check(tmp_path, [inj, rec]) == []
+    # a recovery for a DIFFERENT target does not excuse the injection
+    other = dict(rec, target="worker-2")
+    assert any("no matching recovery" in p
+               for p in _check(tmp_path, [inj, other]))
+    # every terminal phase closes the loop
+    for phase in ("degraded", "escalated", "teardown"):
+        assert _check(tmp_path, [inj, dict(rec, phase=phase)]) == []
+
+
+def test_report_gates_fault_record_shape(tmp_path):
+    bad_kind = {"kind": "fault", "fault": "gremlins", "target": "x",
+                "phase": "injected"}
+    bad_phase = {"kind": "fault", "fault": "slow_host", "target": "x",
+                 "phase": "vibing"}
+    missing = {"kind": "fault", "fault": "slow_host"}
+    problems = _check(tmp_path, [bad_kind, bad_phase, missing])
+    assert any("unknown fault kind" in p for p in problems)
+    assert any("unknown fault phase" in p for p in problems)
+    assert any("fault record missing" in p for p in problems)
+
+
+def test_report_renders_faults_section(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from telemetry_report import render
+
+    records = [
+        {"kind": "fault", "fault": "preempt_signal", "target": "chief",
+         "phase": "injected", "step": 7},
+        {"kind": "fault", "fault": "preempt_signal", "target": "chief",
+         "phase": "recovered", "action": "shrink_resume", "step": 7},
+    ]
+    with open(os.path.join(tmp_path, "metrics.jsonl"), "w") as f:
+        f.write("\n".join(json.dumps(r) for r in records) + "\n")
+    out = render(str(tmp_path))
+    assert "## faults" in out
+    assert "preempt_signal" in out and "shrink_resume" in out
+
+
+# --------------------------------------------------------------------------- #
+# The subprocess chaos matrix (slow tier)
+# --------------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_chaos_matrix_every_fault_recovers(tmp_path):
+    """tools/chaos_run.py --matrix: golden + every fault kind against a
+    LocalCluster pipeline-LM run; each scenario must end in a
+    supervised recovery or a clean coded teardown (never a hang), with
+    schema-valid fault records and the loss trajectory matching the
+    golden."""
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    for k in ("AUTODIST_TPU_WORKER", "AUTODIST_TPU_FAULT_PLAN",
+              "XLA_FLAGS"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_run.py"),
+         "--matrix", "--steps", "12", "--telemetry-dir", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=3000)
+    assert proc.returncode == 0, (
+        f"chaos matrix failed\nstdout:\n{proc.stdout[-4000:]}\n"
+        f"stderr:\n{proc.stderr[-4000:]}")
+    with open(tmp_path / "matrix.json") as f:
+        results = json.load(f)
+    assert set(results) == {"none", *FAULT_KINDS}
+    assert all(r["ok"] for r in results.values()), results

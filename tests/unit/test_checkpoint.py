@@ -168,3 +168,112 @@ def test_portable_restore_elastic_mesh_shrink(tmp_path):
     m = runner4.step(b)
     assert np.isfinite(float(np.asarray(m["loss"])))
     saver.close()
+
+
+# --------------------------------------------------------------------------- #
+# Chaos-hardened saves: bounded retries, coded degrade, async failures
+# surfacing with their step number (pinned by injected ckpt_write_fail).
+# --------------------------------------------------------------------------- #
+def _fast_retry():
+    from autodist_tpu.runtime.retry import RetryPolicy
+
+    return RetryPolicy(max_attempts=2, base_delay_s=0.01,
+                       cap_delay_s=0.01, seed=0)
+
+
+def test_save_retries_through_injected_write_failure(tmp_path):
+    """One injected write failure, a 2-attempt policy: the save lands
+    and restores bit-exactly — the fault is invisible to the caller."""
+    from autodist_tpu.runtime.faults import install_ckpt_write_fail
+
+    runner = train_some(PS())
+    saver = Saver(str(tmp_path), retry=_fast_retry())
+    countdown = install_ckpt_write_fail(saver, times=1)
+    step = saver.save(runner)
+    assert step is not None and countdown["left"] == 0
+    runner2 = AutoDist({}, PS()).build(make_trainable())
+    saver.restore(runner2)
+    jax.tree.map(lambda a, c: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(c)),
+        runner.get_params(), runner2.get_params())
+
+
+def test_save_degrades_on_persistent_write_failure(tmp_path):
+    """Retries exhausted + degrade_on_failure: save() returns None, the
+    counter and the kind="fault" degrade record fire, and the LAST GOOD
+    checkpoint still restores — training stays alive."""
+    from autodist_tpu import telemetry
+    from autodist_tpu.runtime.faults import install_ckpt_write_fail
+
+    telemetry.reset()
+    runner = train_some(PS())
+    saver = Saver(str(tmp_path), retry=_fast_retry(),
+                  degrade_on_failure=True)
+    good_step = saver.save(runner)          # the last good checkpoint
+    runner.step(make_batch(5))
+    install_ckpt_write_fail(saver, times=3)  # outlasts the 2 attempts
+    assert saver.save(runner) is None        # coded degrade, no raise
+    assert telemetry.get().registry.counter(
+        "ckpt/save_failures").value == 1
+    recs = [r for r in telemetry.get().step_records()
+            if r.get("kind") == "fault"]
+    assert any(r["fault"] == "ckpt_write_fail"
+               and r["phase"] == "degraded"
+               and r["last_good_step"] == good_step for r in recs)
+    assert saver.latest_step() == good_step
+
+
+def test_save_failure_without_degrade_is_typed(tmp_path):
+    from autodist_tpu.checkpoint.saver import CheckpointSaveError
+    from autodist_tpu.runtime.faults import install_ckpt_write_fail
+
+    runner = train_some(PS())
+    saver = Saver(str(tmp_path), retry=_fast_retry())
+    install_ckpt_write_fail(saver, times=3)
+    with pytest.raises(CheckpointSaveError) as ei:
+        saver.save(runner)
+    assert ei.value.step == runner.step_count
+
+
+def test_async_save_failure_surfaces_with_step_at_next_join(tmp_path):
+    """The satellite pin: a failed ASYNC commit surfaces as a typed
+    error carrying the step that staged it — at the next save()/wait()/
+    close(), never from an arbitrary later orbax call — and increments
+    ckpt/async_save_failures."""
+    from autodist_tpu import telemetry
+    from autodist_tpu.checkpoint.saver import CheckpointSaveError
+    from autodist_tpu.runtime.faults import install_ckpt_write_fail
+
+    telemetry.reset()
+    runner = train_some(PS())
+    saver = Saver(str(tmp_path), async_save=True)
+    staged = saver.save(runner)              # returns with commit in flight
+    install_ckpt_write_fail(saver, times=1, where="commit")
+    with pytest.raises(CheckpointSaveError) as ei:
+        saver.wait()
+    assert ei.value.step == staged
+    assert f"step {staged}" in str(ei.value)
+    assert telemetry.get().registry.counter(
+        "ckpt/async_save_failures").value == 1
+    # the failure was consumed: the next join is clean
+    saver.wait()
+    saver.close()
+
+
+def test_async_save_failure_degrades_when_opted_in(tmp_path):
+    from autodist_tpu import telemetry
+    from autodist_tpu.runtime.faults import install_ckpt_write_fail
+
+    telemetry.reset()
+    runner = train_some(PS())
+    saver = Saver(str(tmp_path), async_save=True, degrade_on_failure=True)
+    staged = saver.save(runner)
+    install_ckpt_write_fail(saver, times=1, where="commit")
+    runner.step(make_batch(9))
+    assert saver.save(runner) is not None    # next save joins + degrades
+    recs = [r for r in telemetry.get().step_records()
+            if r.get("kind") == "fault"]
+    assert any(r["fault"] == "ckpt_write_fail"
+               and r["phase"] == "degraded" and r["step"] == staged
+               for r in recs)
+    saver.close()

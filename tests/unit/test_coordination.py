@@ -268,3 +268,81 @@ def test_bind_host_restricts_interface():
         with CoordClient("127.0.0.1", s.port, token=s.token) as c:
             c.put("x", b"1")
             assert c.get("x") == b"1"
+
+
+# --------------------------------------------------------------------------- #
+# Reconnect-and-retry (chaos-hardened runtime): the happy path stays one
+# native call; a bounced server is survived; exhaustion is typed.
+# --------------------------------------------------------------------------- #
+def test_happy_path_never_reconnects(server, monkeypatch):
+    """Both-ways pin: with no fault, adopting the retry policy changes
+    nothing — the client never dials a reconnect."""
+    with client(server) as c:
+        dialed = []
+        monkeypatch.setattr(c, "_reconnect",
+                            lambda: dialed.append(1) or (_ for _ in ()))
+        c.put("k", b"v")
+        assert c.get("k") == b"v"
+        assert c.counter_add("n", 1) == 1
+        assert dialed == []
+
+
+def test_reconnect_on_server_bounce_mid_get():
+    """The coord_drop fault: a client blocked in get survives the
+    server stopping and restarting on the same port, and still
+    receives the value published after the bounce."""
+    from autodist_tpu.runtime.coordination import CoordServer
+    from autodist_tpu.runtime.retry import RetryPolicy
+
+    s = CoordServer()
+    port, token = s.port, s.token
+    c = CoordClient("127.0.0.1", port, token=token,
+                    retry=RetryPolicy(max_attempts=10, base_delay_s=0.2,
+                                      cap_delay_s=0.5, deadline_s=30.0,
+                                      seed=0))
+    got = {}
+    t = threading.Thread(
+        target=lambda: got.update(v=c.get("late", timeout_ms=20000)))
+    t.start()
+    try:
+        time.sleep(0.3)          # the get is blocked server-side
+        s.stop()                 # ... and its socket just died
+        time.sleep(0.3)
+        s = CoordServer(port=port, token=token)   # chief comes back
+        with CoordClient("127.0.0.1", port, token=token) as pub:
+            pub.put("late", b"value")
+        t.join(timeout=25)
+        assert not t.is_alive(), "client never recovered from the bounce"
+        assert got.get("v") == b"value"
+    finally:
+        c.close()
+        s.stop()
+
+
+def test_exhausted_retries_raise_typed_unavailable():
+    from autodist_tpu.runtime.coordination import (CoordServer,
+                                                   CoordUnavailableError)
+    from autodist_tpu.runtime.retry import RetryPolicy
+
+    assert issubclass(CoordUnavailableError, OSError)  # legacy handlers
+    s = CoordServer()
+    c = CoordClient("127.0.0.1", s.port, token=s.token,
+                    retry=RetryPolicy(max_attempts=2, base_delay_s=0.05,
+                                      cap_delay_s=0.05, seed=0))
+    s.stop()   # the service is gone for good
+    with pytest.raises(CoordUnavailableError, match="unavailable"):
+        c.put("k", b"v")
+    c.close()
+
+
+def test_retry_opt_out_keeps_raw_oserror():
+    from autodist_tpu.runtime.coordination import (CoordServer,
+                                                   CoordUnavailableError)
+
+    s = CoordServer()
+    c = CoordClient("127.0.0.1", s.port, token=s.token, retry=None)
+    s.stop()
+    with pytest.raises(OSError) as ei:
+        c.put("k", b"v")
+    assert not isinstance(ei.value, CoordUnavailableError)
+    c.close()

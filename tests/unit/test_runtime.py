@@ -155,3 +155,226 @@ def test_native_build_falls_back_to_user_cache(monkeypatch, tmp_path):
     assert (cache / "libautodist_dataio.so").exists()
     assert (cache / "dataio.cc").exists()   # sources copied for make
     nl._loaded.clear()                      # don't leak the cache CDLL
+
+
+# --------------------------------------------------------------------------- #
+# Chaos-hardened runtime: supervision, heartbeats, remote teardown,
+# full-failure reporting (with supervision OFF, fail-fast is untouched —
+# the tests above this line run the exact pre-supervision semantics).
+# --------------------------------------------------------------------------- #
+def _crash_once_script():
+    """Exit 3 on the first incarnation, 0 after a supervised restart."""
+    return [sys.executable, "-c",
+            "import os, sys; "
+            "sys.exit(0 if os.environ.get("
+            "'AUTODIST_TPU_WORKER_INCARNATION') else 3)"]
+
+
+def test_supervised_restart_within_budget():
+    from autodist_tpu import telemetry
+    from autodist_tpu.runtime.cluster import Coordinator, SupervisionConfig
+    from autodist_tpu.runtime.retry import RetryPolicy
+
+    telemetry.reset()
+    sup = SupervisionConfig(
+        max_restarts=1,
+        restart_backoff=RetryPolicy(max_attempts=2, base_delay_s=0.05,
+                                    cap_delay_s=0.05, seed=0))
+    c = Coordinator(supervision=sup)
+    c.launch("w1", _crash_once_script())
+    c.join(timeout=30)    # restart consumed the crash: join is clean
+    assert c._restarts == {"w1": 1}
+    assert telemetry.get().registry.counter(
+        "runtime/worker_restarts").value == 1
+    recs = [r for r in telemetry.get().step_records()
+            if r.get("kind") == "fault"]
+    assert any(r["phase"] == "recovered" and r["action"] == "restart"
+               and r["target"] == "w1" for r in recs)
+
+
+def test_supervised_escalation_hands_over_survivors():
+    from autodist_tpu import telemetry
+    from autodist_tpu.runtime.cluster import Coordinator, SupervisionConfig
+
+    telemetry.reset()
+    seen = {}
+    sup = SupervisionConfig(max_restarts=0, escalate=True, saver=object(),
+                            on_escalate=lambda s: seen.update(
+                                names=[w.name for w in s]))
+    c = Coordinator(supervision=sup)
+    c.launch("survivor", [sys.executable, "-c",
+                          "import time; time.sleep(2)"])
+    c.launch("doomed", [sys.executable, "-c", "import sys; sys.exit(9)"])
+    deadline = time.time() + 10
+    while not c.escalated and time.time() < deadline:
+        time.sleep(0.05)
+    assert c.escalated
+    assert seen["names"] == ["survivor"]
+    c.join(timeout=30)   # the escalated death is consumed, join is clean
+    recs = [r for r in telemetry.get().step_records()
+            if r.get("kind") == "fault"]
+    assert any(r["phase"] == "escalated" and r["target"] == "doomed"
+               for r in recs)
+
+
+def test_supervision_off_keeps_fail_fast_teardown_records_nothing():
+    """Both-ways pin: with supervision=None the fail-fast path emits no
+    fault records and raises exactly as before."""
+    from autodist_tpu import telemetry
+
+    telemetry.reset()
+    c = Coordinator()
+    c.launch("bad", [sys.executable, "-c", "import sys; sys.exit(3)"])
+    with pytest.raises(RuntimeError, match="bad.*3"):
+        c.join(timeout=30)
+    assert not [r for r in telemetry.get().step_records()
+                if r.get("kind") == "fault"]
+
+
+def test_join_reports_all_concurrent_failures():
+    c = Coordinator(fail_fast=False)
+    c.launch("bad-a", [sys.executable, "-c", "import sys; sys.exit(3)"])
+    c.launch("bad-b", [sys.executable, "-c", "import sys; sys.exit(5)"])
+    with pytest.raises(RuntimeError) as ei:
+        c.join(timeout=30)
+    msg = str(ei.value)
+    assert "bad-a" in msg and "3" in msg
+    assert "bad-b" in msg and "5" in msg
+
+
+def test_join_timeout_lists_hung_and_crashed_workers():
+    c = Coordinator(fail_fast=False)
+    c.launch("crashed", [sys.executable, "-c", "import sys; sys.exit(7)"])
+    c.launch("hung-a", [sys.executable, "-c", "import time; time.sleep(60)"])
+    c.launch("hung-b", [sys.executable, "-c", "import time; time.sleep(60)"])
+    time.sleep(1.0)   # let the crash land
+    with pytest.raises(TimeoutError) as ei:
+        c.join(timeout=2)
+    msg = str(ei.value)
+    assert "hung-a" in msg and "hung-b" in msg
+    assert "crashed" in msg and "7" in msg
+
+
+class _StallingClient:
+    """Heartbeat source that beats a few times, then stalls (the
+    SIGSTOPped-worker signature)."""
+
+    def __init__(self, beats=5):
+        self.n = 0
+        self.beats = beats
+
+    def counter_add(self, key, delta=0):
+        self.n += 1
+        return min(self.n, self.beats)
+
+
+def test_heartbeat_monitor_declares_hung_worker_dead():
+    from autodist_tpu import telemetry
+    from autodist_tpu.runtime.cluster import (Coordinator,
+                                              HeartbeatMonitor,
+                                              SupervisionConfig)
+
+    telemetry.reset()
+    sup = SupervisionConfig(max_restarts=0, escalate=True, saver=object())
+    c = Coordinator(supervision=sup)
+    c.launch("wedged", [sys.executable, "-c",
+                        "import time; time.sleep(60)"])
+    mon = HeartbeatMonitor(c, lambda: _StallingClient(),
+                           interval_s=0.05, timeout_s=0.4,
+                           startup_grace_s=0.4)
+    mon.start()
+    try:
+        deadline = time.time() + 10
+        while not c.escalated and time.time() < deadline:
+            time.sleep(0.05)
+        assert c.escalated, "hang was never detected/escalated"
+    finally:
+        mon.stop()
+        c.terminate()
+    recs = [r for r in telemetry.get().step_records()
+            if r.get("kind") == "fault"]
+    assert any(r["phase"] == "detected" and r["fault"] == "worker_hang"
+               for r in recs)
+    assert any(r["phase"] == "escalated" and r["fault"] == "worker_hang"
+               for r in recs)
+    assert telemetry.get().registry.counter(
+        "runtime/workers_declared_dead").value == 1
+
+
+_FAKE_SSH = """#!%(python)s
+import os, subprocess, sys
+args = sys.argv[1:]
+while args and args[0].startswith("-"):
+    args = args[2:]                      # drop "-o BatchMode=yes" pairs
+host, rest = args[0], args[1:]
+if rest == ["/bin/sh -s"]:
+    # launch form: the "remote" worker runs DETACHED (own session), like
+    # a real remote process — killing the local ssh client must not
+    # reach it.
+    proc = subprocess.Popen(["/bin/sh", "-s"], stdin=sys.stdin,
+                            start_new_session=True)
+    sys.exit(proc.wait())
+# exec form (the teardown kill): run the command locally
+sys.exit(subprocess.call(["/bin/sh", "-c", " ".join(rest)]))
+"""
+
+
+def test_remote_worker_teardown_kills_the_remote_process(tmp_path,
+                                                         monkeypatch):
+    """The satellite pin: terminate() on an ssh-launched worker must kill
+    the REMOTE process (via the captured remote pid + a second ssh
+    exec), not just the local ssh client.  The fake ssh shim runs the
+    'remote' side as a detached local process group."""
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    ssh = bin_dir / "ssh"
+    ssh.write_text(_FAKE_SSH % {"python": sys.executable})
+    ssh.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    pidfile = tmp_path / "pid"
+    c = Coordinator()
+    h = c.launch(
+        "remote-1",
+        [sys.executable, "-c",
+         f"import os, time; open({str(pidfile)!r}, 'w').write("
+         "str(os.getpid())); time.sleep(60)"],
+        host="fakehost", env={"SOME_SECRET": "s3cret"})
+    deadline = time.time() + 15
+    while (h.remote_pid is None or not pidfile.exists()) \
+            and time.time() < deadline:
+        time.sleep(0.05)
+    assert h.remote_pid is not None, "remote pid never captured"
+    worker_pid = int(pidfile.read_text())
+    # exec in the bootstrap keeps the sh pid: the marker IS the worker
+    assert h.remote_pid == worker_pid
+    os.kill(worker_pid, 0)   # alive
+    c.terminate()
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            os.kill(worker_pid, 0)
+            time.sleep(0.05)
+        except ProcessLookupError:
+            break
+    with pytest.raises(ProcessLookupError):
+        os.kill(worker_pid, 0)   # the REMOTE side is dead, not orphaned
+
+
+def test_local_cluster_launches_n_workers(tmp_path):
+    from autodist_tpu.runtime.cluster import LocalCluster
+
+    outs = [tmp_path / f"w{i}.txt" for i in (1, 2)]
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import os, sys\n"
+        "pid = os.environ['AUTODIST_TPU_PROCESS_ID']\n"
+        f"open(os.path.join({str(tmp_path)!r}, 'w%s.txt' % pid), "
+        "'w').write(os.environ.get('AUTODIST_TPU_STRATEGY_ID', ''))\n")
+    cluster = LocalCluster(2)
+    try:
+        cluster.launch_clients("strat-7",
+                               argv=[sys.executable, str(script)])
+        cluster.join(timeout=60)
+    finally:
+        cluster.terminate()
+    assert [o.read_text() for o in outs] == ["strat-7", "strat-7"]

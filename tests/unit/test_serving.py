@@ -469,3 +469,101 @@ def test_serve_smoke_report_check(serve_smoke_run):
     assert telemetry_report.main([str(out), "--check"]) == 0
     md = telemetry_report.render(str(out))
     assert "## serving" in md
+
+
+# --------------------------------------------------------------------- #
+# Graceful degradation: deadlines, bounded-queue shedding, drain
+# (both-ways: no deadline pressure => completions byte-identical).
+# --------------------------------------------------------------------- #
+def test_no_deadline_completions_byte_identical(cfg, params):
+    """Both-ways golden: a huge deadline and a bounded-but-unfull queue
+    decode EXACTLY the tokens the plain batcher decodes."""
+    reqs = [([3, 1, 4], 6), ([2, 7], 4)]
+    plain = ContinuousBatcher(make_engine(cfg, params))
+    plain_rids = [plain.submit(p, max_new_tokens=m) for p, m in reqs]
+    plain_out = plain.run()
+    guarded = ContinuousBatcher(make_engine(cfg, params), max_queue=16)
+    g_rids = [guarded.submit(p, max_new_tokens=m, deadline_s=3600.0)
+              for p, m in reqs]
+    g_out = guarded.run()
+    for pr, gr in zip(plain_rids, g_rids):
+        assert g_out[gr].tokens == plain_out[pr].tokens
+        assert g_out[gr].finish_reason == plain_out[pr].finish_reason
+
+
+def test_queued_request_past_deadline_expires_unstarted(cfg, params):
+    telemetry.reset()
+    b = ContinuousBatcher(make_engine(cfg, params, slots=1))
+    live = b.submit([3, 1, 4], max_new_tokens=3)
+    doomed = b.submit([2, 7], max_new_tokens=3, deadline_s=1e-4)
+    import time as _t
+
+    _t.sleep(0.01)   # the queued deadline passes before any admission
+    out = b.run()
+    assert out[live].finish_reason == "max_tokens"
+    assert out[doomed].finish_reason == "deadline_exceeded"
+    assert out[doomed].tokens == []
+    assert telemetry.get().registry.counter(
+        "serve/deadline_exceeded").value == 1
+
+
+def test_in_flight_deadline_keeps_partial_tokens(cfg, params):
+    """A request whose deadline lapses mid-decode completes with the
+    tokens it already has — partial beats nothing at the deadline."""
+    b = ContinuousBatcher(make_engine(cfg, params, slots=1,
+                                      decode_steps=1))
+    rid = b.submit([3, 1, 4], max_new_tokens=64, deadline_s=0.05)
+    out = b.run()[rid]
+    assert out.finish_reason == "deadline_exceeded"
+    assert 0 < len(out.tokens) < 64
+    # the partial prefix matches the unconstrained stream
+    free = ContinuousBatcher(make_engine(cfg, params, slots=1,
+                                         decode_steps=1))
+    frid = free.submit([3, 1, 4], max_new_tokens=64)
+    assert out.tokens == free.run()[frid].tokens[:len(out.tokens)]
+
+
+def test_bounded_queue_sheds_with_coded_error(cfg, params):
+    from autodist_tpu.serving import OverloadedError
+
+    telemetry.reset()
+    b = ContinuousBatcher(make_engine(cfg, params, slots=1), max_queue=1)
+    b.submit([3, 1], max_new_tokens=2)
+    with pytest.raises(OverloadedError, match="serve/overloaded"):
+        b.submit([2, 7], max_new_tokens=2)
+    assert telemetry.get().registry.counter("serve/shed").value == 1
+    # the shed request never entered: the queued one still completes
+    assert len(b.run()) == 1
+
+
+def test_drain_never_strands_in_flight_slots(cfg, params):
+    from autodist_tpu.serving import OverloadedError
+
+    telemetry.reset()
+    eng = make_engine(cfg, params, slots=1, decode_steps=1)
+    b = ContinuousBatcher(eng)
+    flying = b.submit([3, 1, 4], max_new_tokens=6)
+    queued = b.submit([2, 7], max_new_tokens=4)     # no free slot
+    b.step()                                        # admits `flying` only
+    assert b.active_slots == 1
+    done = b.drain(finish_in_flight=True)
+    # every submitted request ended in exactly one completion
+    assert set(done) == {flying, queued}
+    assert done[flying].finish_reason == "max_tokens"
+    assert len(done[flying].tokens) == 6            # decoded to terminal
+    assert done[queued].finish_reason == "shed"     # resubmittable
+    assert done[queued].tokens == []
+    assert b.active_slots == 0
+    with pytest.raises(OverloadedError):            # drained = no admits
+        b.submit([5], max_new_tokens=1)
+
+
+def test_drain_cut_evicts_at_current_token(cfg, params):
+    eng = make_engine(cfg, params, slots=1, decode_steps=1)
+    b = ContinuousBatcher(eng)
+    rid = b.submit([3, 1, 4], max_new_tokens=50)
+    b.step()
+    b.step()
+    done = b.drain(finish_in_flight=False)
+    assert done[rid].finish_reason == "drained"
+    assert 0 < len(done[rid].tokens) < 50           # cut, tokens kept

@@ -36,7 +36,18 @@ _SERVE_KEYS = {"kind", "request", "tokens", "ttft_ms", "tokens_per_sec"}
 # is bounded by.
 _RESHARD_KEYS = {"kind", "route", "leaves", "bytes_moved",
                  "peak_host_bytes", "duration_ms"}
-_KINDS = ("step", "serve", "reshard", "counter", "gauge", "histogram")
+# Chaos/fault records (autodist_tpu/runtime/faults.py + the supervised
+# recovery paths): one per injection and one per detected outcome.  A
+# run whose injections have no matching terminal record is a run that
+# claims chaos coverage it never proved — --check fails it.
+_FAULT_KEYS = {"kind", "fault", "target", "phase"}
+_FAULT_KINDS = ("worker_crash", "worker_hang", "slow_host", "coord_drop",
+                "ckpt_write_fail", "preempt_signal")
+_FAULT_PHASES = ("injected", "detected", "recovered", "degraded",
+                 "escalated", "teardown")
+_FAULT_TERMINAL = ("recovered", "degraded", "escalated", "teardown")
+_KINDS = ("step", "serve", "reshard", "fault", "counter", "gauge",
+          "histogram")
 
 
 def load_jsonl(path: str) -> list[dict]:
@@ -95,10 +106,47 @@ def check_schema(run_dir: str) -> list[str]:
                     f"claims peak_host_bytes="
                     f"{rec['peak_host_bytes']} — the fast path must "
                     "never stage through the host")
+        elif kind == "fault":
+            missing = _FAULT_KEYS - set(rec)
+            if missing:
+                problems.append(
+                    f"metrics.jsonl:{i + 1}: fault record missing "
+                    f"{sorted(missing)}")
+            else:
+                if rec["fault"] not in _FAULT_KINDS:
+                    problems.append(
+                        f"metrics.jsonl:{i + 1}: unknown fault kind "
+                        f"{rec['fault']!r}")
+                if rec["phase"] not in _FAULT_PHASES:
+                    problems.append(
+                        f"metrics.jsonl:{i + 1}: unknown fault phase "
+                        f"{rec['phase']!r}")
         elif "name" not in rec:
             problems.append(f"metrics.jsonl:{i + 1}: {kind} without name")
         elif kind == "histogram" and "count" not in rec:
             problems.append(f"metrics.jsonl:{i + 1}: histogram without count")
+
+    # Every injected fault must reach a terminal outcome record
+    # (recovered / degraded / escalated / teardown) for the same fault
+    # kind and target — an injection with no outcome means the recovery
+    # path silently never ran (or never recorded), which is exactly the
+    # regression the chaos harness exists to catch.
+    faults = [r for r in records if r.get("kind") == "fault"
+              and _FAULT_KEYS <= set(r)]
+    for rec in faults:
+        if rec["phase"] != "injected":
+            continue
+        matched = any(
+            o is not rec and o["fault"] == rec["fault"]
+            and o["phase"] in _FAULT_TERMINAL
+            and o["target"] == rec["target"]
+            for o in faults)
+        if not matched:
+            problems.append(
+                f"metrics.jsonl: injected fault "
+                f"{rec['fault']}@{rec['target']} has no matching "
+                f"recovery/degrade/escalation/teardown record — the "
+                "recovery path never ran or never recorded")
 
     trace = os.path.join(run_dir, "trace.json")
     if os.path.exists(trace):
@@ -191,6 +239,7 @@ def render(run_dir: str) -> str:
     steps = [r for r in records if r.get("kind") == "step"]
     serves = [r for r in records if r.get("kind") == "serve"]
     reshards = [r for r in records if r.get("kind") == "reshard"]
+    faults = [r for r in records if r.get("kind") == "fault"]
     counters = [r for r in records if r.get("kind") == "counter"]
     gauges = [r for r in records if r.get("kind") == "gauge"]
     hists = [r for r in records if r.get("kind") == "histogram"]
@@ -270,6 +319,38 @@ def render(run_dir: str) -> str:
                 f"| {_fmt(r['bytes_moved'] / 1e6)} "
                 f"| {_fmt(r['peak_host_bytes'] / 1e6)} "
                 f"| {_fmt(r['duration_ms'])} |")
+        lines.append("")
+
+    if faults:
+        # One row per injection, joined with its terminal outcome (the
+        # same pairing --check gates on); standalone detections ride
+        # the notes column of their injection when present.
+        lines += ["## faults", "",
+                  "| fault | target | phase(s) | outcome | step/t |",
+                  "|---|---|---|---|---|"]
+        injections = [r for r in faults if r.get("phase") == "injected"]
+        for inj in injections:
+            related = [r for r in faults if r is not inj
+                       and r.get("fault") == inj.get("fault")
+                       and r.get("target") == inj.get("target")]
+            phases = " → ".join(["injected"]
+                                + [r.get("phase", "?") for r in related])
+            outcome = next((r.get("action") or r.get("phase")
+                            for r in reversed(related)
+                            if r.get("phase") in _FAULT_TERMINAL), "NONE")
+            when = inj.get("step")
+            when = f"step {when}" if when is not None \
+                else f"t={_fmt(inj.get('t_s'))}s"
+            lines.append(f"| {inj.get('fault')} | {inj.get('target')} "
+                         f"| {phases} | {outcome} | {when} |")
+        orphans = [r for r in faults if r.get("phase") != "injected"
+                   and not any(i.get("fault") == r.get("fault")
+                               and i.get("target") == r.get("target")
+                               for i in injections)]
+        for r in orphans:   # real (un-injected) faults the run survived
+            lines.append(f"| {r.get('fault')} | {r.get('target')} "
+                         f"| {r.get('phase')} | {r.get('action') or '—'} "
+                         f"| step {_fmt(r.get('step'))} |")
         lines.append("")
 
     if counters or gauges:
