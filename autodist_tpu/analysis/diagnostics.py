@@ -72,6 +72,9 @@ CODES: dict[str, tuple[str, str]] = {
     "ADT071": (WARNING, "compressor error-feedback state not "
                         "transferable across this reshard "
                         "(reinitialized on the target)"),
+    "ADT090": (ERROR, "fused kernel elected without its enabling knob "
+                      "(the kernel slot would be a silent no-op or a "
+                      "contradiction)"),
     "ADT080": (ERROR, "supervised escalation with no saver attached "
                       "(shrink-to-survivors would resume from nothing: "
                       "silent state loss)"),
@@ -105,6 +108,8 @@ CODES: dict[str, tuple[str, str]] = {
     "ADT113": (ERROR, "single-replica program carries cross-device "
                       "collectives"),
     "ADT114": (ERROR, "expected model-axis collectives are missing"),
+    "ADT120": (ERROR, "elected fused kernel missing from the compiled "
+                      "program (the composed op soup survived)"),
     # --- source lint (repo AST) -------------------------------------- #
     "ADT201": (ERROR, "raw collective call outside the sanctioned "
                       "modules (bypasses the precision policy)"),

@@ -67,6 +67,13 @@ _HOST_CUSTOM_CALL_RE = re.compile(
 # pairs and the chained ZeRO-3 gathers lean on).
 _BARRIER_RE = re.compile(r"\b(?:opt-barrier|optimization-barrier)(?:\.\d+)?\(")
 
+# Fused-kernel markers: every Pallas kernel call site is wrapped in a
+# `jax.named_scope("adtk_<kernel>")` (kernel.pallas.kernel_marker), and
+# the scope string survives XLA optimization inside op_name metadata —
+# fusion keeps per-instruction metadata — so marker counts ARE evidence
+# the kernel's ops exist in the optimized program (the ADT120 rule).
+_KERNEL_MARKER_RE = re.compile(r"adtk_([a-z0-9_]+)")
+
 
 def collective_counts(hlo_text: str) -> dict[str, int]:
     """Count collective ops by kind in optimized HLO text."""
@@ -190,6 +197,13 @@ def optimization_barriers(hlo_text: str) -> int:
     return len(_BARRIER_RE.findall(hlo_text))
 
 
+def kernel_markers(hlo_text: str) -> dict[str, int]:
+    """Occurrences of each fused-kernel ``adtk_<name>`` scope marker in
+    op metadata — zero for a kernel means no op of that Pallas kernel
+    survived into the program."""
+    return dict(collections.Counter(_KERNEL_MARKER_RE.findall(hlo_text)))
+
+
 def entry_signature(hlo_text: str) -> str:
     """The ENTRY computation's definition line — every array that is
     live ACROSS the step boundary (donated-in state, fed batch/rng,
@@ -229,6 +243,8 @@ class ProgramFacts:
     fused_loop: bool
     io_alias: bool
     entry: str                  # ENTRY line, "" when absent
+    markers: dict = dataclasses.field(default_factory=dict)
+    # fused-kernel marker name -> occurrence count
 
     @classmethod
     def from_hlo(cls, hlo_text: str) -> "ProgramFacts":
@@ -248,6 +264,7 @@ class ProgramFacts:
             fused_loop=has_fused_loop(hlo_text),
             io_alias=has_io_alias(hlo_text),
             entry=entry,
+            markers=kernel_markers(hlo_text),
         )
 
     # Shape scans stay methods (they take the dim parameter, so they

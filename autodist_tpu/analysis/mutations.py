@@ -528,6 +528,15 @@ def _plan_mutations() -> list[PlanMutation]:
             edit(lambda d: _set_node(
                 d, "ln_mlp/scale",
                 **{"synchronizer.compressor": "wavelet"}))),
+        PlanMutation(
+            "kernel_enabling_knob_dropped", "ADT090",
+            "the precision policy hand-stripped from a quant_ring-"
+            "elected plan (the fused ring would silently never run)",
+            lambda: _pipeline_fixture(
+                tensor_parallel=2,
+                collective_precision={"tp_psum": "int8"},
+                kernel=("quant_ring",)),
+            edit(lambda d: d["graph_config"].update({"precision": {}}))),
     ]
 
 
@@ -675,6 +684,34 @@ def _program_mutations() -> list[ProgramMutation]:
             lambda: [R.no_collectives()],
             _inject("  %ar = f32[8]{0} all-reduce(f32[8]{0} %g), "
                     "replica_groups={}, to_apply=%add")),
+        ProgramMutation(
+            "quant_ring_kernel_dropped", "ADT120",
+            "the s8 EQuARX ring goes missing (the composed int8 "
+            "convert-sandwich program a dropped kernel slot compiles "
+            "to)",
+            lambda: P.pipeline_step_text(2, collective_precision=tp_only,
+                                         kernel=("quant_ring",)),
+            lambda: [R.fused_kernel_replaced(("quant_ring",), tp=2)],
+            lambda t: P.pipeline_step_text(
+                2, collective_precision=tp_only)),
+        ProgramMutation(
+            "collective_matmul_kernel_dropped", "ADT120",
+            "the fused ring step goes missing (the composed "
+            "collective-matmul program a dropped kernel slot compiles "
+            "to)",
+            lambda: P.pipeline_step_text(2, comm_overlap="matmul",
+                                         kernel=("collective_matmul",)),
+            lambda: [R.fused_kernel_replaced(("collective_matmul",),
+                                             tp=2)],
+            lambda t: P.pipeline_step_text(2, comm_overlap="matmul")),
+        ProgramMutation(
+            "flash_decode_kernel_dropped", "ADT120",
+            "the flash-decode cache kernel goes missing (the composed "
+            "einsum decode program a dropped kernel slot compiles to)",
+            lambda: P.decode_step_text(1, False,
+                                       kernel=("flash_decode",)),
+            lambda: [R.fused_kernel_replaced(("flash_decode",), tp=1)],
+            lambda t: P.decode_step_text(1, False)),
         ProgramMutation(
             "tp_psums_missing", "ADT114",
             "the per-stage Megatron activation all-reduces go missing "

@@ -474,6 +474,66 @@ def rule_noop_at_tp1(ctx: PlanContext):
 
 
 # --------------------------------------------------------------------------- #
+# Fused-kernel tier rules
+# --------------------------------------------------------------------------- #
+@plan_rule
+def rule_kernel_enabling_knob(ctx: PlanContext):
+    """Each training kernel of the Pallas tier needs its enabling knob;
+    elected without one, the lowering would either reject the plan or —
+    on a hand-edited JSON that bypassed the builder — silently keep the
+    composed path while the user believes the fused kernel runs.
+    Mirrors the builder/lowering rejects as coded diagnostics:
+    ``quant_ring`` rides the *blocking* int8 tp_psum (a decomposed
+    boundary never takes the psum path), ``collective_matmul`` the
+    ``comm_overlap="matmul"`` ring.  ``flash_decode`` is serving-side
+    and legal on any plan."""
+    from autodist_tpu.strategy.ir import UnknownKernelError, \
+        normalize_kernel
+
+    try:
+        kernel = normalize_kernel(getattr(ctx.graph, "kernel", None))
+    except UnknownKernelError as e:
+        yield Diagnostic("ADT090", str(e), where="graph_config.kernel",
+                         fix="pick kernels from kernel.pallas"
+                             ".KERNEL_CHOICES")
+        return
+    if not kernel:
+        return
+    overlap = ctx.parallel.get("comm_overlap") or None
+    if "quant_ring" in kernel:
+        if ctx.tp <= 1 or ctx.precision().get("tp_psum") != "int8":
+            yield Diagnostic(
+                "ADT090",
+                "kernel 'quant_ring' fuses q/dq into the int8 tp_psum "
+                "ring, but this plan has no int8 tp_psum boundary "
+                f"(tensor_parallel={ctx.tp}, precision="
+                f"{ctx.precision() or '{}'})",
+                where="graph_config.kernel.quant_ring",
+                fix="set collective_precision's tp_psum slot to 'int8' "
+                    "with tensor_parallel>1, or drop the election")
+        elif overlap is not None:
+            yield Diagnostic(
+                "ADT090",
+                "kernel 'quant_ring' replaces the monolithic tp_psum, "
+                f"but comm_overlap={overlap!r} routes the boundary "
+                "through the decomposed forms — the ring would never "
+                "run",
+                where="graph_config.kernel.quant_ring",
+                fix="drop comm_overlap or the quant_ring election")
+    if "collective_matmul" in kernel and (ctx.tp <= 1
+                                          or overlap != "matmul"):
+        yield Diagnostic(
+            "ADT090",
+            "kernel 'collective_matmul' fuses the chunked ppermute "
+            f"ring, which needs comm_overlap='matmul' and "
+            f"tensor_parallel>1 (got comm_overlap={overlap!r}, "
+            f"tensor_parallel={ctx.tp})",
+            where="graph_config.kernel.collective_matmul",
+            fix="set comm_overlap='matmul' with tensor_parallel>1, or "
+                "drop the election")
+
+
+# --------------------------------------------------------------------------- #
 # Hierarchical-topology rules
 # --------------------------------------------------------------------------- #
 @plan_rule

@@ -279,6 +279,56 @@ def no_collectives() -> Rule:
                     "must skip the sync")
 
 
+def fused_kernel_replaced(kernels, tp: int = 2) -> Rule:
+    """ADT120: every elected fused kernel actually replaced its
+    composed op soup.  Evidence, per kernel:
+
+    * its ``adtk_<name>`` scope marker appears in op metadata (Pallas
+      kernel ops survived into the optimized program — a program built
+      from a kernel-slot-dropped sibling strategy has none);
+    * ``quant_ring`` additionally shows the EQuARX wire: ``>= 2(tp-1)``
+      TRUE-``s8`` collective-permutes (the composed int8 lowering has
+      zero — its wire is one monolithic fp16-levels all-reduce);
+    * ``collective_matmul`` additionally shows the ring itself:
+      ``>= tp-1`` collective-permutes (the blocking sibling has none).
+    """
+    kernels = tuple(kernels)
+
+    def check(f: ProgramFacts):
+        out = []
+        for name in kernels:
+            if not f.markers.get(name):
+                out.append(
+                    f"elected kernel {name!r} left no adtk_{name} op in "
+                    "the compiled program — the composed lowering "
+                    "survived (kernel slot dropped between plan and "
+                    "program)")
+                continue
+            if name == "quant_ring":
+                s8_perms = f.narrowed.get("collective-permute", 0)
+                want = 2 * (tp - 1)
+                if s8_perms < want:
+                    out.append(
+                        f"quant_ring elected but only {s8_perms} "
+                        f"narrowed collective-permute(s) (expected >= "
+                        f"{want}) — the s8 ring wire is missing")
+            if name == "collective_matmul":
+                perms = f.counts.get("collective-permute", 0)
+                if perms < tp - 1:
+                    out.append(
+                        f"collective_matmul elected but only {perms} "
+                        f"collective-permute(s) (expected >= {tp - 1}) "
+                        "— the chunked ring is missing")
+        return out
+
+    return Rule("ADT120", "fused_kernel_replaced",
+                "every elected fused kernel replaced its composed ops",
+                check,
+                fix="thread the Strategy IR kernel slot through the "
+                    "lowering (kernel_scope / the engine's flash "
+                    "dispatch) so the Pallas call site is reached")
+
+
 def min_extra_all_reduces(baseline: int, n: int, label: str) -> Rule:
     def check(f: ProgramFacts):
         extra = f.counts.get("all-reduce", 0) - baseline
@@ -309,6 +359,7 @@ def rules_for_strategy(strategy, *, vocab_size: Optional[int] = None,
     composed by the probes instead.
     """
     from autodist_tpu.strategy.ir import (PSSynchronizer,
+                                          normalize_kernel,
                                           normalize_precision)
 
     gc = strategy.graph_config
@@ -316,6 +367,11 @@ def rules_for_strategy(strategy, *, vocab_size: Optional[int] = None,
     par = gc.parallel or {}
     tp = max(int(par.get("tensor_parallel", 1)), 1)
     precision = normalize_precision(gc.precision)
+    kernel = normalize_kernel(getattr(gc, "kernel", None))
+    train_kernels = tuple(k for k in ("quant_ring", "collective_matmul")
+                          if k in kernel)
+    if train_kernels:
+        rules.append(fused_kernel_replaced(train_kernels, tp=tp))
     compressors = {getattr(nc.synchronizer, "compressor", "none") or "none"
                    for nc in strategy.node_configs}
     zero_stages = {nc.synchronizer.zero_stage
@@ -331,7 +387,11 @@ def rules_for_strategy(strategy, *, vocab_size: Optional[int] = None,
         rules.append(quantized_wire(clean=True))
     else:
         mins = {}
-        if tp > 1 and precision.get("tp_psum"):
+        if tp > 1 and precision.get("tp_psum") \
+                and "quant_ring" not in kernel:
+            # Under the quant_ring kernel the tp_psum narrowing rides
+            # s8 collective-permutes, not narrowed all-reduces — the
+            # ADT120 rule above carries that evidence instead.
             mins["all-reduce"] = 1
         if max(zero_stages, default=0) >= 3 \
                 and precision.get("zero3_gather"):
@@ -383,25 +443,36 @@ def rules_for_reshard(max_shard_elems: int) -> list[Rule]:
 def rules_for_decode(tensor_parallel: int, vocab_parallel: bool, *,
                      vocab_size: int, max_len: int, num_layers: int,
                      num_slots: int, heads_local: int,
-                     head_dim: int) -> list[Rule]:
+                     head_dim: int, kernel=()) -> list[Rule]:
     """The structural contract of a serving decode window, derived from
-    its (tp, vocab_parallel) config and cache geometry."""
+    its (tp, vocab_parallel, kernel) config and cache geometry."""
+    kernel = tuple(kernel)
     rules = [
         no_host_transfer(),
         fused_loop(),
         donated_alias(),
         no_score_square(max_len),
         min_dus(2 * num_layers),
-        no_donated_copy(max_len,
-                        num_slots * heads_local * max_len * head_dim,
-                        "cache-lane"),
     ]
+    if "flash_decode" not in kernel:
+        # The composed einsum path's no-cache-lane-copy guard.  The
+        # flash-elected program is exempt ON CPU ONLY: the Pallas
+        # *interpreter* materializes each grid step's operand blocks as
+        # copies (on TPU the Mosaic kernel streams the cache via DMA —
+        # no HLO copy exists to scan); ADT120 carries the flash
+        # program's structural proof instead.
+        rules.append(no_donated_copy(
+            max_len, num_slots * heads_local * max_len * head_dim,
+            "cache-lane"))
     if vocab_parallel and tensor_parallel > 1:
         v_pad = vocab_size + (-vocab_size) % tensor_parallel
         rules.append(no_buffer_with_dim(
             sorted({vocab_size, v_pad}), "vocab"))
         rules.append(min_extra_all_reduces(
             0, 2 * num_layers, "per-layer Megatron boundary psums"))
+    if "flash_decode" in kernel:
+        rules.append(fused_kernel_replaced(("flash_decode",),
+                                           tp=tensor_parallel))
     if tensor_parallel == 1:
         rules.append(no_collectives())
     return rules

@@ -101,7 +101,7 @@ def tiny_scan_texts(k: int = 4) -> tuple[str, str]:
 # --------------------------------------------------------------------------- #
 def pipeline_runner(tensor_parallel: int, comm_overlap=None,
                     vocab_parallel: bool = False, vocab_size: int = 32,
-                    collective_precision=None):
+                    collective_precision=None, kernel=None):
     import jax
     import jax.numpy as jnp
     import optax
@@ -129,15 +129,15 @@ def pipeline_runner(tensor_parallel: int, comm_overlap=None,
                     tensor_parallel=tensor_parallel,
                     comm_overlap=comm_overlap,
                     vocab_parallel=vocab_parallel,
-                    collective_precision=collective_precision
-                    ).build(trainable)
+                    collective_precision=collective_precision,
+                    kernel=kernel).build(trainable)
 
 
 @functools.lru_cache(maxsize=None)
 def pipeline_step_text(tensor_parallel: int, comm_overlap=None,
                        vocab_parallel: bool = False,
                        vocab_size: int = 32,
-                       collective_precision=None) -> str:
+                       collective_precision=None, kernel=None) -> str:
     """Optimized HLO of one pipeline train step (memoized: the tp=1 and
     blocking tp=2 programs serve several probes/rules — each 8-device
     compile costs tens of seconds, and the bench embeds an all-probes
@@ -150,7 +150,7 @@ def pipeline_step_text(tensor_parallel: int, comm_overlap=None,
              "y": r.randint(0, vocab_size, (8, 8)).astype(np.int32)}
     runner = pipeline_runner(tensor_parallel, comm_overlap,
                              vocab_parallel, vocab_size,
-                             collective_precision)
+                             collective_precision, kernel)
     try:
         return compiled_text(runner.lowered.step_fn, runner.state,
                              runner._place_batch(batch),
@@ -343,7 +343,8 @@ DEC_HEAD_DIM = 8
 
 
 @functools.lru_cache(maxsize=None)
-def decode_step_text(tensor_parallel: int, vocab_parallel: bool) -> str:
+def decode_step_text(tensor_parallel: int, vocab_parallel: bool,
+                     kernel=None) -> str:
     """Optimized HLO of one fused-decode dispatch of the serving
     engine (memoized like the pipeline texts)."""
     import jax
@@ -361,7 +362,7 @@ def decode_step_text(tensor_parallel: int, vocab_parallel: bool) -> str:
     params = make_pipeline_lm_trainable(
         cfg, optax.sgd(0.1), jax.random.PRNGKey(0)).params
     engine = ServingEngine(cfg, params, tensor_parallel=tensor_parallel,
-                           vocab_parallel=vocab_parallel,
+                           vocab_parallel=vocab_parallel, kernel=kernel,
                            num_slots=DEC_SLOTS, max_len=DEC_T,
                            prefill_len=8, decode_steps=4)
     return engine.compiled_decode_text()

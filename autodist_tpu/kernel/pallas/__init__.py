@@ -1,0 +1,83 @@
+"""The Pallas fused-kernel tier: cost-model alternatives the search elects.
+
+Three TPU kernels replace hot composed-XLA-op paths when — and only
+when — the Strategy IR's ``kernel`` slot elects them (a calibratable
+crossover decision, never an unconditional swap; the hierarchical
+placement results of arxiv 2110.10548 say the win is topology-
+dependent, and the round-3 flash-crossover measurements say it is
+shape-dependent too):
+
+* :func:`~autodist_tpu.kernel.pallas.flash_decode.flash_decode_attention`
+  — single-query-per-slot block-streaming attention over the TP-sharded
+  KV cache (online softmax, masked slot lengths), the decode analog of
+  ``ops/flash_attention.py`` and the kernel that finally lets
+  ``ServingEngine`` accept ``attention_fn``.
+* :func:`~autodist_tpu.kernel.pallas.quant_ring.quantized_ring_all_reduce`
+  — the EQuARX-style fused quantize-into-all-reduce (PAPERS.md
+  2506.17615): quantize/dequantize happens *per hop inside the ring
+  step* and the wire carries TRUE ``s8`` chunks, replacing the
+  convert-sandwich ``kernel/quantize.py`` wraps around one monolithic
+  fp16-wire collective — a form composed HLO cannot express.
+* :func:`~autodist_tpu.kernel.pallas.collective_matmul
+  .collective_matmul_row_fused` — the ``ppermute``-chunked row-parallel
+  matmul of ``parallel/tensor.py collective_matmul_row`` with the hop
+  accumulate + chunk matmul fused into one kernel pass.
+
+Every kernel runs under the Pallas interpreter off-TPU (the simulated
+CPU mesh the test harness uses), so each carries a CPU golden pinned
+against its composed lowering; on real TPU the same ``pallas_call``
+compiles through Mosaic.  Each call site is wrapped in a
+``jax.named_scope`` whose :func:`kernel_marker` string survives into
+optimized-HLO op metadata — the structural evidence the ADT120 program
+rule (``fused_kernel_replaced``) keys on to prove an elected kernel
+actually replaced the composed op soup.
+"""
+from __future__ import annotations
+
+# The Strategy IR's kernel-slot vocabulary (strategy/ir.py
+# normalize_kernel re-exports this; kernel code stays IR-agnostic).
+KERNEL_CHOICES = ("flash_decode", "quant_ring", "collective_matmul")
+
+# Kernels that change the *training* program (the pipeline lowering
+# honors them); flash_decode is serving-side (the decode program).
+TRAINING_KERNELS = ("quant_ring", "collective_matmul")
+
+# Op-metadata marker prefix: `with jax.named_scope(kernel_marker(name))`
+# around a pallas_call stamps every emitted op's `op_name` metadata, and
+# the string survives XLA optimization (fusion keeps per-instruction
+# metadata) — analysis/facts.py counts these per kernel.
+_MARKER_PREFIX = "adtk_"
+
+
+def kernel_marker(name: str) -> str:
+    """The ``named_scope`` string an elected kernel's call site wears."""
+    if name not in KERNEL_CHOICES:
+        raise ValueError(f"unknown kernel {name!r}; expected one of "
+                         f"{list(KERNEL_CHOICES)}")
+    return _MARKER_PREFIX + name
+
+
+def default_interpret() -> bool:
+    """Pallas interpreter off-TPU (CPU goldens / simulated meshes);
+    Mosaic compilation on real silicon."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def __getattr__(name):
+    # Lazy kernel re-exports: importing the registry (strategy/ir.py
+    # does, at module import) must not pull jax.experimental.pallas.
+    if name == "flash_decode_attention":
+        from autodist_tpu.kernel.pallas.flash_decode import \
+            flash_decode_attention
+        return flash_decode_attention
+    if name == "quantized_ring_all_reduce":
+        from autodist_tpu.kernel.pallas.quant_ring import \
+            quantized_ring_all_reduce
+        return quantized_ring_all_reduce
+    if name == "collective_matmul_row_fused":
+        from autodist_tpu.kernel.pallas.collective_matmul import \
+            collective_matmul_row_fused
+        return collective_matmul_row_fused
+    raise AttributeError(name)

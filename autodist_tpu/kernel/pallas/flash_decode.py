@@ -1,0 +1,142 @@
+"""Flash-decode attention: one query per slot, block-streamed KV cache.
+
+The decode analog of ``ops/flash_attention.py``: a single-token step's
+attention over a layer's cache slice (``serving/kv_cache.py
+cached_attention``) computes a ``[B, heads, 1, T]`` score row, a full-T
+softmax, and a second full-T contraction — three HBM-shaped passes over
+the cache per layer per token.  This kernel streams the cache in
+``block_k``-sized tiles with the online-softmax recurrence (running
+max / sum / accumulator in VMEM), so the cache is read once and the
+scores never exist outside a ``[1, block_k]`` tile.
+
+Masking matches ``cached_attention`` exactly: key positions ``<=
+lengths[slot]`` are visible (the just-written token attends to itself
+and everything before it), everything past a slot's occupancy —
+including the zero tail and any previous occupant's stale rows — is
+unreachable.  Slot lengths shorter than one block and cache lengths
+that don't divide ``block_k`` are handled by the same mask (the wrapper
+zero-pads T up to a block multiple; padded positions sit above every
+legal length).
+
+Softmax statistics in fp32 regardless of cache dtype, the trained
+model's scaling — the greedy-parity goldens pin token-for-token
+agreement with the full-recompute ``sequential_logits`` reference.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from autodist_tpu.kernel.pallas import default_interpret, kernel_marker
+
+NEG_INF = float(np.finfo(np.float32).min)
+
+# Default cache-tile length.  Small caches stream in one tile; the
+# tuning table measured by ``tools/flash_crossover.py --decode`` can
+# override per call.
+DEFAULT_BLOCK_K = 128
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                   num_blocks: int, scale: float, out_dtype):
+    """One (slot, head) program: online-softmax over T in ``block_k``
+    tiles.  ``len_ref``: (1, 1) int32 in SMEM — the slot's occupancy;
+    visible keys are positions ``<= length``."""
+    length = len_ref[0, 0]
+    d = q_ref.shape[-1]
+    q = q_ref[...].reshape(1, d).astype(jnp.float32)
+
+    def body(i, carry):
+        m, s, acc = carry
+        kblk = k_ref[0, 0, pl.ds(i * block_k, block_k), :] \
+            .astype(jnp.float32)                          # [bk, d]
+        scores = jax.lax.dot_general(
+            q, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [1, bk]
+        idx = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        scores = jnp.where(idx <= length, scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)                        # [1, bk]
+        vblk = v_ref[0, 0, pl.ds(i * block_k, block_k), :] \
+            .astype(jnp.float32)                          # [bk, d]
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [1, d]
+        return m_new, s_new_of(s, alpha, p), acc_new
+
+    def s_new_of(s, alpha, p):
+        return s * alpha + jnp.sum(p, axis=-1, keepdims=True)
+
+    m0 = jnp.full((1, 1), NEG_INF, jnp.float32)
+    s0 = jnp.zeros((1, 1), jnp.float32)
+    acc0 = jnp.zeros((1, d), jnp.float32)
+    m, s, acc = jax.lax.fori_loop(0, num_blocks, body, (m0, s0, acc0))
+    # Position 0 is always visible (length >= 0), so s > 0.
+    o_ref[...] = (acc / s).reshape(o_ref.shape).astype(out_dtype)
+
+
+def flash_decode_attention(q, k_layer, v_layer, lengths, *,
+                           dtype=jnp.float32,
+                           block_k: Optional[int] = None,
+                           interpret: Optional[bool] = None):
+    """Drop-in fused replacement for :func:`autodist_tpu.serving.
+    kv_cache.cached_attention`.
+
+    ``q``: ``[B, 1, heads, head_dim]`` (the step's query);
+    ``k_layer``/``v_layer``: ``[B, heads, T, head_dim]`` (one layer's
+    cache slice in its native layout); ``lengths``: ``[B]`` int32.
+    Returns ``[B, 1, heads, head_dim]`` in ``dtype``.
+
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU (the
+    CPU-golden contract); ``block_k`` defaults to
+    :data:`DEFAULT_BLOCK_K` capped at the padded cache length.
+    """
+    B, _, H, d = q.shape
+    T = k_layer.shape[2]
+    interp = default_interpret() if interpret is None else bool(interpret)
+    bk = min(int(block_k or DEFAULT_BLOCK_K), T)
+    pad = (-T) % bk
+    if pad:
+        # Padded positions sit at idx >= T > any legal length, so the
+        # in-kernel mask never reads them as real keys — no clamped
+        # dynamic-slice aliasing of earlier rows.
+        cfg = [(0, 0), (0, 0), (0, pad), (0, 0)]
+        k_layer = jnp.pad(k_layer, cfg)
+        v_layer = jnp.pad(v_layer, cfg)
+    num_blocks = (T + pad) // bk
+    scale = 1.0 / float(np.sqrt(d))
+
+    q2 = jnp.swapaxes(q, 1, 2)                 # [B, H, 1, d]
+    len2d = lengths.astype(jnp.int32).reshape(B, 1)
+
+    import functools
+
+    kern = functools.partial(_decode_kernel, block_k=bk,
+                             num_blocks=num_blocks, scale=scale,
+                             out_dtype=dtype)
+    with jax.named_scope(kernel_marker("flash_decode")):
+        out = pl.pallas_call(
+            kern,
+            grid=(B, H),
+            in_specs=[
+                pl.BlockSpec((1, 1), lambda b, h: (b, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1, 1, d), lambda b, h: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, T + pad, d),
+                             lambda b, h: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, T + pad, d),
+                             lambda b, h: (b, h, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, 1, d),
+                                   lambda b, h: (b, h, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((B, H, 1, d), dtype),
+            interpret=interp,
+        )(len2d, q2, k_layer, v_layer)
+    return jnp.swapaxes(out, 1, 2)             # [B, 1, H, d]

@@ -563,4 +563,20 @@ def make_attention_fn(causal: bool, *, block_q: Optional[int] = None,
         return flash_attention(q, k, v, causal=causal,
                                block_q=block_q, block_k=block_k)
 
+    # Recognition tag: the serving engine accepts exactly this family
+    # of attention_fns (numerics-equivalent to the trained einsum path,
+    # decode served by the flash-decode cache kernel).
+    attention_fn._adt_flash = True
     return attention_fn
+
+
+def is_flash_attention_fn(fn) -> bool:
+    """True when ``fn`` is this module's flash attention (the
+    :func:`make_attention_fn` adapter or the kernel itself) — the
+    family ``ServingEngine`` accepts as ``cfg.attention_fn``.  Only
+    the tagged adapter and the kernel qualify: other helpers from this
+    module (``make_attention_fn`` itself uncalled,
+    ``flash_attention_with_lse``'s two-output form) must still get the
+    engine's coded rejection rather than a trace-time shape error."""
+    return bool(getattr(fn, "_adt_flash", False)) \
+        or fn is flash_attention

@@ -84,6 +84,20 @@ def emit_precision_gauges(precision: dict):
         telemetry.get().gauge(f"precision/{b}_bits").set(PRECISION_BITS[p])
 
 
+def emit_kernel_gauges(kernel: dict):
+    """Per-kernel ``kernel/<name>_elected`` gauges — emitted by every
+    lowering that honors a fused-kernel election (the pipeline lowering
+    for the training kernels, the serving engine for flash_decode), so
+    ``tools/telemetry_report.py --check`` can gate a run's declared
+    kernel annotation against what actually lowered."""
+    if not kernel:
+        return
+    from autodist_tpu import telemetry
+
+    for name in kernel:
+        telemetry.get().gauge(f"kernel/{name}_elected").set(1)
+
+
 def ssp_staleness_from(strategy) -> int:
     """Max PS ``staleness`` over the strategy's node configs — the
     bound the runner's host-side SSP gate enforces (the gate is

@@ -279,6 +279,10 @@ class _PipelineLowered(SimpleLowered):
     # everywhere) — the plan record a caller can audit without
     # re-deriving the graph/per-variable adoption rules.
     precision: Any = None
+    # The fused-kernel election this program lowered with (normalized
+    # name -> True dict; {} = composed everywhere) — same audit record
+    # as ``precision``.
+    kernel: Any = None
     # Elastic state-codec builder (closure over _build_pipeline's layout
     # bookkeeping): state tree -> per-leaf stored↔logical recipes.
     state_manifest_fn: Any = None
@@ -343,7 +347,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                     remat: bool = False, tp_specs=None,
                     model_axis: str = const.MODEL_AXIS,
                     comm_overlap=None, shared_specs=None,
-                    zero_degraded=None, precision=None):
+                    zero_degraded=None, precision=None, kernel=None):
     """Shared construction for the direct API and the Strategy-IR entry;
     returns a Lowered-contract container.
 
@@ -460,9 +464,15 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
     # step body (stage code keeps its signature); zero3_gather binds
     # into the gather chain; the grad slot was already resolved into
     # compressor configs by the builder / lower_pipeline_ir.
-    from autodist_tpu.strategy.ir import normalize_precision
+    from autodist_tpu.strategy.ir import (normalize_kernel,
+                                          normalize_precision)
     precision = normalize_precision(precision)
     zero3_precision = precision.get("zero3_gather", "fp32")
+    # Fused-kernel tier election (Strategy IR kernel slot): applied
+    # through the same trace-time scope discipline as the precision
+    # policy — flash_decode is serving-side and ignored here.
+    kernel = {k: True for k in normalize_kernel(kernel)
+              if k in ("quant_ring", "collective_matmul")}
     tp = mesh.shape.get(model_axis, 1) if tp_specs else 1
     if (tp_specs or shared_specs) and model_axis not in mesh.shape:
         raise ValueError(
@@ -1003,8 +1013,9 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
         # boundary primitive — including the custom-VJP backwards
         # linearized within value_and_grad below — resolves the policy
         # at trace time.
-        from autodist_tpu.parallel.tensor import precision_scope
-        with precision_scope(precision):
+        from autodist_tpu.parallel.tensor import (kernel_scope,
+                                                  precision_scope)
+        with precision_scope(precision), kernel_scope(kernel):
             return _local_step_impl(state, batch, rng)
 
     def _local_step_impl(state, batch, rng):
@@ -1122,8 +1133,9 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
 
     def _local_eval(state, batch, rng):
         # Eval is deterministic: no rng reaches the stages (dropout off).
-        from autodist_tpu.parallel.tensor import precision_scope
-        with precision_scope(precision):
+        from autodist_tpu.parallel.tensor import (kernel_scope,
+                                                  precision_scope)
+        with precision_scope(precision), kernel_scope(kernel):
             _, metrics = _forward_loss(state["params"], batch, None)
             return _broadcast_metrics(metrics)
 
@@ -1246,6 +1258,7 @@ def _build_pipeline(stage_fn: Callable, stacked_params, loss_head: Callable,
                             zero3_shapes=zero3_shapes,
                             zero_degraded=zero_degraded,
                             precision=dict(precision),
+                            kernel=dict(kernel),
                             state_manifest_fn=_state_manifest,
                             sync_init=dict(sync_rows))
 
@@ -1379,6 +1392,35 @@ def lower_pipeline_ir(trainable, strategy, mesh):
             precision[slot] = vps.pop()
     precision = normalize_precision(precision)
 
+    # Fused-kernel tier (Strategy IR kernel slot, PR 13).  Each training
+    # kernel needs its enabling knob — electing it without one would be
+    # a silent no-op the user believes is active (mirrors the
+    # comm_overlap/precision reject-don't-drift discipline; plan lint
+    # ADT090 reports the same contradictions on hand-edited JSON):
+    # quant_ring replaces the monolithic int8 tp_psum (so it needs the
+    # int8 slot and the blocking form — a decomposed boundary never
+    # takes the psum path), collective_matmul fuses the ppermute ring
+    # (so it needs comm_overlap == "matmul").  flash_decode is the
+    # serving engine's kernel: recorded here, applied there.
+    from autodist_tpu.strategy.ir import normalize_kernel
+    kernel = normalize_kernel(cfg.kernel)
+    if "quant_ring" in kernel:
+        if precision.get("tp_psum") != "int8":
+            raise ValueError(
+                "kernel 'quant_ring' fuses q/dq into the int8 tp_psum "
+                "ring; set collective_precision's tp_psum slot to "
+                "'int8' (or drop the kernel election)")
+        if overlap is not None:
+            raise ValueError(
+                "kernel 'quant_ring' replaces the monolithic tp_psum; "
+                f"comm_overlap={overlap!r} routes the boundary through "
+                "the decomposed rs+ag/matmul forms instead — pick one")
+    if "collective_matmul" in kernel and overlap != "matmul":
+        raise ValueError(
+            "kernel 'collective_matmul' fuses the chunked ppermute "
+            "ring; it requires comm_overlap='matmul' "
+            f"(got {overlap!r})")
+
     # Per-variable synchronizer configs (PS -> ZeRO stages, compressors)
     # compose with the pipeline: stage variables zero/compress over the
     # data axes (they are pipe-sharded already), shared variables zero
@@ -1422,8 +1464,14 @@ def lower_pipeline_ir(trainable, strategy, mesh):
     # Per-boundary precision gauges: a lowering that silently dropped
     # the policy would miss these, and `tools/telemetry_report.py
     # --check` schema-gates them against the run's annotation.
-    from autodist_tpu.parallel._spmd import emit_precision_gauges
+    from autodist_tpu.parallel._spmd import (emit_kernel_gauges,
+                                             emit_precision_gauges)
     emit_precision_gauges(precision)
+    # kernel/<name>_elected gauges for the kernels THIS lowering honors
+    # (flash_decode's gauge is the serving engine's to emit) — the
+    # schema gate `tools/telemetry_report.py --check` matches them
+    # against the run's declared kernel annotation.
+    emit_kernel_gauges({k: True for k in kernel if k != "flash_decode"})
     if not d_axes:
         dropped = sorted(nm for nm, p in policies.items()
                          if p.compressor != "none")
@@ -1445,4 +1493,4 @@ def lower_pipeline_ir(trainable, strategy, mesh):
         remat=bool(cfg.parallel.get("remat", False)),
         tp_specs=tp_specs, comm_overlap=overlap,
         shared_specs=shared_specs, zero_degraded=degraded,
-        precision=precision)
+        precision=precision, kernel=kernel)

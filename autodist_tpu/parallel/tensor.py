@@ -101,6 +101,37 @@ def active_precision(slot: str) -> str:
     return _active_slots.get(slot, "fp32")
 
 
+# --------------------------------------------------------------------------- #
+# Fused-kernel scope (the Strategy IR kernel slot, PR 13)
+# --------------------------------------------------------------------------- #
+# The kernels elected for the program being traced, read by the
+# primitives below at TRACE time — same discipline as the precision
+# scope: the lowering opens the scope inside its traced step body,
+# stage code keeps calling the primitives unchanged, and code outside
+# any scope (the sequential reference, every pre-PR-13 program) lowers
+# composed exactly as before.
+_active_kernels: frozenset = frozenset()
+
+
+@contextlib.contextmanager
+def kernel_scope(kernel):
+    """Activate a fused-kernel election (a ``normalize_kernel`` dict or
+    an iterable of kernel names) for the primitives traced inside the
+    ``with`` body."""
+    global _active_kernels
+    prev = _active_kernels
+    names = kernel.keys() if isinstance(kernel, dict) else (kernel or ())
+    _active_kernels = frozenset(names)
+    try:
+        yield
+    finally:
+        _active_kernels = prev
+
+
+def active_kernel(name: str) -> bool:
+    return name in _active_kernels
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _gather_grads_fp32(x, model_axis):
     return x
@@ -139,10 +170,15 @@ def gather_grads(x, model_axis):
     Under a non-fp32 ``tp_psum`` precision scope the backward cotangent
     reduction narrows (:func:`~autodist_tpu.kernel.quantize
     .quantized_psum`) — the custom-VJP wrapper is what lets a *backward*
-    boundary carry the policy too."""
+    boundary carry the policy too.  With the ``quant_ring`` kernel
+    elected (and the slot at int8), the reduction runs the fused-q/dq
+    EQuARX ring instead of the composed convert sandwich."""
     prec = active_precision("tp_psum")
     if prec == "fp32":
         return _gather_grads_fp32(x, model_axis)
+    if prec == "int8" and active_kernel("quant_ring"):
+        from autodist_tpu.kernel.pallas.quant_ring import ring_gather_grads
+        return ring_gather_grads(x, model_axis)
     return _gather_grads_q(x, model_axis, prec)
 
 
@@ -182,10 +218,14 @@ def sum_partials(x, model_axis):
     """psum-over-``model_axis`` forward / identity backward (Megatron g).
 
     The forward reduction narrows to the active ``tp_psum`` precision
-    (fp32 outside any scope — the exact psum)."""
+    (fp32 outside any scope — the exact psum); int8 under the
+    ``quant_ring`` kernel election takes the fused-q/dq ring."""
     prec = active_precision("tp_psum")
     if prec == "fp32":
         return _sum_partials_fp32(x, model_axis)
+    if prec == "int8" and active_kernel("quant_ring"):
+        from autodist_tpu.kernel.pallas.quant_ring import ring_sum_partials
+        return ring_sum_partials(x, model_axis)
     return _sum_partials_q(x, model_axis, prec)
 
 
@@ -631,7 +671,12 @@ def row_parallel(x, kernel, bias=None, *, model_axis=None, axes: int = 1,
     """
     overlap = normalize_comm_overlap(comm_overlap)
     if model_axis is not None and overlap == "matmul":
-        y = collective_matmul_row(x, kernel, model_axis, axes)
+        if active_kernel("collective_matmul") and kernel.ndim == axes + 1:
+            from autodist_tpu.kernel.pallas.collective_matmul import \
+                collective_matmul_row_fused
+            y = collective_matmul_row_fused(x, kernel, model_axis, axes)
+        else:
+            y = collective_matmul_row(x, kernel, model_axis, axes)
     else:
         y = jnp.tensordot(x, kernel, axes=axes)
         if model_axis is not None:

@@ -96,6 +96,9 @@ def seed_engine_kwargs(engine_kwargs: dict, strategy) -> dict:
         engine_kwargs.setdefault(
             "vocab_parallel", bool(par.get("vocab_parallel", False)))
         engine_kwargs.setdefault("comm_overlap", par.get("comm_overlap"))
+        kern = getattr(strategy.graph_config, "kernel", None)
+        if kern:
+            engine_kwargs.setdefault("kernel", dict(kern))
     return engine_kwargs
 
 
@@ -122,21 +125,41 @@ class ServingEngine:
 
     def __init__(self, cfg, params, *, tensor_parallel: int = 1,
                  vocab_parallel: bool = False, comm_overlap=None,
+                 kernel=None,
                  num_slots: int = 4, max_len: Optional[int] = None,
                  prefill_len: Optional[int] = None, decode_steps: int = 8,
                  devices=None):
+        from autodist_tpu.strategy.ir import normalize_kernel
+
         self.cfg = cfg
-        if getattr(cfg, "attention_fn", None) is not None:
-            # The decode step attends over the cache with its own
-            # masked kernel; a custom attention_fn (flash/ring) would
-            # serve different numerics than it trained with.  Flash
-            # decode is a ROADMAP rung — reject rather than drift.
-            raise NotImplementedError(
-                "serving a model with cfg.attention_fn set is not "
-                "supported yet: decode attends over the KV cache with "
-                "the einsum kernel; clear attention_fn (numerics-"
-                "equivalent for trained weights) or wait for the "
-                "flash-decode path")
+        # The fused-kernel election (Strategy IR kernel slot): only
+        # flash_decode changes the serving programs — prefill/decode
+        # have no grad sync or matmul-overlap ring for the training
+        # kernels to replace.
+        self.kernel = normalize_kernel(kernel)
+        attn_fn = getattr(cfg, "attention_fn", None)
+        if attn_fn is not None:
+            from autodist_tpu.ops.flash_attention import \
+                is_flash_attention_fn
+            if not is_flash_attention_fn(attn_fn):
+                # The decode step attends over the cache with its own
+                # masked kernel; an unrecognized attention_fn (ring,
+                # hand-rolled) would serve different numerics than it
+                # trained with — reject rather than drift, naming the
+                # supported kernel.
+                raise NotImplementedError(
+                    "serving supports cfg.attention_fn only for the "
+                    "flash-attention family (autodist_tpu.ops."
+                    "make_attention_fn / flash_attention — numerics-"
+                    "equivalent to the trained einsum path, decode "
+                    "served by the flash-decode cache kernel); got "
+                    f"{getattr(attn_fn, '__name__', attn_fn)!r} — "
+                    "clear attention_fn or use the supported kernel")
+            # Flash prefill ⇒ flash decode: the decode-parity gate (the
+            # greedy goldens pin decode token-for-token against the
+            # sequential_logits reference, which runs the same
+            # attention_fn).
+            self.kernel = dict(self.kernel, flash_decode=True)
         if cfg.dropout_rate or cfg.attention_dropout_rate:
             raise ValueError(
                 "serving requires dropout_rate == "
@@ -208,6 +231,12 @@ class ServingEngine:
 
         self._prefill_jit = self._build_prefill()
         self._decode_jit = self._build_decode()
+        if self.kernel.get("flash_decode"):
+            # The serving-side kernel/<name>_elected gauge (the pipeline
+            # lowering emits the training kernels' gauges) — schema-
+            # gated by `tools/telemetry_report.py --check`.
+            from autodist_tpu.parallel._spmd import emit_kernel_gauges
+            emit_kernel_gauges({"flash_decode": True})
 
     # ------------------------------------------------------------------ #
     # constructors from the training stack
@@ -272,8 +301,14 @@ class ServingEngine:
         q, k, v = jnp.moveaxis(qkv, -3, 0)          # [B, 1, heads, dh]
         kc = kv_cache.write_token(kc, layer, k, lengths)
         vc = kv_cache.write_token(vc, layer, v, lengths)
-        out = kv_cache.cached_attention(q, kc[layer], vc[layer], lengths,
-                                        dtype=dtype)
+        if self.kernel.get("flash_decode"):
+            from autodist_tpu.kernel.pallas.flash_decode import \
+                flash_decode_attention
+            out = flash_decode_attention(q, kc[layer], vc[layer],
+                                         lengths, dtype=dtype)
+        else:
+            out = kv_cache.cached_attention(q, kc[layer], vc[layer],
+                                            lengths, dtype=dtype)
         a = row_parallel(out, att["out"]["kernel"].astype(dtype),
                          att["out"]["bias"].astype(dtype),
                          model_axis=axis, axes=2, comm_overlap=overlap)

@@ -95,6 +95,40 @@ QUANT_PROFILE: dict = {
     "int8_s_per_elem": 1e-10,
 }
 
+# Fused-kernel tier pricing (the Strategy IR ``kernel`` slot, PR 13) —
+# analytic defaults; a ``"kernel"`` section in calibration.json
+# (written mechanically from ``tools/flash_crossover.py --decode`` /
+# ``bench.py flash`` measurements) replaces them like ``"link"`` and
+# ``"quant"``:
+#
+# * ``quant_ring_wire_factor`` — the EQuARX ring's TRUE-s8 wire vs the
+#   composed int8 psum's fp16-levels wire (0.25 vs PSUM_WIRE_FACTOR's
+#   0.5): the ring halves the bytes again.
+# * ``quant_ring_qdq_factor`` — the q/dq passes the per-hop fused
+#   requantization costs relative to the composed sandwich's one
+#   quantize + one dequantize (each hop re-quantizes, so ~2x at tp=2
+#   and growing with hops; the fused VMEM pass keeps it near the byte
+#   count rather than 2(n-1) full passes).
+# * ``fused_hop_alpha_s`` — per-hop launch overhead of the fused
+#   collective-matmul ring step (one kernel issues the hop's
+#   accumulate+matmul, and on silicon its RDMA): the composed ring
+#   pays the full ``hop_alpha_s`` per hop.
+# * ``flash_decode_crossover_len`` / ``flash_decode_speedup`` /
+#   ``flash_decode_short_penalty`` — the decode einsum-vs-flash
+#   crossover: past the crossover length flash divides the attention
+#   term by the measured speedup; below it the kernel's fixed overhead
+#   *loses* to einsum by the penalty factor (the round-3 verdict's
+#   measured shape), so the search elects flash exactly when the cache
+#   length favors it.
+KERNEL_PROFILE: dict = {
+    "quant_ring_wire_factor": 0.25,
+    "quant_ring_qdq_factor": 2.0,
+    "fused_hop_alpha_s": 1e-6,
+    "flash_decode_crossover_len": 1024,
+    "flash_decode_speedup": 1.6,
+    "flash_decode_short_penalty": 0.8,
+}
+
 # The grad slot's realization: which EF compressor a bf16/int8 gradient
 # policy elects (mirrors lower_pipeline_ir / build_replicated_spmd).
 _GRAD_PRECISION_COMPRESSOR = {"bf16": "bf16_ef", "int8": "int8_ef"}
@@ -153,6 +187,10 @@ def load_calibration(path: Optional[str] = None) -> dict:
             # ``"quant"`` section ``tools/calibrate_compressors.py``
             # emits) replace the analytic q/dq defaults the same way.
             QUANT_PROFILE.update(dict(data.get("quant", {})))
+            # Measured fused-kernel constants (``tools/flash_crossover
+            # .py --decode`` / ``bench.py flash``) replace the kernel
+            # tier's analytic defaults the same way.
+            KERNEL_PROFILE.update(dict(data.get("kernel", {})))
             return factors
     return {}
 
@@ -247,6 +285,12 @@ class DecodeCost:
     feasible: bool
     tensor_parallel: int = 1
     vocab_parallel: bool = False
+    # Attention-over-cache share of compute_time_s (already included):
+    # the term the flash_decode kernel divides by its calibrated
+    # speedup past the crossover length — broken out so the election
+    # report can show why flash won (or lost) at this cache length.
+    attn_time_s: float = 0.0
+    kernel: tuple = ()
 
     @property
     def score(self) -> float:
@@ -264,7 +308,8 @@ class CostModel:
                  tokens_per_step: Optional[int] = None,
                  act_bytes_per_token: Optional[float] = None,
                  link_profile: Optional[dict] = None,
-                 quant_profile: Optional[dict] = None):
+                 quant_profile: Optional[dict] = None,
+                 kernel_profile: Optional[dict] = None):
         """``sparsity_fraction``: expected fraction of embedding rows
         touched per step (drives the sparse gather/scatter volume).
         ``opt_state_multiplier``: optimizer slots per parameter byte
@@ -281,7 +326,9 @@ class CostModel:
         ``quant_profile``: quantize/dequantize per-element costs for the
         precision-policy pricing (keys ``bf16_s_per_elem`` /
         ``int8_s_per_elem``); same override chain as ``link_profile``
-        against :data:`QUANT_PROFILE`."""
+        against :data:`QUANT_PROFILE`.
+        ``kernel_profile``: fused-kernel tier constants (see
+        :data:`KERNEL_PROFILE`); same override chain."""
         _ensure_calibration()
         self.spec = resource_spec
         self.chip = resource_spec.chip
@@ -296,6 +343,9 @@ class CostModel:
         self.quant_profile = dict(QUANT_PROFILE)
         if quant_profile:
             self.quant_profile.update(quant_profile)
+        self.kernel_profile = dict(KERNEL_PROFILE)
+        if kernel_profile:
+            self.kernel_profile.update(kernel_profile)
 
     # ------------------------------------------------------------------ #
     def with_spec(self, resource_spec: ResourceSpec) -> "CostModel":
@@ -311,7 +361,8 @@ class CostModel:
                          tokens_per_step=self.tokens_per_step,
                          act_bytes_per_token=self.act_bytes_per_token,
                          link_profile=self.link_profile,
-                         quant_profile=self.quant_profile)
+                         quant_profile=self.quant_profile,
+                         kernel_profile=self.kernel_profile)
 
     def _dcn_link(self) -> tuple[float, float]:
         """(bytes/s, launch alpha) of the cross-slice DCN level —
@@ -574,8 +625,20 @@ class CostModel:
         # each policied boundary's bytes; the q/dq compute term charges
         # the quantize/dequantize passes against the saving — a narrowed
         # plan outranks fp32 exactly when the saved wire time exceeds it.
-        from autodist_tpu.strategy.ir import normalize_precision
+        from autodist_tpu.strategy.ir import (normalize_kernel,
+                                              normalize_precision)
         policy = normalize_precision(strategy.graph_config.precision)
+        # Fused-kernel tier (PR 13): the quant_ring kernel trades the
+        # composed int8 psum's fp16-levels wire for TRUE s8 at the cost
+        # of per-hop requantization; the fused collective-matmul ring
+        # shrinks the per-hop launch overhead.  Priced from the
+        # calibratable KERNEL_PROFILE so the search elects each kernel
+        # exactly when its crossover favors it.
+        kern_cfg = normalize_kernel(
+            getattr(strategy.graph_config, "kernel", None))
+        ring_kernel = "quant_ring" in kern_cfg
+        fused_mm = "collective_matmul" in kern_cfg
+        kp = self.kernel_profile
         tp_prec = policy.get("tp_psum", "fp32")
         stats_prec = policy.get("vocab_stats", "fp32")
         z3_prec = policy.get("zero3_gather", "fp32")
@@ -857,12 +920,23 @@ class CostModel:
                         prec_b = tp_prec if tp_prec != "fp32" else \
                             (getattr(part, "precision", None) or "fp32")
                         act_factor = PSUM_WIRE_FACTOR[prec_b]
+                        use_ring = (ring_kernel and prec_b == "int8"
+                                    and mode is None and not tp_over_dcn)
+                        if use_ring:
+                            # EQuARX ring: TRUE s8 chunks on every hop
+                            # (vs int8 levels on an fp16 wire), paid for
+                            # with per-hop fused requantization passes.
+                            act_factor = float(
+                                kp["quant_ring_wire_factor"])
                         if prec_b != "fp32":
                             # fwd + bwd payload elements per step, each
                             # quantized before / dequantized after its
-                            # collective.
+                            # collective (the ring requantizes per hop —
+                            # the calibratable factor).
                             qdq_s += qdq(2.0 * V * tokens_local * width,
-                                         prec_b)
+                                         prec_b) \
+                                * (float(kp["quant_ring_qdq_factor"])
+                                   if use_ring else 1.0)
                         if tp_over_dcn:
                             # Megatron boundary spanning slices: the
                             # whole per-execution payload crosses DCN
@@ -879,7 +953,12 @@ class CostModel:
                         elif mode is None:
                             comm += act_bytes * act_factor
                             saved_bytes += act_bytes * (1.0 - act_factor)
-                            colls += 2 * M * V
+                            # The ring pays 2(n-1) hop launches per
+                            # boundary where the monolithic collective
+                            # pays one — part of the crossover the
+                            # election trades against the wire saving.
+                            colls += 2 * M * V * (
+                                2 * (tp_group - 1) if use_ring else 1)
                         else:
                             # Latency-hiding decomposition: price the
                             # Megatron boundary as max(comm, compute)
@@ -910,13 +989,22 @@ class CostModel:
                                 * (width / tp) / flops_rate
                             t_wire = tok_e * (width / tp) * _ACT_BYTES \
                                 * act_factor / bw_link
+                            # The fused collective-matmul kernel issues
+                            # each hop's accumulate+matmul (and, on
+                            # silicon, its RDMA) as ONE op — the per-hop
+                            # launch overhead drops to the calibratable
+                            # fused constant.
+                            mm_alpha = (float(kp["fused_hop_alpha_s"])
+                                        if fused_mm and mode == "matmul"
+                                        else hop_alpha)
                             t_hop = t_wire + hop_alpha
+                            t_hop_mm = t_wire + mm_alpha
                             t_blk = 2.0 * (tp - 1) * t_wire + hop_alpha
                             t_rsag = max(hop_alpha,
                                          2.0 * (tp - 1) * t_hop
                                          - tp * t_chunk)
-                            t_mm = (tp - 1) * (max(0.0, t_hop - t_chunk)
-                                               + t_hop)
+                            t_mm = (tp - 1) * (
+                                max(0.0, t_hop_mm - t_chunk) + t_hop_mm)
                             fwd_t = min(t_mm if mode == "matmul"
                                         else t_rsag, t_blk)
                             # The column partner's backward cotangent
@@ -1164,13 +1252,19 @@ class CostModel:
           (``2·layers·H·max_len·slots/tp`` elements), gated against HBM
           headroom like the training costs.
         """
+        from autodist_tpu.strategy.ir import normalize_kernel
+
         if isinstance(config, Strategy):
             par = config.graph_config.parallel or {}
             tp = int(par.get("tensor_parallel", 1) or 1)
             vocab_parallel = bool(par.get("vocab_parallel", False))
+            kern = normalize_kernel(
+                getattr(config.graph_config, "kernel", None))
         else:
             tp = int(config.get("tensor_parallel", 1) or 1)
             vocab_parallel = bool(config.get("vocab_parallel", False))
+            kern = normalize_kernel(config.get("kernel"))
+        flash = "flash_decode" in kern
         from autodist_tpu.strategy.parallel_builders import (
             PIPELINE_TP_RULES, PIPELINE_VOCAB_RULES)
 
@@ -1209,6 +1303,23 @@ class CostModel:
             "mxu_efficiency", _DEFAULT_MXU_EFFICIENCY))
         flops_rate = self.chip.peak_bf16_tflops * 1e12 * mxu_eff
         compute = 2.0 * elems * batch_slots / flops_rate
+        # Attention over the cache: per token, each layer contracts the
+        # query against its [heads/tp, max_len, head_dim] cache slice
+        # twice (scores + values) — the term that grows with occupancy
+        # and the one the flash_decode kernel moves.  Past the
+        # calibrated crossover length flash divides it by the measured
+        # speedup; below it the kernel's fixed overhead loses to plain
+        # einsum (the short penalty < 1), so the election flips exactly
+        # at the crossover.
+        attn = 4.0 * layers * hidden * max_len * batch_slots \
+            / max(tp, 1) / flops_rate
+        if flash:
+            kp = self.kernel_profile
+            if max_len >= float(kp["flash_decode_crossover_len"]):
+                attn /= float(kp["flash_decode_speedup"])
+            else:
+                attn /= float(kp["flash_decode_short_penalty"])
+        compute += attn
 
         bw_link = float(self.link_profile.get(
             "ici_gbps", self.chip.ici_gbps)) * 1e9
@@ -1228,7 +1339,8 @@ class CostModel:
         return DecodeCost(token_time_s=compute + comm, comm_time_s=comm,
                           compute_time_s=compute, kv_bytes_per_device=kv,
                           mem_bytes_per_device=mem, feasible=mem <= hbm,
-                          tensor_parallel=tp, vocab_parallel=vocab_parallel)
+                          tensor_parallel=tp, vocab_parallel=vocab_parallel,
+                          attn_time_s=attn, kernel=tuple(sorted(kern)))
 
     def strategy_cost(self, trainable: Trainable,
                       strategy: Strategy) -> StrategyCost:

@@ -83,6 +83,10 @@ class KnobConfig:
     comm_overlap: Optional[str] = None
     collective_precision: Optional[str] = None
     compressor: str = "none"
+    # Fused-kernel tier election: "fused" enables every Pallas kernel
+    # this knob point's enabling knobs admit (builder_from_knobs
+    # resolves the set); None keeps the composed lowerings.
+    kernel: Optional[str] = None
     pipeline: bool = True      # stage-structured (Pipeline) vs generic
 
     def mesh(self) -> dict:
@@ -100,8 +104,17 @@ class KnobConfig:
         return shape
 
     def mesh_key(self) -> tuple:
-        """Sibling group for dominance pruning: one mesh factorization."""
-        return (self.dp_dcn, self.dp_ici, self.pp, self.tp)
+        """Sibling group for dominance pruning: one mesh factorization,
+        split by kernel election.  The fused collective-matmul proxy is
+        one-sidedly better than its composed sibling (a launch credit
+        with no offsetting proxy term), so weak dominance inside one
+        group would delete the composed sibling before the REAL cost
+        model — where calibration can disfavor fusion
+        (``fused_hop_alpha_s`` at or above the measured ``hop_alpha``)
+        — ever prices it.  The kernel-vs-composed election must always
+        reach pricing, in both directions."""
+        return (self.dp_dcn, self.dp_ici, self.pp, self.tp,
+                bool(self.kernel))
 
     def knob_string(self) -> str:
         """Descriptive candidate name, e.g.
@@ -124,6 +137,8 @@ class KnobConfig:
             parts.append(f"ov-{self.comm_overlap}")
         if self.compressor != "none":
             parts.append(self.compressor)
+        if self.kernel:
+            parts.append("kern")
         return "_".join(parts)
 
     def knobs(self) -> dict:
@@ -134,7 +149,8 @@ class KnobConfig:
                 "zero_stage": self.zero_stage,
                 "comm_overlap": self.comm_overlap,
                 "collective_precision": self.collective_precision,
-                "compressor": self.compressor}
+                "compressor": self.compressor,
+                "kernel": self.kernel}
 
 
 @dataclasses.dataclass
@@ -152,6 +168,11 @@ class SearchSpace:
     comm_overlap: Sequence[Optional[str]] = (None, "matmul")
     collective_precision: Sequence[Optional[str]] = (None, "bf16", "int8")
     compressor: Sequence[str] = ("none", "bf16_ef")
+    # The fused-kernel tier: "fused" points are emitted only where an
+    # enabling knob admits a kernel (int8 tp_psum for quant_ring,
+    # matmul overlap for the fused ring step), so the kernel column
+    # never multiplies the whole space.
+    kernel: Sequence[Optional[str]] = (None, "fused")
     # Merge the hand-enumerated zoo into the frontier as seeds, so the
     # searched winner can never score below the zoo winner.
     seed_zoo: bool = True
@@ -301,13 +322,25 @@ def enumerate_configs(trainable: Trainable, resource_spec: ResourceSpec,
                                         continue
                                     if prec and not stage_structured:
                                         continue
-                                    configs.append(KnobConfig(
-                                        num_microbatches=M,
-                                        vocab_parallel=vp,
-                                        zero_stage=zero,
-                                        comm_overlap=ov,
-                                        collective_precision=prec,
-                                        compressor=comp, **base))
+                                    for kern in space.kernel:
+                                        if kern and not (
+                                                stage_structured
+                                                and tp > 1
+                                                and ((prec == "int8"
+                                                      and ov is None)
+                                                     or ov == "matmul")):
+                                            # No enabling knob — the
+                                            # point would be the ADT090
+                                            # no-op contradiction.
+                                            continue
+                                        configs.append(KnobConfig(
+                                            num_microbatches=M,
+                                            vocab_parallel=vp,
+                                            zero_stage=zero,
+                                            comm_overlap=ov,
+                                            collective_precision=prec,
+                                            compressor=comp,
+                                            kernel=kern, **base))
     return configs
 
 
@@ -365,6 +398,13 @@ def _proxies(cfg: KnobConfig, st: _Stats) -> tuple[float, float, float]:
             and cfg.compressor == "none":
         grad_f = 0.5
     wire_f = 0.5 if cfg.collective_precision else 1.0
+    # quant_ring: TRUE s8 chunks on the tp boundary wire (less comm)
+    # at more q/dq compute — mirrors the cost model's monotone trade so
+    # a kernel point and its composed sibling never dominate each other.
+    ring_kern = (cfg.kernel and cfg.collective_precision == "int8"
+                 and cfg.comm_overlap is None and cfg.tp > 1)
+    if ring_kern:
+        wire_f = 0.25
 
     sync_f = ring(cfg.dp_ici) + st.dcn_penalty * ring(cfg.dp_dcn) \
         / max(cfg.dp_ici, 1)
@@ -387,9 +427,13 @@ def _proxies(cfg: KnobConfig, st: _Stats) -> tuple[float, float, float]:
         launches += 2.0 * M * V
     if cfg.pipeline and cfg.pp > 1:
         launches += 2.0 * (M * V + cfg.pp - 1)
+    if cfg.kernel and cfg.comm_overlap == "matmul" and cfg.tp > 1:
+        # The fused ring step shrinks per-hop launch overhead.
+        launches -= 2.0 * M * V * 0.8
     compute = COLLECTIVE_ALPHA * launches
     if cfg.collective_precision and cfg.tp > 1 and tokens_local:
-        compute += 2.0 * V * tokens_local * st.hidden * 1e-10
+        compute += 2.0 * V * tokens_local * st.hidden * 1e-10 \
+            * (2.0 if ring_kern else 1.0)
     if cfg.pipeline and cfg.pp > 1 and st.tokens:
         bubble = (cfg.pp - 1) / (M * V + cfg.pp - 1)
         model_elems = (st.stage_bytes + st.shared_bytes) / 4.0

@@ -376,6 +376,7 @@ def builder_from_knobs(knobs, *, stage_structured: bool = True
     vocab_parallel = bool(k.get("vocab_parallel", False))
     comm_overlap = k.get("comm_overlap") or None
     prec = k.get("collective_precision") or None
+    kern = k.get("kernel") or None
 
     # Resolve a bare precision string onto only the boundary classes
     # this knob set emits (a full-slot policy on a plan without the
@@ -398,6 +399,27 @@ def builder_from_knobs(knobs, *, stage_structured: bool = True
                 f"compressor={compressor!r})")
         precision = slots
 
+    # Resolve a "fused" kernel request onto only the kernels this knob
+    # set enables (electing one without its knob is the ADT090
+    # contradiction the Pipeline builder rejects).
+    kernel = None
+    if kern:
+        if kern in ("fused", True):
+            names = []
+            if tp > 1 and comm_overlap is None \
+                    and precision and precision.get("tp_psum") == "int8":
+                names.append("quant_ring")
+            if tp > 1 and comm_overlap == "matmul":
+                names.append("collective_matmul")
+            if not names:
+                raise ValueError(
+                    f"kernel='fused' enables no kernel for this knob "
+                    f"set (tp={tp}, comm_overlap={comm_overlap!r}, "
+                    f"collective_precision={prec!r})")
+            kernel = tuple(names)
+        else:
+            kernel = kern
+
     if stage_structured:
         from autodist_tpu.strategy.parallel_builders import Pipeline
 
@@ -410,7 +432,8 @@ def builder_from_knobs(knobs, *, stage_structured: bool = True
             comm_overlap=comm_overlap,
             zero_stage=zero_stage or None,
             compressor=compressor,
-            collective_precision=precision)
+            collective_precision=precision,
+            kernel=kernel)
 
     # Generic (non-stage-structured) trainable: the collective/GSPMD
     # families.  Knobs with no realization here are rejected, not
@@ -418,6 +441,7 @@ def builder_from_knobs(knobs, *, stage_structured: bool = True
     for knob, value in (("vocab_parallel", vocab_parallel),
                         ("comm_overlap", comm_overlap),
                         ("collective_precision", prec),
+                        ("kernel", kern),
                         ("num_microbatches",
                          int(k.get("num_microbatches", 1) or 1) > 1)):
         if value:

@@ -42,6 +42,58 @@ from autodist_tpu import const
 from autodist_tpu.kernel.quantize import (PRECISIONS,  # noqa: E402
                                           UnknownPrecisionError)
 
+# --------------------------------------------------------------------------- #
+# Fused-kernel tier (PR 13): the Strategy IR's ``kernel`` slot elects
+# Pallas kernels from :data:`~autodist_tpu.kernel.pallas.KERNEL_CHOICES`
+# in place of their composed-XLA-op lowerings — a per-topology cost-model
+# decision beside ``comm_overlap``/``precision``, never an unconditional
+# swap.  An absent slot (the empty dict — what every pre-PR-13 strategy
+# JSON deserializes to) is the composed lowering everywhere.
+# --------------------------------------------------------------------------- #
+from autodist_tpu.kernel.pallas import KERNEL_CHOICES  # noqa: E402
+
+
+class UnknownKernelError(ValueError):
+    """A kernel name outside :data:`~autodist_tpu.kernel.pallas
+    .KERNEL_CHOICES` — the named error a hand-edited strategy JSON gets
+    instead of a silently ignored election."""
+
+
+def normalize_kernel(policy) -> dict:
+    """Canonicalize a fused-kernel election.
+
+    ``None``/``{}``/``False``/``""`` -> ``{}`` (composed lowerings —
+    the pre-PR-13 behavior); ``True``/``"all"`` elects every kernel; a
+    bare name or an iterable of names elects those; a dict keeps only
+    truthy entries.  The canonical form maps each elected name to
+    ``True`` so pre-PR-13 JSON round-trips with the slot absent-or-empty
+    and hand edits stay readable.  Unknown names raise
+    :class:`UnknownKernelError`.
+    """
+    if policy in (None, False, "", {}, (), []):
+        return {}
+    if policy is True or policy == "all":
+        return {k: True for k in KERNEL_CHOICES}
+    if isinstance(policy, str):
+        policy = (policy,)
+    if isinstance(policy, dict):
+        names = [k for k, v in policy.items() if v]
+    elif isinstance(policy, (list, tuple, set, frozenset)):
+        names = list(policy)
+    else:
+        raise UnknownKernelError(
+            f"kernel election must be a name, an iterable of names, or "
+            f"a name->bool dict; got {type(policy).__name__}")
+    out = {}
+    for name in names:
+        if name not in KERNEL_CHOICES:
+            raise UnknownKernelError(
+                f"unknown kernel {name!r}; expected one of "
+                f"{list(KERNEL_CHOICES)}")
+        out[name] = True
+    return {k: True for k in KERNEL_CHOICES if k in out}
+
+
 PRECISION_BOUNDARIES = (
     # dp gradient sync (all-reduce / reduce-scatter).  Realized through
     # the compressor machinery — the one boundary with persistent error-
@@ -325,6 +377,12 @@ class GraphConfig:
     # everywhere; hand-edited unknown boundaries/values are rejected
     # with UnknownPrecisionError at deserialization.
     precision: dict = dataclasses.field(default_factory=dict)
+    # Fused-kernel tier election: kernel name -> True (see
+    # normalize_kernel above).  Empty — what every pre-PR-13 strategy
+    # JSON deserializes to — is the composed lowering everywhere;
+    # hand-edited unknown names are rejected with UnknownKernelError at
+    # deserialization.
+    kernel: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -336,7 +394,8 @@ class GraphConfig:
                    lowering=d.get("lowering", "collective"),
                    accum_steps=d.get("accum_steps", 1),
                    parallel=dict(d.get("parallel", {})),
-                   precision=normalize_precision(d.get("precision")))
+                   precision=normalize_precision(d.get("precision")),
+                   kernel=normalize_kernel(d.get("kernel")))
 
 
 @dataclasses.dataclass
@@ -404,6 +463,8 @@ class Strategy:
             head += f", parallel={gc.parallel}"
         if gc.precision:
             head += f", precision={gc.precision}"
+        if gc.kernel:
+            head += f", kernel={sorted(gc.kernel)}"
         if gc.accum_steps > 1:
             head += f", accum_steps={gc.accum_steps}"
         lines = [head + ")"]

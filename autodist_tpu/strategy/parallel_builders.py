@@ -25,7 +25,8 @@ from autodist_tpu.strategy.gspmd_builders import TRANSFORMER_TP_RULES
 from autodist_tpu.utils import logging
 from autodist_tpu.strategy.ir import (AllReduceSynchronizer, NodeConfig,
                                       PartitionerConfig, PSSynchronizer,
-                                      Strategy, normalize_precision)
+                                      Strategy, normalize_kernel,
+                                      normalize_precision)
 
 # Megatron-style model-axis rules for tensor parallelism *inside* pipeline
 # stages, matched against the per-stage variable (the stacked leaf minus
@@ -215,7 +216,7 @@ class Pipeline(StrategyBuilder):
                  tp_rules: Sequence[tuple[str, list]] = None,
                  comm_overlap=None, vocab_parallel: bool = False,
                  vocab_rules: Sequence[tuple[str, list]] = None,
-                 collective_precision=None):
+                 collective_precision=None, kernel=None):
         if num_microbatches < 1:
             raise ValueError("num_microbatches must be >= 1")
         if virtual_stages < 1:
@@ -257,6 +258,34 @@ class Pipeline(StrategyBuilder):
         # conflicts with an explicit compressor= the same way zero does.
         self.precision = normalize_precision(collective_precision)
         _check_grad_precision(self.precision, compressor)
+        # Fused-kernel tier (PR 13): elect Pallas kernels in place of
+        # the composed lowerings — names from kernel.pallas
+        # .KERNEL_CHOICES.  Each training kernel needs its enabling knob
+        # (validated here so AutoStrategy/search skip unbuildable
+        # combos instead of failing at lowering; lower_pipeline_ir
+        # re-checks hand-edited JSON and plan lint ADT090 reports it):
+        # quant_ring rides the blocking int8 tp_psum, collective_matmul
+        # the comm_overlap="matmul" ring; flash_decode is serving-side
+        # and recorded for the engine to read.
+        self.kernel = normalize_kernel(kernel)
+        if "quant_ring" in self.kernel:
+            if tensor_parallel <= 1 \
+                    or self.precision.get("tp_psum") != "int8":
+                raise ValueError(
+                    "kernel 'quant_ring' fuses q/dq into the int8 "
+                    "tp_psum ring: it needs tensor_parallel > 1 and "
+                    "collective_precision's tp_psum slot at 'int8'")
+            if self.comm_overlap is not None:
+                raise ValueError(
+                    "kernel 'quant_ring' replaces the monolithic "
+                    "tp_psum; comm_overlap routes the boundary through "
+                    "the decomposed forms instead — pick one")
+        if "collective_matmul" in self.kernel and (
+                tensor_parallel <= 1 or self.comm_overlap != "matmul"):
+            raise ValueError(
+                "kernel 'collective_matmul' fuses the chunked ppermute "
+                "ring: it needs tensor_parallel > 1 and "
+                "comm_overlap='matmul'")
         # ZeRO stage over the data axes (stage vars) / pipe x data
         # (shared vars): 1 shards optimizer state, 2 additionally
         # accounts the gradients sharded (same U_FLAT program), 3 stores
@@ -432,6 +461,7 @@ class Pipeline(StrategyBuilder):
                         # PSSynchronizer.zero_stage node config.
                         "zero_stage": self.zero_stage}
         cfg.precision = dict(self.precision)
+        cfg.kernel = dict(self.kernel)
         return Strategy(node_configs=nodes, graph_config=cfg)
 
 

@@ -263,8 +263,11 @@ def main():
     # instead of training MFU; `bench.py quant` compares the dp×pp×tp
     # pipeline step at fp32 vs int8 collective precision.  The
     # UNAVAILABLE fresh-process retry carries the mode through sys.argv.
+    # `bench.py flash` compares the composed einsum decode step against
+    # the flash-decode Pallas kernel at the same cache occupancy.
     run = (_bench_serve if "serve" in sys.argv[1:]
-           else _bench_quant if "quant" in sys.argv[1:] else _bench)
+           else _bench_quant if "quant" in sys.argv[1:]
+           else _bench_flash if "flash" in sys.argv[1:] else _bench)
     dog = _Watchdog(2400, "backend init").arm()
     try:
         run(dog)
@@ -409,6 +412,115 @@ def _bench_quant(dog):
     dog.disarm()
     print(json.dumps(record), flush=True)
     telemetry.gauge("bench/quantized_speedup").set(ratio)
+    telemetry.flush()
+
+
+def _bench_flash(dog):
+    """`bench.py flash`: fused-vs-composed decode step ratio — the
+    measured half of the flash-decode kernel claim (the interpreter
+    goldens prove numerics, ADT120 proves the kernel is in the program;
+    this puts a wall-clock number on the crossover).  The record carries
+    the cost model's predicted crossover beside the measured ratio so a
+    hardware window can see whether the calibrated `"kernel"` section
+    still matches silicon.  Same provenance-stamped one-line record
+    shape and UNAVAILABLE fresh-process backoff as the other modes."""
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import telemetry
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.serving import ServingEngine
+    from autodist_tpu.simulator.cost_model import CostModel
+
+    on_accel = jax.default_backend() != "cpu"
+    rs = ResourceSpec({})
+    n = rs.num_devices()
+    if on_accel:
+        cfg = TransformerConfig(vocab_size=32768, hidden_size=1024,
+                                num_layers=4, num_heads=16,
+                                mlp_dim=4096, max_len=2048,
+                                dtype=jnp.bfloat16, dropout_rate=0.0,
+                                attention_dropout_rate=0.0)
+        slots, windows = 8, 10
+    else:  # CPU dev smoke: same code path, toy size (interpret mode)
+        cfg = TransformerConfig(vocab_size=128, hidden_size=32,
+                                num_layers=2, num_heads=2,
+                                mlp_dim=64, max_len=64,
+                                dtype=jnp.float32, dropout_rate=0.0,
+                                attention_dropout_rate=0.0)
+        slots, windows = 2, 2
+    telemetry.annotate(bench="flash_decode_speedup", devices=n,
+                       chip=rs.chip.name, kernel=["flash_decode"])
+    params = make_pipeline_lm_trainable(
+        cfg, optax.adam(1e-3), jax.random.PRNGKey(0)).params
+    r = np.random.RandomState(0)
+    prompt_len = min(16, cfg.max_len // 2)
+    prompts = r.randint(1, cfg.vocab_size, (slots, prompt_len)) \
+        .astype(np.int32)
+    p_lens = np.full((slots,), prompt_len, np.int32)
+
+    def timed(kernel):
+        engine = ServingEngine(cfg, params, num_slots=slots,
+                               max_len=cfg.max_len,
+                               prefill_len=prompt_len, decode_steps=8,
+                               kernel=kernel)
+        active = np.ones((slots,), bool)
+        engine.prefill(prompts, p_lens, active)
+        engine.decode(active)                    # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(windows):
+            toks = engine.decode(active)
+        float(np.asarray(toks)[0, 0])
+        return (time.perf_counter() - t0) / (windows
+                                             * engine.decode_steps)
+
+    dog.stage = f"flash bench composed decode ({n} dev)"
+    try:
+        dt_einsum = timed(None)
+        dog.stage = f"flash bench fused decode ({n} dev)"
+        dt_flash = timed(("flash_decode",))
+    except Exception as e:
+        dog.disarm()
+        if "UNAVAILABLE" in str(e) or "Connection" in str(e):
+            _unavailable_exit(f"transport: {e}")
+        print(json.dumps({
+            "metric": "flash_decode_speedup", "value": 0.0,
+            "unit": "ratio", "vs_baseline": 0.0,
+            "error": f"flash bench failed: {e}",
+            "provenance": _provenance()}))
+        sys.exit(4)
+    ratio = dt_einsum / dt_flash if dt_flash > 0 else 0.0
+    cm = CostModel(rs)
+    kp = cm.kernel_profile
+    trainable = make_pipeline_lm_trainable(
+        cfg, optax.adam(1e-3), jax.random.PRNGKey(0))
+    pred_flash = cm.decode_cost(trainable,
+                                {"kernel": ("flash_decode",)},
+                                batch_slots=slots, max_len=cfg.max_len)
+    pred_einsum = cm.decode_cost(trainable, {}, batch_slots=slots,
+                                 max_len=cfg.max_len)
+    record = {
+        "metric": "flash_decode_speedup",
+        "value": round(ratio, 4), "unit": "ratio",
+        "vs_baseline": round(ratio, 4), "devices": n,
+        "chip": rs.chip.name, "slots": slots,
+        "max_len": cfg.max_len, "windows": windows,
+        "token_ms_einsum": round(dt_einsum * 1e3, 4),
+        "token_ms_flash": round(dt_flash * 1e3, 4),
+        "predicted_crossover_len": kp["flash_decode_crossover_len"],
+        "predicted_speedup": round(
+            pred_einsum.attn_time_s
+            / max(pred_flash.attn_time_s, 1e-12), 4),
+        "measured_favors_flash": ratio > 1.0,
+        "predicted_favors_flash":
+            cfg.max_len >= kp["flash_decode_crossover_len"],
+        "scored": True, "provenance": _provenance(),
+    }
+    dog.disarm()
+    print(json.dumps(record), flush=True)
+    telemetry.gauge("bench/flash_decode_speedup").set(ratio)
     telemetry.flush()
 
 

@@ -254,7 +254,8 @@ def test_pipeline_strategy_serializes():
     assert strategy.graph_config.lowering == "pipeline"
     expected = {"num_microbatches": 2, "virtual_stages": 1,
                 "remat": False, "tensor_parallel": 1,
-                "comm_overlap": None, "vocab_parallel": False}
+                "comm_overlap": None, "vocab_parallel": False,
+                "zero_stage": 0}
     assert strategy.graph_config.parallel == expected
     clone = Strategy.from_json(strategy.to_json())
     assert clone.graph_config.parallel == expected

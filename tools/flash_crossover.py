@@ -8,6 +8,16 @@ the curve that goes into BASELINE.md and justifies (or bounds) when the
 bench self-tuner should pick the kernel.
 
 Usage: ``python tools/flash_crossover.py [--seqs 512,1024,2048,4096]``
+
+``--decode`` switches to the serving-side crossover: single-query-per-
+slot shapes (one token attending over a KV cache of each ``--seqs``
+length) at ``--fill`` slot-length fractions, comparing the composed
+einsum cache attention (``serving/kv_cache.cached_attention``) against
+the Pallas flash-decode kernel.  Each point prints one provenance-
+stamped record in the bench schema, and ``--write-calibration`` merges
+the measured crossover into calibration.json's ``"kernel"`` section
+(``flash_decode_crossover_len`` / ``flash_decode_speedup``) — the
+constants ``CostModel.decode_cost`` elects the kernel by.
 """
 import argparse
 import json
@@ -77,7 +87,26 @@ def main():
                          "(per-length best blocks + crossover_len; the "
                          "kernel's default blocks and the flash_wins() "
                          "helper read it — commit it at the repo root)")
+    ap.add_argument("--decode", action="store_true",
+                    help="measure the serving-side crossover instead: "
+                         "single-query flash-decode vs the composed "
+                         "einsum cache attention over --seqs cache "
+                         "lengths")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="--decode: batch slots per step")
+    ap.add_argument("--fill", default="1.0,0.5",
+                    help="--decode: slot-length fractions of the cache "
+                         "length (the occupancy distribution decode "
+                         "actually sees)")
+    ap.add_argument("--write-calibration", default="",
+                    metavar="PATH",
+                    help="--decode: merge the measured crossover into "
+                         "this calibration.json's 'kernel' section "
+                         "(flash_decode_crossover_len / "
+                         "flash_decode_speedup)")
     args = ap.parse_args()
+    if args.decode:
+        return _main_decode(args)
 
     H, D = args.heads, args.head_dim
     causal = bool(args.causal)
@@ -142,6 +171,103 @@ def main():
     }))
     if args.write and not wrote:
         print("# no successful flash timing; tuning table unchanged",
+              file=sys.stderr)
+
+
+def _main_decode(args):
+    """The ``--decode`` mode: one record per (cache length, fill)
+    point, bench-schema-shaped and provenance-stamped; the summary line
+    derives the crossover, and ``--write-calibration`` commits it."""
+    from autodist_tpu.serving.kv_cache import cached_attention
+    from autodist_tpu.kernel.pallas.flash_decode import \
+        flash_decode_attention
+    from autodist_tpu.telemetry.records import provenance
+
+    H, D, B = args.heads, args.head_dim, args.slots
+    fills = [float(f) for f in args.fill.split(",")]
+    records = []
+    for T in [int(s) for s in args.seqs.split(",")]:
+        r = np.random.RandomState(0)
+        q = jnp.asarray(r.randn(B, 1, H, D), jnp.bfloat16)
+        k = jnp.asarray(r.randn(B, H, T, D), jnp.bfloat16)
+        v = jnp.asarray(r.randn(B, H, T, D), jnp.bfloat16)
+        for fill in fills:
+            lengths = jnp.full((B,), max(int(T * fill) - 1, 0),
+                               jnp.int32)
+            t_einsum = timed(jax.jit(
+                lambda q, k, v, l: cached_attention(
+                    q, k, v, l, dtype=jnp.bfloat16)),
+                (q, k, v, lengths), args.steps)
+            try:
+                t_flash = timed(jax.jit(
+                    lambda q, k, v, l: flash_decode_attention(
+                        q, k, v, l, dtype=jnp.bfloat16)),
+                    (q, k, v, lengths), args.steps)
+            except Exception as e:
+                print(f"# flash decode T={T} fill={fill} failed: {e}",
+                      file=sys.stderr)
+                continue
+            rec = {
+                "metric": "flash_decode_crossover",
+                "kv_len": T, "fill": fill, "slots": B, "heads": H,
+                "head_dim": D,
+                "einsum_ms": round(t_einsum * 1e3, 4),
+                "flash_ms": round(t_flash * 1e3, 4),
+                "value": round(t_einsum / t_flash, 4),
+                "unit": "ratio", "scored": True,
+                "provenance": provenance(),
+            }
+            records.append(rec)
+            print(json.dumps(rec), flush=True)
+    wins = sorted({r["kv_len"] for r in records if r["value"] > 1.0})
+    crossover = wins[0] if wins else None
+    speedups = [r["value"] for r in records
+                if crossover is not None and r["kv_len"] >= crossover]
+    summary = {
+        "summary": (f"flash decode wins from kv_len {crossover}"
+                    if crossover is not None
+                    else "einsum wins at every measured cache length"),
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(summary))
+    if args.write_calibration and records:
+        if jax.default_backend() == "cpu":
+            # Interpreter timings say nothing about the TPU kernel and
+            # would mislead every chip's planning (load_calibration has
+            # no per-section provenance to filter them back out).
+            print("# refusing to write CPU-measured kernel constants "
+                  f"into {args.write_calibration}", file=sys.stderr)
+            return
+        table = {}
+        if os.path.exists(args.write_calibration):
+            try:
+                with open(args.write_calibration) as f:
+                    table = json.load(f)
+            except (OSError, ValueError):
+                table = {}
+        kern = dict(table.get("kernel", {}))
+        if crossover is not None:
+            kern["flash_decode_crossover_len"] = crossover
+            kern["flash_decode_speedup"] = round(
+                sum(speedups) / len(speedups), 3)
+        else:
+            # Flash never won: push the crossover past every measured
+            # length so the cost model stops electing it in this range.
+            kern["flash_decode_crossover_len"] = 2 * max(
+                r["kv_len"] for r in records)
+        table["kernel"] = kern
+        meta = dict(table.get("meta", {}))
+        meta["kernel_source"] = (
+            f"tools/flash_crossover.py --decode on "
+            f"{jax.devices()[0].device_kind} "
+            f"({provenance().get('git_sha', '')[:12]})")
+        table["meta"] = meta
+        tmp = args.write_calibration + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(table, f, indent=1)
+        os.replace(tmp, args.write_calibration)
+        print(f"# wrote kernel section to {args.write_calibration}",
               file=sys.stderr)
 
 

@@ -133,7 +133,8 @@ def _train_program_text(strategy, spec, trainable, batch) -> str:
 
 
 def lint_zoo(max_programs=None, plan_only=False, decode=True,
-             reshard=True, out=print) -> tuple[int, int, list]:
+             reshard=True, kernel=True, out=print) -> tuple[int, int,
+                                                            list]:
     """Sweep the zoo; returns ``(n_errors, n_warnings, results)``."""
     from autodist_tpu.analysis import (lint_plan, lint_program,
                                        rules_for_decode,
@@ -236,6 +237,84 @@ def lint_zoo(max_programs=None, plan_only=False, decode=True,
             out(f"{name}: program {len(prog.errors)}E/"
                 f"{len(prog.warnings)}W (gather budget "
                 f"{programs.reshard_budget()} elems)")
+            results.append({"candidate": name,
+                            "program": [d.to_dict() for d in prog],
+                            "program_rules": [r.name for r in rules]})
+
+    if kernel and not plan_only:
+        # The Pallas kernel tier: every kernel-elected program (plan
+        # lint + lower/compile + the ADT120 fused_kernel_replaced proof
+        # that the elected kernel actually replaced the composed ops).
+        from autodist_tpu.analysis import rules_for_strategy as _rfs
+        from autodist_tpu.strategy.parallel_builders import Pipeline
+
+        kernel_cases = [
+            ("kernel/quant_ring",
+             dict(tensor_parallel=2,
+                  collective_precision={"tp_psum": "int8"},
+                  kernel=("quant_ring",)),
+             dict(collective_precision=(("tp_psum", "int8"),),
+                  kernel=("quant_ring",))),
+            ("kernel/collective_matmul",
+             dict(tensor_parallel=2, comm_overlap="matmul",
+                  kernel=("collective_matmul",)),
+             dict(comm_overlap="matmul",
+                  kernel=("collective_matmul",))),
+        ]
+        fixtures = {f[0]: f for f in _zoo_fixtures()}
+        _, lm_trainable, lm_spec, lm_batch = fixtures["pipeline_lm"]
+        for name, bkw, pkw in kernel_cases:
+            if max_programs is not None and compiled >= max_programs:
+                out(f"{name}: SKIPPED (--max-programs budget)")
+                results.append({"candidate": name,
+                                "program": "skipped (--max-programs "
+                                           "budget)"})
+                continue
+            compiled += 1
+            strategy = Pipeline(num_microbatches=2, **bkw).build(
+                lm_trainable, lm_spec)
+            plan = lint_plan(strategy, resource_spec=lm_spec,
+                             trainable=lm_trainable)
+            n_err += len(plan.errors)
+            n_warn += len(plan.warnings)
+            # Default (vocab-32) geometry: shares the compile cache
+            # with the mutation matrix's kernel-elected programs —
+            # these plans are not vocab-parallel, so no rule needs the
+            # distinctive vocab extent.
+            text = programs.pipeline_step_text(2, **pkw)
+            rules = _rfs(strategy)
+            prog = lint_program(text, rules, where=name)
+            n_err += len(prog.errors)
+            n_warn += len(prog.warnings)
+            out(f"{name}: plan {len(plan.errors)}E/"
+                f"{len(plan.warnings)}W, program {len(prog.errors)}E"
+                f" ({len(rules)} rules)")
+            results.append({"candidate": name,
+                            "plan": [d.to_dict() for d in plan],
+                            "program": [d.to_dict() for d in prog],
+                            "program_rules": [r.name for r in rules]})
+        name = "kernel/flash_decode"
+        if max_programs is not None and compiled >= max_programs:
+            out(f"{name}: SKIPPED (--max-programs budget)")
+            results.append({"candidate": name,
+                            "program": "skipped (--max-programs "
+                                       "budget)"})
+        else:
+            compiled += 1
+            text = programs.decode_step_text(1, False,
+                                             kernel=("flash_decode",))
+            rules = rules_for_decode(
+                1, False, vocab_size=programs.DEC_V,
+                max_len=programs.DEC_T,
+                num_layers=programs.DEC_LAYERS,
+                num_slots=programs.DEC_SLOTS, heads_local=2,
+                head_dim=programs.DEC_HEAD_DIM,
+                kernel=("flash_decode",))
+            prog = lint_program(text, rules, where=name)
+            n_err += len(prog.errors)
+            n_warn += len(prog.warnings)
+            out(f"{name}: program {len(prog.errors)}E/"
+                f"{len(prog.warnings)}W ({len(rules)} rules)")
             results.append({"candidate": name,
                             "program": [d.to_dict() for d in prog],
                             "program_rules": [r.name for r in rules]})
@@ -387,6 +466,8 @@ def main(argv=None) -> int:
                     help="skip the decode-window programs")
     ap.add_argument("--no-reshard", action="store_true",
                     help="skip the elastic reshard program")
+    ap.add_argument("--no-kernel", action="store_true",
+                    help="skip the Pallas kernel-elected programs")
     ap.add_argument("--max-programs", type=int, default=None,
                     metavar="N",
                     help="compile at most N programs (CI budget "
@@ -411,7 +492,7 @@ def main(argv=None) -> int:
         zoo_err, zoo_warn, report["zoo"] = lint_zoo(
             max_programs=args.max_programs, plan_only=args.plan_only,
             decode=not args.no_decode, reshard=not args.no_reshard,
-            out=out)
+            kernel=not args.no_kernel, out=out)
         n_err += zoo_err
         print(f"zoo sweep: {zoo_err} error(s), {zoo_warn} warning(s) "
               f"across {len(report['zoo'])} candidate(s)")

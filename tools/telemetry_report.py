@@ -27,6 +27,12 @@ _STEP_KEYS = {"kind", "step", "duration_ms"}
 # whose metrics lack the gauges means a lowering silently dropped the
 # policy — a schema break, caught by --check in CI.
 _PRECISION_BITS = {"fp32": 32, "bf16": 16, "int8": 8}
+# Fused-kernel election gauges (the Strategy IR kernel slot): the
+# lowering that honors an election emits `kernel/<name>_elected` = 1
+# (the pipeline lowering for the training kernels, the serving engine
+# for flash_decode); a manifest run.kernel annotation without its gauge
+# means the election was silently dropped — --check fails it.
+_KERNEL_CHOICES = ("flash_decode", "quant_ring", "collective_matmul")
 # Per-request serving records (autodist_tpu/serving/batcher.py): the
 # latency facts the serving section aggregates.
 _SERVE_KEYS = {"kind", "request", "tokens", "ttft_ms", "tokens_per_sec"}
@@ -170,6 +176,20 @@ def check_schema(run_dir: str) -> list[str]:
             problems.append(
                 f"metrics.jsonl: {name} = {rec.get('value')!r} is not a "
                 f"wire width in {sorted(_PRECISION_BITS.values())}")
+        # Fused-kernel election gauges: the name must be a registered
+        # kernel and an elected gauge is always 1 (a lowering either
+        # honored the election or emitted nothing).
+        if isinstance(name, str) and name.startswith("kernel/") \
+                and name.endswith("_elected"):
+            kname = name[len("kernel/"):-len("_elected")]
+            if kname not in _KERNEL_CHOICES:
+                problems.append(
+                    f"metrics.jsonl: {name} names an unregistered "
+                    f"kernel (have {sorted(_KERNEL_CHOICES)})")
+            elif rec.get("value") != 1:
+                problems.append(
+                    f"metrics.jsonl: {name} = {rec.get('value')!r} — an "
+                    "elected-kernel gauge must be 1")
 
     manifest = os.path.join(run_dir, "manifest.json")
     if os.path.exists(manifest):
@@ -199,6 +219,33 @@ def check_schema(run_dir: str) -> list[str]:
                             f"{gname} = {rec.get('value')!r} disagrees "
                             f"with the declared {boundary}={prec} "
                             f"({_PRECISION_BITS.get(prec)} bits)")
+            declared_kernel = (m.get("run") or {}).get("kernel")
+            if declared_kernel:
+                # A run annotated with a fused-kernel election must
+                # carry the kernel/<name>_elected gauge the lowering
+                # (or serving engine) emits — absence means the
+                # election was silently dropped between plan and
+                # program.
+                names = (declared_kernel if isinstance(
+                    declared_kernel, (list, tuple))
+                    else [k for k, v in declared_kernel.items() if v]
+                    if isinstance(declared_kernel, dict)
+                    else str(declared_kernel).split(","))
+                for kname in names:
+                    kname = str(kname).strip()
+                    if not kname:
+                        continue
+                    gname = f"kernel/{kname}_elected"
+                    rec = gauges.get(gname)
+                    if rec is None:
+                        problems.append(
+                            f"manifest run.kernel declares {kname!r} "
+                            f"but metrics.jsonl has no {gname} gauge — "
+                            "the lowering dropped the election")
+                    elif rec.get("value") != 1:
+                        problems.append(
+                            f"{gname} = {rec.get('value')!r} disagrees "
+                            f"with the declared kernel election")
         except ValueError as e:
             problems.append(f"manifest.json: invalid ({e})")
 
