@@ -108,6 +108,8 @@ CODES: dict[str, tuple[str, str]] = {
     "ADT113": (ERROR, "single-replica program carries cross-device "
                       "collectives"),
     "ADT114": (ERROR, "expected model-axis collectives are missing"),
+    "ADT115": (ERROR, "paged decode carries a dense cache reservation "
+                      "(or reads K/V without the block table)"),
     "ADT120": (ERROR, "elected fused kernel missing from the compiled "
                       "program (the composed op soup survived)"),
     # --- source lint (repo AST) -------------------------------------- #

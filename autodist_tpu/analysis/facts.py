@@ -74,6 +74,16 @@ _BARRIER_RE = re.compile(r"\b(?:opt-barrier|optimization-barrier)(?:\.\d+)?\(")
 # the kernel's ops exist in the optimized program (the ADT120 rule).
 _KERNEL_MARKER_RE = re.compile(r"adtk_([a-z0-9_]+)")
 
+# Plain `gather` ops with their first-operand shape (the paged-KV
+# block-table rule scans for gathers whose OPERAND carries the block
+# pool's distinctive extent — the structural evidence the decode reads
+# K/V through the table).  The negative lookbehind keeps `all-gather(`
+# (a collective, counted above) out.
+_GATHER_RE = re.compile(
+    r"(?<![\w-])gather\(\s*"
+    r"(?:pred|s4|u4|s8|u8|s16|u16|s32|u32|s64|u64|"
+    r"f8\w*|bf16|f16|f32|f64|c64|c128)\[([0-9,]*)\]")
+
 
 def collective_counts(hlo_text: str) -> dict[str, int]:
     """Count collective ops by kind in optimized HLO text."""
@@ -184,6 +194,20 @@ def large_copies_with_dim(hlo_text: str, dim: int, min_volume: int) -> int:
     return hits
 
 
+def gathers_with_operand_dim(hlo_text: str, dim: int) -> int:
+    """Count plain ``gather`` ops whose first operand's shape carries
+    ``dim`` — with a dim chosen distinctive (the paged block pool's
+    ``num_blocks`` extent), a hit IS a block-table gather over the KV
+    pool, and zero hits proves the program never reads the cache
+    through the table."""
+    hits = 0
+    for m in _GATHER_RE.finditer(hlo_text):
+        dims = [int(d) for d in m.group(1).split(",") if d]
+        if dim in dims:
+            hits += 1
+    return hits
+
+
 def host_transfers(hlo_text: str) -> int:
     """Count host boundary crossings (send/recv/infeed/outfeed and
     host-offloading custom-calls; ``-start``/``-done`` pairs count per
@@ -277,6 +301,22 @@ class ProgramFacts:
 
     def large_copies_with_dim(self, dim: int, min_volume: int) -> int:
         return large_copies_with_dim(self.text, dim, min_volume)
+
+    def buffers_with_dims(self, dims) -> int:
+        """Array shapes carrying ALL of ``dims`` at once — e.g. the
+        dense KV cache's ``[.., slots, .., max_len, ..]`` lane shape at
+        two distinctive extents, which a paged program must never
+        build."""
+        dims = list(dims)
+        hits = 0
+        for m in _SHAPE_RE.finditer(self.text):
+            got = [int(d) for d in m.group(1).split(",") if d]
+            if all(d in got for d in dims):
+                hits += 1
+        return hits
+
+    def gathers_with_operand_dim(self, dim: int) -> int:
+        return gathers_with_operand_dim(self.text, dim)
 
     def boundary_buffers_with_dim(self, dim: int) -> int:
         """Step-boundary (ENTRY signature) buffers carrying ``dim``."""

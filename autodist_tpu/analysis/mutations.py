@@ -705,6 +705,23 @@ def _program_mutations() -> list[ProgramMutation]:
                                              tp=2)],
             lambda t: P.pipeline_step_text(2, comm_overlap="matmul")),
         ProgramMutation(
+            "paged_decode_densified", "ADT115",
+            "a paged-elected decode compiles the dense [slots x "
+            "max_len] reservation anyway (the program a dropped "
+            "kv_layout knob compiles to)",
+            lambda: P.decode_step_text(1, False, kv_layout="paged"),
+            lambda: [R.paged_cache(P.DEC_SLOTS, T,
+                                   pool_blocks=P.DEC_POOL_BLOCKS)],
+            lambda t: P.decode_step_text(1, False)),
+        ProgramMutation(
+            "paged_table_gather_dropped", "ADT115",
+            "the block-table gather over the KV pool goes missing "
+            "(dense addressing surviving inside a paged program)",
+            lambda: P.decode_step_text(1, False, kv_layout="paged"),
+            lambda: [R.paged_cache(P.DEC_SLOTS, T,
+                                   pool_blocks=P.DEC_POOL_BLOCKS)],
+            lambda t: t.replace(" gather(", " splat(")),
+        ProgramMutation(
             "flash_decode_kernel_dropped", "ADT120",
             "the flash-decode cache kernel goes missing (the composed "
             "einsum decode program a dropped kernel slot compiles to)",

@@ -329,6 +329,49 @@ def fused_kernel_replaced(kernels, tp: int = 2) -> Rule:
                     "dispatch) so the Pallas call site is reached")
 
 
+def paged_cache(num_slots: int, max_len: int,
+                pool_blocks: Optional[int] = None) -> Rule:
+    """ADT115: the paged decode program actually dropped the dense
+    reservation.  Two halves of the evidence:
+
+    * ZERO buffers shaped with BOTH the slot count and the ``max_len``
+      extent (the dense cache's ``[L, slots, heads, max_len, dh]`` lane
+      signature at two distinctive dims) — a hit means the paged
+      election compiled the dense layout anyway;
+    * ``pool_blocks`` given (the composed, non-flash path): >= 1
+      ``gather`` whose operand carries the pool's distinctive
+      ``num_blocks`` extent — the block-table read.  The paged *flash*
+      program streams blocks inside the Pallas kernel (no HLO gather
+      exists to scan), so its table evidence is the ADT120
+      ``adtk_flash_decode`` marker instead and ``pool_blocks`` stays
+      ``None``.
+    """
+    def check(f: ProgramFacts):
+        out = []
+        lanes = f.buffers_with_dims((num_slots, max_len))
+        if lanes:
+            out.append(
+                f"{lanes} dense [{num_slots} x .. x {max_len}]-shaped "
+                "cache buffer(s) in a paged decode program — the "
+                "kv_layout election compiled the dense per-slot "
+                "reservation anyway")
+        if pool_blocks is not None:
+            got = f.gathers_with_operand_dim(pool_blocks)
+            if got < 1:
+                out.append(
+                    f"no gather over the [{pool_blocks}, ...] block "
+                    "pool — the decode reads K/V without the block "
+                    "table (dense addressing survived)")
+        return out
+
+    return Rule("ADT115", "paged_cache",
+                "a paged decode carries no dense cache lane and reads "
+                "K/V through the block table", check,
+                fix="thread kv_layout='paged' through the engine so "
+                    "writes/reads route through PagedKVCache and the "
+                    "block table")
+
+
 def min_extra_all_reduces(baseline: int, n: int, label: str) -> Rule:
     def check(f: ProgramFacts):
         extra = f.counts.get("all-reduce", 0) - baseline
@@ -443,9 +486,12 @@ def rules_for_reshard(max_shard_elems: int) -> list[Rule]:
 def rules_for_decode(tensor_parallel: int, vocab_parallel: bool, *,
                      vocab_size: int, max_len: int, num_layers: int,
                      num_slots: int, heads_local: int,
-                     head_dim: int, kernel=()) -> list[Rule]:
+                     head_dim: int, kernel=(),
+                     kv_layout: str = "dense",
+                     pool_blocks: Optional[int] = None) -> list[Rule]:
     """The structural contract of a serving decode window, derived from
-    its (tp, vocab_parallel, kernel) config and cache geometry."""
+    its (tp, vocab_parallel, kernel, kv_layout) config and cache
+    geometry."""
     kernel = tuple(kernel)
     rules = [
         no_host_transfer(),
@@ -454,7 +500,17 @@ def rules_for_decode(tensor_parallel: int, vocab_parallel: bool, *,
         no_score_square(max_len),
         min_dus(2 * num_layers),
     ]
-    if "flash_decode" not in kernel:
+    if kv_layout == "paged":
+        # The paged contract: no dense [slots x max_len] reservation
+        # anywhere, and (composed path) the block-table gather over the
+        # pool's distinctive extent.  The flash-elected program's table
+        # walk lives inside the Pallas kernel — ADT120 carries its
+        # evidence — so the gather half is skipped there.
+        rules.append(paged_cache(
+            num_slots, max_len,
+            pool_blocks=None if "flash_decode" in kernel
+            else pool_blocks))
+    elif "flash_decode" not in kernel:
         # The composed einsum path's no-cache-lane-copy guard.  The
         # flash-elected program is exempt ON CPU ONLY: the Pallas
         # *interpreter* materializes each grid step's operand blocks as

@@ -334,17 +334,24 @@ def reshard_step_text(naive: bool = False) -> str:
 # --------------------------------------------------------------------------- #
 # Decode-probe geometry: T (cache max_len) and V (vocab) are chosen
 # distinctive — no other tensor dimension equals either, so a shape scan
-# hit IS the buffer the claim forbids.
+# hit IS the buffer the claim forbids.  The paged pool adds two more
+# distinctive extents: DEC_BLOCK_LEN deliberately does NOT divide DEC_T
+# (the padded 4·16 = 64 lane the composed gather assembles must differ
+# from the 57 extent the ADT115 dense-lane scan keys on), and
+# DEC_POOL_BLOCKS (13) is the gather-operand extent no other dimension
+# equals.
 DEC_T = 57
 DEC_V = 93
 DEC_LAYERS = 2
 DEC_SLOTS = 3
 DEC_HEAD_DIM = 8
+DEC_BLOCK_LEN = 16
+DEC_POOL_BLOCKS = 13
 
 
 @functools.lru_cache(maxsize=None)
 def decode_step_text(tensor_parallel: int, vocab_parallel: bool,
-                     kernel=None) -> str:
+                     kernel=None, kv_layout: str = "dense") -> str:
     """Optimized HLO of one fused-decode dispatch of the serving
     engine (memoized like the pipeline texts)."""
     import jax
@@ -364,5 +371,8 @@ def decode_step_text(tensor_parallel: int, vocab_parallel: bool,
     engine = ServingEngine(cfg, params, tensor_parallel=tensor_parallel,
                            vocab_parallel=vocab_parallel, kernel=kernel,
                            num_slots=DEC_SLOTS, max_len=DEC_T,
-                           prefill_len=8, decode_steps=4)
+                           prefill_len=8, decode_steps=4,
+                           kv_layout=kv_layout,
+                           kv_block_len=DEC_BLOCK_LEN,
+                           kv_num_blocks=DEC_POOL_BLOCKS)
     return engine.compiled_decode_text()

@@ -140,3 +140,124 @@ def flash_decode_attention(q, k_layer, v_layer, lengths, *,
             interpret=interp,
         )(len2d, q2, k_layer, v_layer)
     return jnp.swapaxes(out, 1, 2)             # [B, 1, H, d]
+
+
+# --------------------------------------------------------------------------- #
+# Paged variant: the block loop IS the page loop
+# --------------------------------------------------------------------------- #
+def _paged_decode_kernel(len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, s_ref, acc_ref, *, block_len: int,
+                         scale: float, out_dtype):
+    """One (slot, head, logical-block) program over a *paged* cache.
+
+    The page walk lives in the GRID, not in the kernel body: the grid's
+    innermost dimension is the slot's logical block index ``j``, and
+    the k/v BlockSpecs' index maps read the scalar-prefetched block
+    table (``tab_ref[b, j]``) to pick WHICH pool block this step's
+    ``[block_len, d]`` VMEM tile stages — so Pallas's own pipeline
+    double-buffers the per-block DMA and the VMEM working set is one
+    block per operand, independent of pool size.  The online-softmax
+    carry (running max / sum / accumulator) persists across the ``j``
+    steps in VMEM scratch: initialized at ``j == 0``, emitted at the
+    last block — the dense kernel's fori_loop recurrence, unrolled
+    into the grid.  The tail block (and any unassigned table entry,
+    which holds 0 and may alias another slot's block) is hidden by the
+    ``idx <= length`` mask exactly like the dense kernel's zero-pad."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    d = q_ref.shape[-1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        s_ref[...] = jnp.zeros_like(s_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[b, 0]
+    q = q_ref[...].reshape(1, d).astype(jnp.float32)
+    kblk = k_ref[...].reshape(block_len, d).astype(jnp.float32)
+    scores = jax.lax.dot_general(
+        q, kblk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale        # [1, bl]
+    idx = j * block_len + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_len), 1)
+    scores = jnp.where(idx <= length, scores, NEG_INF)
+    m, s, acc = m_ref[...], s_ref[...], acc_ref[...]
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new)                            # [1, bl]
+    vblk = v_ref[...].reshape(block_len, d).astype(jnp.float32)
+    m_ref[...] = m_new
+    s_ref[...] = s * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc * alpha + jax.lax.dot_general(
+        p, vblk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                # [1, d]
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _emit():
+        # Position 0 is always visible (length >= 0), so s > 0.
+        o_ref[...] = (acc_ref[...] / s_ref[...]) \
+            .reshape(o_ref.shape).astype(out_dtype)
+
+
+def flash_decode_attention_paged(q, k_pool, v_pool, lengths, block_table,
+                                 *, block_len: int, dtype=jnp.float32,
+                                 interpret: Optional[bool] = None):
+    """Drop-in fused replacement for :func:`autodist_tpu.serving.
+    kv_cache.paged_cached_attention` — the paged-cache flash decode.
+
+    ``q``: ``[B, 1, heads, head_dim]``; ``k_pool``/``v_pool``: one
+    layer's ``[num_blocks, heads, block_len, head_dim]`` pool slice;
+    ``lengths``: ``[B]`` int32; ``block_table``: ``[B, max_blocks]``
+    int32.  Returns ``[B, 1, heads, head_dim]`` in ``dtype``.
+
+    Unlike the composed path there is NO gather/materialization of a
+    contiguous ``[B, heads, max_blocks·block_len, head_dim]`` lane, and
+    the pool itself never stages into VMEM whole: the block table rides
+    ``PrefetchScalarGridSpec`` so each (slot, head, logical-block) grid
+    step's BlockSpec index map routes ONE ``[block_len, d]`` pool block
+    into VMEM (double-buffered by the Pallas pipeline — the per-block
+    DMA the paged layout promises), the scores never exist outside a
+    ``[1, block_len]`` tile, and the VMEM working set is independent of
+    ``num_blocks``.
+    """
+    B, _, H, d = q.shape
+    mb = block_table.shape[1]
+    interp = default_interpret() if interpret is None else bool(interpret)
+    scale = 1.0 / float(np.sqrt(d))
+
+    q2 = jnp.swapaxes(q, 1, 2)                 # [B, H, 1, d]
+    len2d = lengths.astype(jnp.int32).reshape(B, 1)
+    tab = block_table.astype(jnp.int32)
+
+    import functools
+
+    kern = functools.partial(_paged_decode_kernel, block_len=block_len,
+                             scale=scale, out_dtype=dtype)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                 # len2d, tab (SMEM)
+        grid=(B, H, mb),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, d),
+                         lambda b, h, j, lens, t: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_len, d),
+                         lambda b, h, j, lens, t: (t[b, j], h, 0, 0)),
+            pl.BlockSpec((1, 1, block_len, d),
+                         lambda b, h, j, lens, t: (t[b, j], h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, d),
+                               lambda b, h, j, lens, t: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 1), jnp.float32),   # running max
+            pltpu.VMEM((1, 1), jnp.float32),   # running sum
+            pltpu.VMEM((1, d), jnp.float32),   # accumulator
+        ],
+    )
+    with jax.named_scope(kernel_marker("flash_decode")):
+        out = pl.pallas_call(
+            kern,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, H, 1, d), dtype),
+            interpret=interp,
+        )(len2d, tab, q2, k_pool, v_pool)
+    return jnp.swapaxes(out, 1, 2)             # [B, 1, H, d]

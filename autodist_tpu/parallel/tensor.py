@@ -625,12 +625,89 @@ def vocab_parallel_greedy_token(x, embedding, *, vocab_size: int,
     start = 0 if model_axis is None else lax.axis_index(model_axis) * rows
     valid = (start + jnp.arange(rows)) < vocab_size
     logits = jnp.where(valid, logits, jnp.finfo(jnp.float32).min)
-    m_loc = jnp.max(logits, axis=-1)
+    return _resolve_global_argmax(logits, start, vocab_size, model_axis)
+
+
+def _resolve_global_argmax(scores, start, vocab_size: int, model_axis):
+    """The shard-invariant argmax election the greedy AND sampling
+    epilogues share: each shard proposes its local argmax's global id,
+    a ``pmax`` finds the global max score, losers propose
+    ``vocab_size`` and a ``pmin`` keeps the smallest winning id (the
+    tie-break :func:`vocab_parallel_cross_entropy`'s ``pred`` also
+    uses).  ONE copy so the ``temperature=0 == greedy`` and
+    ``top_k=1 == greedy`` parity contracts are structural, not
+    coincidental.  Returns ``(token [B] int32, max score [B] f32)``."""
+    m_loc = jnp.max(scores, axis=-1)
     m = m_loc if model_axis is None else lax.pmax(m_loc, model_axis)
-    am = (start + jnp.argmax(logits, axis=-1)).astype(jnp.int32)
+    am = (start + jnp.argmax(scores, axis=-1)).astype(jnp.int32)
     cand = jnp.where(m_loc >= m, am, jnp.int32(vocab_size))
     tok = cand if model_axis is None else lax.pmin(cand, model_axis)
     return tok, m
+
+
+def _rowwise_gumbel(seed, position, row_ids):
+    """Gumbel noise per *global* vocab row for one slot, deterministic
+    in ``(seed, position, row_id)`` alone — each shard folds its own
+    global row ids, so the draw is **shard-invariant**: the same
+    virtual ``[V]`` gumbel vector materializes only as each shard's
+    ``[rows_local]`` slice (never a full-vocab buffer), and tp=1,
+    tp=2, and the sequential reference all see identical noise."""
+    base = jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(0), seed), position)
+    keys = jax.vmap(lambda r: jax.random.fold_in(base, r))(row_ids)
+    u = jax.vmap(lambda k: jax.random.uniform(
+        k, (), jnp.float32, minval=1e-7, maxval=1.0))(keys)
+    return -jnp.log(-jnp.log(u))
+
+
+def vocab_parallel_sample_token(x, embedding, *, vocab_size: int,
+                                seeds, positions, temperature: float,
+                                top_k: int = 0, model_axis=None):
+    """Temperature/top-k sampling from *last-position* hidden states —
+    the sampling rung of :func:`vocab_parallel_greedy_token`, same
+    ``[B, V/tp]``-bounded live logits.
+
+    Sampling is the **Gumbel-max trick**: ``argmax(logits/T + g)``
+    where ``g`` is per-(slot, position, global-row) gumbel noise from
+    :func:`_rowwise_gumbel`.  Because the noise is keyed by the global
+    row id (not the shard), the perturbed scores agree across any tp
+    sharding and the argmax resolves through the exact pmax/pmin
+    machinery of the greedy path — so a sampled stream keeps the
+    interleave-parity contract: interleaved == run-alone == the
+    sequential reference at the same per-slot ``(seed, position)``
+    keys.
+
+    ``seeds``/``positions``: ``[B]`` int32 (the request's sampling seed
+    and the emitted token's context length — the fold keys).
+    ``top_k > 0`` restricts sampling to the global top-k rows: each
+    shard proposes its local top-k, an ``all_gather`` of the ``k·tp``
+    candidate *values* (scalars, never rows) finds the global
+    threshold.  ``temperature`` must be > 0 — the engine routes
+    ``temperature == 0`` to the greedy path so it stays bit-identical.
+    """
+    if temperature <= 0.0:
+        raise ValueError("temperature must be > 0 (temperature == 0 is "
+                         "the greedy path)")
+    rows = embedding.shape[0]
+    logits = jnp.tensordot(x.astype(jnp.float32),
+                           embedding.astype(jnp.float32).T, axes=1)
+    start = 0 if model_axis is None else lax.axis_index(model_axis) * rows
+    valid = (start + jnp.arange(rows)) < vocab_size
+    neg = jnp.finfo(jnp.float32).min
+    logits = jnp.where(valid, logits, neg)
+    if top_k and top_k > 0:
+        k = min(int(top_k), vocab_size)
+        loc = lax.top_k(logits, min(k, rows))[0]         # [B, k_loc]
+        if model_axis is not None:
+            loc = lax.all_gather(loc, model_axis, axis=1,
+                                 tiled=True)             # [B, k_loc*tp]
+        kth = lax.top_k(loc, k)[0][:, -1]                # [B]
+        logits = jnp.where(logits >= kth[:, None], logits, neg)
+    row_ids = start + jnp.arange(rows, dtype=jnp.int32)
+    g = jax.vmap(_rowwise_gumbel, in_axes=(0, 0, None))(
+        seeds.astype(jnp.int32), positions.astype(jnp.int32), row_ids)
+    z = jnp.where(logits > neg, logits / temperature + g, neg)
+    return _resolve_global_argmax(z, start, vocab_size, model_axis)
 
 
 def column_parallel(x, kernel, bias=None, *, model_axis=None, axes: int = 1,

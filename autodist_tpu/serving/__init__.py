@@ -26,12 +26,17 @@ from autodist_tpu.serving.batcher import (FINISH_REASONS, Completion,
                                           ContinuousBatcher,
                                           OverloadedError, Request)
 from autodist_tpu.serving.engine import ServingEngine, serving_param_specs
-from autodist_tpu.serving.kv_cache import KVCache, init_cache
+from autodist_tpu.serving.kv_cache import (BlockAllocator, KVCache,
+                                           PagedKVCache,
+                                           PoolExhaustedError, init_cache,
+                                           init_paged_cache)
 
 __all__ = [
     "ServingEngine", "ContinuousBatcher", "Request", "Completion",
     "FINISH_REASONS", "OverloadedError",
     "KVCache", "init_cache", "serve", "serving_param_specs",
+    "PagedKVCache", "init_paged_cache", "BlockAllocator",
+    "PoolExhaustedError",
 ]
 
 

@@ -22,8 +22,11 @@ Per-token telemetry flows through the PR 4 sink: ``serve/ttft_ms`` and
 ``serve/inter_token_ms`` histograms (a fused window attributes
 ``window/K`` to each of its tokens), ``serve/queue_depth`` gauge,
 ``serve/requests``/``serve/tokens`` counters, and one ``kind="serve"``
-record per completed request (rendered by ``tools/telemetry_report.py``,
-schema-gated by its ``--check``).
+record per completed request — carrying the engine's ``kv_layout`` —
+(rendered by ``tools/telemetry_report.py``, schema-gated by its
+``--check``).  Paged engines additionally emit the
+``serve/kv_blocks_free``/``serve/kv_blocks_used`` pool gauges on every
+reservation/release; a paged run missing them fails the schema gate.
 """
 from __future__ import annotations
 
@@ -64,6 +67,12 @@ class Request:
     eos_id: Optional[int] = None
     submit_s: float = 0.0
     deadline_s: Optional[float] = None   # absolute (perf_counter) deadline
+    # Sampling seed (engines with temperature > 0): the per-request key
+    # the gumbel-max epilogue folds per emitted token, so a request
+    # decodes the same stream wherever/whenever it runs (the
+    # interleave-parity contract extended to sampling).  Ignored by
+    # greedy engines.
+    seed: int = 0
 
 
 @dataclasses.dataclass
@@ -115,7 +124,7 @@ class ContinuousBatcher:
     # ------------------------------------------------------------------ #
     def submit(self, prompt, *, max_new_tokens: int = 16,
                eos_id: Optional[int] = None, rid: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> str:
+               deadline_s: Optional[float] = None, seed: int = 0) -> str:
         """Queue one request; returns its id.  Prompts must fit the
         engine's prompt bucket; a budget exceeding the cache capacity
         is accepted but the request truncates at capacity
@@ -125,7 +134,10 @@ class ContinuousBatcher:
         latency: a request still queued — or still decoding — past its
         deadline completes with ``finish_reason="deadline_exceeded"``
         and whatever tokens it has (queued requests get none), instead
-        of silently burning slot time nobody is waiting for."""
+        of silently burning slot time nobody is waiting for.
+
+        ``seed`` keys this request's sampled stream on a
+        temperature > 0 engine (greedy engines ignore it)."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -155,7 +167,7 @@ class ContinuousBatcher:
             rid=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
             eos_id=eos_id, submit_s=now,
             deadline_s=now + deadline_s if deadline_s is not None
-            else None))
+            else None, seed=int(seed)))
         telemetry.gauge("serve/queue_depth").set(len(self._queue))
         return rid
 
@@ -198,7 +210,15 @@ class ContinuousBatcher:
                 slot.done = "deadline_exceeded"
 
     def _admit(self):
-        """Fill free slots from the queue with ONE batched prefill."""
+        """Fill free slots from the queue with ONE batched prefill.
+
+        Under the paged KV layout admission gates on **free blocks, not
+        slots**: a request enters only when its ``prompt + budget``
+        block reservation fits the free pool (FIFO, head-of-line — a
+        big request at the head waits rather than being jumped, so the
+        admission order, and with it the parity contract, stays
+        deterministic).  Dense engines keep the slots-only predicate
+        byte-identically (``blocks_needed`` is 0)."""
         self._expire_queued()
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free or not self._queue:
@@ -207,19 +227,31 @@ class ContinuousBatcher:
         prompts = np.zeros((B, S), np.int32)
         p_lens = np.ones((B,), np.int32)
         admit = np.zeros((B,), bool)
+        seeds = np.zeros((B,), np.int32)
         taken: list[tuple[int, Request]] = []
-        now = time.perf_counter()
         for i in free:
             if not self._queue:
                 break
+            head = self._queue[0]
+            needed = self.engine.blocks_needed(len(head.prompt),
+                                               head.max_new_tokens)
+            if needed > self.engine.free_blocks:
+                break   # pool-bound: the head request waits its turn
             req = self._queue.popleft()
+            self.engine.reserve_slot(i, len(req.prompt),
+                                     req.max_new_tokens)
             prompts[i, :len(req.prompt)] = req.prompt
             p_lens[i] = len(req.prompt)
             admit[i] = True
+            seeds[i] = req.seed
             taken.append((i, req))
         telemetry.gauge("serve/queue_depth").set(len(self._queue))
+        if not taken:
+            return
+        now = time.perf_counter()
         with telemetry.span("serve/prefill", admitted=len(taken)):
-            toks = self.engine.prefill(prompts, p_lens, admit)
+            toks = self.engine.prefill(prompts, p_lens, admit,
+                                       seeds=seeds)
         t_first = time.perf_counter()
         for i, req in taken:
             slot = _Slot(req=req, tokens=[int(toks[i])], admitted_s=now,
@@ -271,6 +303,7 @@ class ContinuousBatcher:
         telemetry.get().record_event(
             "serve", request=req.rid,
             prompt_tokens=len(req.prompt), tokens=len(comp.tokens),
+            kv_layout=getattr(self.engine, "kv_layout", "dense"),
             finish=comp.finish_reason,
             ttft_ms=comp.ttft_s * 1e3,
             queue_wait_ms=comp.queue_wait_s * 1e3,
@@ -286,6 +319,10 @@ class ContinuousBatcher:
         req = slot.req
         t_end = time.perf_counter()
         self._slots[i] = None
+        # Paged: the freed blocks go back on the free list immediately,
+        # so the next admission round can hand them to a queued request
+        # (the block-recycling edge the paged parity goldens pin).
+        self.engine.release_slot(i)
         self._finish(req, tokens=slot.tokens, reason=slot.done,
                      ttft_s=slot.first_tok_s - req.submit_s,
                      queue_wait_s=slot.admitted_s - req.submit_s,

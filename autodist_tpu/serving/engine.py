@@ -90,12 +90,16 @@ def seed_engine_kwargs(engine_kwargs: dict, strategy) -> dict:
     Strategy-IR serving knob cannot be seeded by one path and missed by
     another."""
     if strategy is not None:
+        from autodist_tpu.strategy.ir import normalize_kv_layout
+
         par = strategy.graph_config.parallel or {}
         engine_kwargs.setdefault(
             "tensor_parallel", int(par.get("tensor_parallel", 1) or 1))
         engine_kwargs.setdefault(
             "vocab_parallel", bool(par.get("vocab_parallel", False)))
         engine_kwargs.setdefault("comm_overlap", par.get("comm_overlap"))
+        engine_kwargs.setdefault(
+            "kv_layout", normalize_kv_layout(par.get("kv_layout")))
         kern = getattr(strategy.graph_config, "kernel", None)
         if kern:
             engine_kwargs.setdefault("kernel", dict(kern))
@@ -121,6 +125,21 @@ class ServingEngine:
     training ``Pipeline`` knobs; with ``tensor_parallel == 1`` the same
     code runs unsharded with zero collectives (the decode goldens'
     sequential-reference property).
+
+    ``kv_layout`` (Strategy-IR serving knob, ``"dense"``/``"paged"``):
+    ``"paged"`` replaces the per-slot ``max_len`` lanes with a block
+    pool of ``kv_num_blocks`` blocks of ``kv_block_len`` positions and
+    a per-slot block table — requests reserve only the blocks their
+    ``prompt + budget`` span needs (:meth:`blocks_needed` /
+    :meth:`reserve_slot` / :meth:`release_slot`), so the batcher admits
+    against free blocks, not slots, and ``num_slots`` may exceed what
+    the pool could hold at ``max_len``.
+
+    ``temperature``/``top_k`` (the sampling rung): ``temperature == 0``
+    (default) compiles the exact greedy program; ``> 0`` samples via
+    the shard-invariant gumbel-max epilogue keyed per (request seed,
+    context length) — see
+    :func:`~autodist_tpu.parallel.tensor.vocab_parallel_sample_token`.
     """
 
     def __init__(self, cfg, params, *, tensor_parallel: int = 1,
@@ -128,8 +147,13 @@ class ServingEngine:
                  kernel=None,
                  num_slots: int = 4, max_len: Optional[int] = None,
                  prefill_len: Optional[int] = None, decode_steps: int = 8,
+                 kv_layout: str = "dense",
+                 kv_block_len: Optional[int] = None,
+                 kv_num_blocks: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0,
                  devices=None):
-        from autodist_tpu.strategy.ir import normalize_kernel
+        from autodist_tpu.strategy.ir import (normalize_kernel,
+                                              normalize_kv_layout)
 
         self.cfg = cfg
         # The fused-kernel election (Strategy IR kernel slot): only
@@ -184,6 +208,34 @@ class ServingEngine:
         if self.prefill_len > self.max_len:
             raise ValueError("prefill_len must be <= max_len")
         self.decode_steps = int(decode_steps)
+        # ---- KV layout (Strategy-IR serving knob): dense per-slot
+        # lanes, or the block-paged pool + table --------------------------
+        self.kv_layout = normalize_kv_layout(kv_layout)
+        self.kv_block_len = int(kv_block_len or min(16, self.max_len))
+        if self.kv_block_len < 1:
+            raise ValueError("kv_block_len must be >= 1")
+        self.max_blocks = kv_cache.blocks_for(self.max_len,
+                                              self.kv_block_len)
+        # Default pool: byte parity with the dense cache (num_slots full
+        # lanes) — the capacity win comes from admitting MORE slots than
+        # the pool could hold at max_len, gated on free blocks.
+        self.kv_num_blocks = int(kv_num_blocks
+                                 or self.num_slots * self.max_blocks)
+        if self.kv_layout == "paged" \
+                and self.kv_num_blocks < self.max_blocks:
+            raise ValueError(
+                f"kv_num_blocks={self.kv_num_blocks} cannot hold even "
+                f"one full-length request ({self.max_blocks} blocks of "
+                f"{self.kv_block_len})")
+        # ---- sampling rung (temperature == 0 is the exact greedy
+        # program: the sampler is never traced, so the compiled decode
+        # stays bit-identical to the greedy goldens) ----------------------
+        self.temperature = float(temperature)
+        if self.temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
+        self.top_k = int(top_k)
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0")
         self._axis = const.MODEL_AXIS if tp > 1 else None
 
         if devices is None:
@@ -215,18 +267,43 @@ class ServingEngine:
         self.params = params
 
         # ---- cache + per-slot decode state -----------------------------
-        cache = kv_cache.init_cache(
-            cfg.num_layers, self.num_slots, cfg.num_heads,
-            cfg.head_dim, self.max_len,
-            dtype=cfg.dtype)
         self._tok = jnp.zeros((self.num_slots,), jnp.int32)
-        if self.mesh is not None:
-            csh = NamedSharding(self.mesh, kv_cache.cache_spec())
-            cache = kv_cache.KVCache(
-                k=jax.device_put(cache.k, csh),
-                v=jax.device_put(cache.v, csh),
-                lengths=jax.device_put(
-                    cache.lengths, NamedSharding(self.mesh, P())))
+        self._sample_seeds = np.zeros((self.num_slots,), np.int32)
+        if self.kv_layout == "paged":
+            cache = kv_cache.init_paged_cache(
+                cfg.num_layers, self.num_slots, cfg.num_heads,
+                cfg.head_dim, self.max_len,
+                block_len=self.kv_block_len,
+                num_blocks=self.kv_num_blocks, dtype=cfg.dtype)
+            # Host-side block accounting: the free-list allocator and
+            # the numpy mirror of the device block table (refreshed
+            # into the compiled programs as a replicated input).
+            self._allocator = kv_cache.BlockAllocator(self.kv_num_blocks)
+            self._table = np.zeros((self.num_slots, self.max_blocks),
+                                   np.int32)
+            self._slot_blocks: list = [[] for _ in range(self.num_slots)]
+            if self.mesh is not None:
+                csh = NamedSharding(self.mesh, kv_cache.cache_spec())
+                rep = NamedSharding(self.mesh, P())
+                cache = kv_cache.PagedKVCache(
+                    k=jax.device_put(cache.k, csh),
+                    v=jax.device_put(cache.v, csh),
+                    lengths=jax.device_put(cache.lengths, rep),
+                    block_table=jax.device_put(cache.block_table, rep))
+            self._emit_block_gauges()
+        else:
+            cache = kv_cache.init_cache(
+                cfg.num_layers, self.num_slots, cfg.num_heads,
+                cfg.head_dim, self.max_len,
+                dtype=cfg.dtype)
+            self._allocator = None
+            if self.mesh is not None:
+                csh = NamedSharding(self.mesh, kv_cache.cache_spec())
+                cache = kv_cache.KVCache(
+                    k=jax.device_put(cache.k, csh),
+                    v=jax.device_put(cache.v, csh),
+                    lengths=jax.device_put(
+                        cache.lengths, NamedSharding(self.mesh, P())))
         self.cache = cache
 
         self._prefill_jit = self._build_prefill()
@@ -285,10 +362,13 @@ class ServingEngine:
                                  comm_overlap=self.comm_overlap,
                                  return_kv=True)
 
-    def _layer_decode(self, chunk, x, kc, vc, layer, lengths):
+    def _layer_decode(self, chunk, x, kc, vc, layer, lengths, table=None,
+                      active=None):
         """One encoder layer for a single-token step: project, write
-        this layer's k/v into the cache in place, attend over the
-        cache slice."""
+        this layer's k/v into the cache in place (through the block
+        table under the paged layout, suppressed for inactive slots
+        whose table rows hold no reservation), attend over the cache
+        slice."""
         from autodist_tpu.models.pipeline_lm import _flax_layer_norm
 
         cfg, axis, overlap = self.cfg, self._axis, self.comm_overlap
@@ -299,16 +379,33 @@ class ServingEngine:
                               att["qkv"]["bias"].astype(dtype),
                               model_axis=axis, comm_overlap=overlap)
         q, k, v = jnp.moveaxis(qkv, -3, 0)          # [B, 1, heads, dh]
-        kc = kv_cache.write_token(kc, layer, k, lengths)
-        vc = kv_cache.write_token(vc, layer, v, lengths)
-        if self.kernel.get("flash_decode"):
-            from autodist_tpu.kernel.pallas.flash_decode import \
-                flash_decode_attention
-            out = flash_decode_attention(q, kc[layer], vc[layer],
-                                         lengths, dtype=dtype)
+        if table is not None:
+            bl = self.kv_block_len
+            kc = kv_cache.paged_write_token(kc, layer, k, lengths,
+                                            table, bl, write_mask=active)
+            vc = kv_cache.paged_write_token(vc, layer, v, lengths,
+                                            table, bl, write_mask=active)
+            if self.kernel.get("flash_decode"):
+                from autodist_tpu.kernel.pallas.flash_decode import \
+                    flash_decode_attention_paged
+                out = flash_decode_attention_paged(
+                    q, kc[layer], vc[layer], lengths, table,
+                    block_len=bl, dtype=dtype)
+            else:
+                out = kv_cache.paged_cached_attention(
+                    q, kc[layer], vc[layer], lengths, table,
+                    block_len=bl, dtype=dtype)
         else:
-            out = kv_cache.cached_attention(q, kc[layer], vc[layer],
-                                            lengths, dtype=dtype)
+            kc = kv_cache.write_token(kc, layer, k, lengths)
+            vc = kv_cache.write_token(vc, layer, v, lengths)
+            if self.kernel.get("flash_decode"):
+                from autodist_tpu.kernel.pallas.flash_decode import \
+                    flash_decode_attention
+                out = flash_decode_attention(q, kc[layer], vc[layer],
+                                             lengths, dtype=dtype)
+            else:
+                out = kv_cache.cached_attention(q, kc[layer], vc[layer],
+                                                lengths, dtype=dtype)
         a = row_parallel(out, att["out"]["kernel"].astype(dtype),
                          att["out"]["bias"].astype(dtype),
                          model_axis=axis, axes=2, comm_overlap=overlap)
@@ -333,6 +430,26 @@ class ServingEngine:
             x, shared["embedding"], vocab_size=self.cfg.vocab_size,
             model_axis=self._axis if self.vocab_parallel else None)
 
+    def _next_token(self, shared, h, seeds, positions):
+        """The decode epilogue: greedy at ``temperature == 0`` (the
+        exact pre-sampling program — the sampler is never traced), else
+        shard-invariant gumbel-max sampling keyed per (request seed,
+        context length), so a sampled stream is identical interleaved,
+        run-alone, and against the sequential reference."""
+        if self.temperature == 0.0:
+            return self._greedy(shared, h)
+        from autodist_tpu.models.pipeline_lm import _layer_norm
+        from autodist_tpu.parallel.tensor import \
+            vocab_parallel_sample_token
+
+        x = _layer_norm(h, shared["ln_final_scale"],
+                        shared["ln_final_bias"])
+        return vocab_parallel_sample_token(
+            x, shared["embedding"], vocab_size=self.cfg.vocab_size,
+            seeds=seeds, positions=positions,
+            temperature=self.temperature, top_k=self.top_k,
+            model_axis=self._axis if self.vocab_parallel else None)
+
     # ------------------------------------------------------------------ #
     # compiled programs
     # ------------------------------------------------------------------ #
@@ -355,29 +472,42 @@ class ServingEngine:
 
     def _build_prefill(self):
         L, S = self.cfg.num_layers, self.prefill_len
+        paged = self.kv_layout == "paged"
 
-        def prefill(params, kc, vc, lengths, tok, prompts, p_lens, admit):
+        def prefill(params, kc, vc, lengths, tok, table, seeds, prompts,
+                    p_lens, admit):
             stages, shared = params["stages"], params["shared"]
             x = self._embed(shared, prompts, jnp.arange(S))
             mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
             for layer in range(L):
                 chunk = jax.tree.map(lambda p: p[layer], stages)
                 x, k, v = self._layer_prefill(chunk, x, mask)
-                kc = kv_cache.write_prompt(kc, layer, k, admit)
-                vc = kv_cache.write_prompt(vc, layer, v, admit)
+                if paged:
+                    kc = kv_cache.paged_write_prompt(
+                        kc, layer, k, admit, table, self.kv_block_len,
+                        p_lens)
+                    vc = kv_cache.paged_write_prompt(
+                        vc, layer, v, admit, table, self.kv_block_len,
+                        p_lens)
+                else:
+                    kc = kv_cache.write_prompt(kc, layer, k, admit)
+                    vc = kv_cache.write_prompt(vc, layer, v, admit)
             last = jnp.take_along_axis(
                 x, (p_lens - 1)[:, None, None], axis=1)[:, 0]
-            first_tok, _ = self._greedy(shared, last)
+            # The first emitted token conditions on the p_lens prompt
+            # tokens — its sampling key position.
+            first_tok, _ = self._next_token(shared, last, seeds, p_lens)
             tok = jnp.where(admit, first_tok, tok)
             lengths = jnp.where(admit, p_lens, lengths)
             return kc, vc, lengths, tok
 
-        return self._wrap(prefill, n_in_rest=5, n_out_rest=2)
+        return self._wrap(prefill, n_in_rest=7, n_out_rest=2)
 
     def _build_decode(self):
         L, K = self.cfg.num_layers, self.decode_steps
+        paged = self.kv_layout == "paged"
 
-        def decode(params, kc, vc, lengths, tok, active):
+        def decode(params, kc, vc, lengths, tok, table, seeds, active):
             stages, shared = params["stages"], params["shared"]
 
             def body(carry, _):
@@ -385,9 +515,13 @@ class ServingEngine:
                 x = self._embed(shared, tok[:, None], lengths[:, None])
                 for layer in range(L):
                     chunk = jax.tree.map(lambda p: p[layer], stages)
-                    x, kc, vc = self._layer_decode(chunk, x, kc, vc,
-                                                   layer, lengths)
-                nxt, _ = self._greedy(shared, x[:, 0])
+                    x, kc, vc = self._layer_decode(
+                        chunk, x, kc, vc, layer, lengths,
+                        table=table if paged else None, active=active)
+                # The emitted token conditions on lengths + 1 tokens
+                # (the one just written included) — its sampling key.
+                nxt, _ = self._next_token(shared, x[:, 0], seeds,
+                                          lengths + 1)
                 nxt = jnp.where(active, nxt, tok)
                 lengths = lengths + active.astype(jnp.int32)
                 return (kc, vc, lengths, nxt), nxt
@@ -396,23 +530,113 @@ class ServingEngine:
                 body, (kc, vc, lengths, tok), None, length=K)
             return kc, vc, lengths, tok, toks
 
-        return self._wrap(decode, n_in_rest=3, n_out_rest=3)
+        return self._wrap(decode, n_in_rest=5, n_out_rest=3)
+
+    # ------------------------------------------------------------------ #
+    # host-side block accounting (the batcher's admission predicate)
+    # ------------------------------------------------------------------ #
+    def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Pool blocks a request reserves: its worst-case occupancy
+        ``min(prompt + budget, max_len)`` rounded up to blocks (0 under
+        the dense layout — admission gates on slots alone there)."""
+        if self.kv_layout != "paged":
+            return 0
+        span = min(int(prompt_len) + int(max_new_tokens), self.max_len)
+        return kv_cache.blocks_for(span, self.kv_block_len)
+
+    @property
+    def free_blocks(self) -> int:
+        """Unreserved pool blocks (dense: the pool concept is vacuous —
+        reported as 0 used / 0 free is wrong either way, so dense
+        returns a sentinel no admission check consults)."""
+        return (self._allocator.free_blocks
+                if self._allocator is not None else 0)
+
+    def reserve_slot(self, slot: int, prompt_len: int,
+                     max_new_tokens: int) -> None:
+        """Map a request's blocks into ``slot``'s table row (paged;
+        dense is a no-op).  Raises
+        :class:`~autodist_tpu.serving.kv_cache.PoolExhaustedError` when
+        the pool cannot cover it — the batcher checks
+        :meth:`blocks_needed` against :attr:`free_blocks` first, so a
+        raise here is a bookkeeping bug surfacing loudly."""
+        if self._allocator is None:
+            return
+        if self._slot_blocks[slot]:
+            raise ValueError(f"slot {slot} already holds blocks "
+                             f"{self._slot_blocks[slot]}")
+        n = self.blocks_needed(prompt_len, max_new_tokens)
+        blocks = self._allocator.alloc(n)
+        self._slot_blocks[slot] = blocks
+        # Tail-fill the row with the slot's LAST block: an over-decode
+        # position past the reservation (a final fused window's
+        # overshoot, or the clamped >= max_len write) then routes into
+        # the slot's own tail block — never block 0, which may be
+        # another slot's live block.
+        self._table[slot, :] = blocks[-1]
+        self._table[slot, :n] = blocks
+        self._sync_table()
+        self._emit_block_gauges()
+
+    def release_slot(self, slot: int) -> None:
+        """Return ``slot``'s blocks to the free list (paged; dense is a
+        no-op).  The pool rows keep their stale content — unreachable
+        behind the next owner's length mask."""
+        if self._allocator is None:
+            return
+        if self._slot_blocks[slot]:
+            self._allocator.free(self._slot_blocks[slot])
+            self._slot_blocks[slot] = []
+            self._table[slot, :] = 0
+            self._sync_table()
+            self._emit_block_gauges()
+
+    def _emit_block_gauges(self):
+        from autodist_tpu import telemetry
+
+        telemetry.gauge("serve/kv_blocks_free").set(
+            self._allocator.free_blocks)
+        telemetry.gauge("serve/kv_blocks_used").set(
+            self._allocator.used_blocks)
+
+    def _sync_table(self):
+        """Mirror the host block table onto ``cache.block_table`` so
+        the live cache pytree IS the complete decode state (a consumer
+        serializing/inspecting ``engine.cache`` between dispatches —
+        elastic checkpointing, debug dumps — must never see a stale
+        mapping; the numpy ``_table`` stays the single source the
+        device copy reflects)."""
+        self.cache = kv_cache.PagedKVCache(
+            k=self.cache.k, v=self.cache.v, lengths=self.cache.lengths,
+            block_table=jnp.asarray(self._table))
+
+    def _table_arg(self):
+        if self.kv_layout == "paged":
+            return self.cache.block_table
+        return jnp.zeros((self.num_slots, 1), jnp.int32)
 
     # ------------------------------------------------------------------ #
     # host-side driver API (the batcher's contract)
     # ------------------------------------------------------------------ #
-    def prefill(self, prompts, p_lens, admit):
+    def prefill(self, prompts, p_lens, admit, seeds=None):
         """Run one prefill over the slot batch; admitted slots adopt
-        their prompt's cache/length and first generated token.  Returns
-        the per-slot current token ``[B]`` (numpy)."""
+        their prompt's cache/length and first generated token (greedy,
+        or sampled at the engine's temperature under the slot's
+        ``seeds`` entry).  Returns the per-slot current token ``[B]``
+        (numpy)."""
         prompts = jnp.asarray(prompts, jnp.int32)
         p_lens = jnp.asarray(p_lens, jnp.int32)
         admit = jnp.asarray(admit, bool)
+        if seeds is not None:
+            self._sample_seeds = np.where(
+                np.asarray(admit), np.asarray(seeds, np.int32),
+                self._sample_seeds).astype(np.int32)
         c = self.cache
         k, v, lengths, tok = self._prefill_jit(
-            self.params, c.k, c.v, c.lengths, self._tok, prompts,
+            self.params, c.k, c.v, c.lengths, self._tok,
+            self._table_arg(), jnp.asarray(self._sample_seeds), prompts,
             p_lens, admit)
-        self.cache = kv_cache.KVCache(k=k, v=v, lengths=lengths)
+        self.cache = self._rebuild_cache(k, v, lengths)
         self._tok = tok
         return np.asarray(jax.device_get(tok))
 
@@ -423,10 +647,20 @@ class ServingEngine:
         active = jnp.asarray(active, bool)
         c = self.cache
         k, v, lengths, tok, toks = self._decode_jit(
-            self.params, c.k, c.v, c.lengths, self._tok, active)
-        self.cache = kv_cache.KVCache(k=k, v=v, lengths=lengths)
+            self.params, c.k, c.v, c.lengths, self._tok,
+            self._table_arg(), jnp.asarray(self._sample_seeds), active)
+        self.cache = self._rebuild_cache(k, v, lengths)
         self._tok = tok
         return np.asarray(jax.device_get(toks))
+
+    def _rebuild_cache(self, k, v, lengths):
+        if self.kv_layout == "paged":
+            # block_table is kept current by _sync_table at every
+            # reserve/release — the programs consumed this same array.
+            return kv_cache.PagedKVCache(
+                k=k, v=v, lengths=lengths,
+                block_table=self.cache.block_table)
+        return kv_cache.KVCache(k=k, v=v, lengths=lengths)
 
     @property
     def lengths(self):
@@ -441,6 +675,7 @@ class ServingEngine:
         active = jnp.ones((self.num_slots,), bool)
         return self._decode_jit.lower(
             self.params, c.k, c.v, c.lengths, self._tok,
+            self._table_arg(), jnp.asarray(self._sample_seeds),
             active).compile().as_text()
 
     def compiled_prefill_text(self) -> str:
@@ -450,5 +685,6 @@ class ServingEngine:
         p_lens = jnp.ones((self.num_slots,), jnp.int32)
         admit = jnp.ones((self.num_slots,), bool)
         return self._prefill_jit.lower(
-            self.params, c.k, c.v, c.lengths, self._tok, prompts,
+            self.params, c.k, c.v, c.lengths, self._tok,
+            self._table_arg(), jnp.asarray(self._sample_seeds), prompts,
             p_lens, admit).compile().as_text()

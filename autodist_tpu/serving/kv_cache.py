@@ -1,6 +1,7 @@
-"""TP-sharded KV cache for batched decode.
+"""TP-sharded KV cache for batched decode: dense and block-paged.
 
-One pair of arrays holds every layer's keys and values, laid out
+**Dense** (the original layout): one pair of arrays holds every layer's
+keys and values, laid out
 
     ``[layer, batch_slot, heads/tp, max_len, head_dim]``
 
@@ -12,16 +13,34 @@ resharding (the GSPMD property: one sharding-annotated layout serves
 both the training program's attention and the decode program's cache,
 arxiv 2105.04663).
 
-Writes are in-place ``lax.dynamic_update_slice`` updates at per-slot
-positions (each batch slot advances its own sequence under continuous
-batching); under ``jax.jit`` with the cache donated, XLA aliases the
+**Paged** (the vLLM PagedAttention design adapted to this layout,
+PAPERS.md: block tables + non-contiguous KV): the per-slot ``max_len``
+lane is replaced by a pool of fixed-size blocks
+
+    ``[layer, num_blocks, heads/tp, block_len, head_dim]``
+
+with the SAME model-axis sharding spec (axis 2 is still the head
+split), a per-slot **block table** ``[num_slots, max_blocks]`` mapping
+logical block ``j`` of a slot's sequence to a pool block, and a
+host-side free-list :class:`BlockAllocator`.  A logical position ``p``
+of slot ``s`` lives at pool coordinates
+``(block_table[s, p // block_len], p % block_len)``.  Short requests
+stop squatting on ``max_len`` bytes they never touch: the batcher
+admits against *free blocks*, not slots, so equal pool bytes carry
+strictly more concurrent short requests than dense reservation.
+
+Writes are in-place ``lax.dynamic_update_slice`` updates in both
+layouts (the paged write's start index merely routes through the
+table); under ``jax.jit`` with the cache donated, XLA aliases the
 update into the live buffer — ``tools/hlo_probe.py --probe decode``
-asserts the compiled step carries the dynamic-update-slices and no
-per-step full-cache copy.  Slots are recycled by the batcher: a newly
-admitted request's prefill overwrites positions ``[0, prompt_len)`` and
-decode overwrites forward from there, and reads are always masked to
-``pos < length``, so stale tail entries from the previous occupant are
-never observable.
+and the ADT111/ADT115 program-lint rules assert the compiled step
+carries the dynamic-update-slices, no per-step full-cache copy, and
+(paged) no dense ``[slots, max_len]``-shaped cache buffer at all.
+Slots are recycled by the batcher: a newly admitted request's prefill
+overwrites positions ``[0, prompt_len)`` and decode overwrites forward
+from there, and reads are always masked to ``pos < length``, so stale
+tail entries from the previous occupant — or, paged, from a freed
+block's previous owner — are never observable.
 """
 from __future__ import annotations
 
@@ -150,3 +169,216 @@ def cached_attention(q, k_layer, v_layer, lengths, *, dtype=jnp.float32):
         probs, v_layer.astype(dtype),
         (((3,), (2,)), ((0, 1), (0, 1))))            # [B, heads, 1, dh]
     return jnp.transpose(out, (0, 2, 1, 3))          # [B, 1, heads, dh]
+
+
+# --------------------------------------------------------------------------- #
+# Block-paged cache
+# --------------------------------------------------------------------------- #
+class PoolExhaustedError(RuntimeError):
+    """The block pool cannot satisfy an allocation: the request must
+    wait in the admission queue (or be shed) instead of silently
+    corrupting another slot's blocks.  Coded, like the batcher's
+    :class:`~autodist_tpu.serving.batcher.OverloadedError`."""
+
+    code = "serve/kv_pool_exhausted"
+
+
+class BlockAllocator:
+    """Host-side free-list over the pool's ``num_blocks`` block ids.
+
+    Pure accounting — no device traffic.  Allocation pops from one flat
+    free list, so there is no fragmentation by construction: any
+    ``n <= free_blocks`` allocation succeeds, and
+    ``free_blocks + allocated == num_blocks`` is an invariant the unit
+    tests pin.  Double-frees and foreign ids are rejected loudly (a
+    bookkeeping bug must not silently double-map a block to two
+    slots)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError("num_blocks must be >= 1")
+        self.num_blocks = int(num_blocks)
+        # LIFO free list: deterministic reuse order (a freed block is
+        # handed to the next admission — the recycling edge the paged
+        # parity goldens pin).
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._held = set()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._held)
+
+    def alloc(self, n: int) -> list:
+        if n < 0:
+            raise ValueError("alloc count must be >= 0")
+        if n > len(self._free):
+            raise PoolExhaustedError(
+                f"[{PoolExhaustedError.code}] {n} block(s) requested, "
+                f"{len(self._free)} free of {self.num_blocks} — the "
+                "admission predicate must gate on free blocks")
+        blocks = [self._free.pop() for _ in range(n)]
+        self._held.update(blocks)
+        return blocks
+
+    def free(self, blocks) -> None:
+        for b in blocks:
+            if b not in self._held:
+                raise ValueError(
+                    f"block {b} is not allocated (double-free or "
+                    "foreign id)")
+            self._held.remove(b)
+            self._free.append(b)
+
+
+def blocks_for(tokens: int, block_len: int) -> int:
+    """Pool blocks covering ``tokens`` logical positions."""
+    return -(-max(int(tokens), 0) // int(block_len))
+
+
+@dataclasses.dataclass
+class PagedKVCache:
+    """The paged decode state: block pools + table + occupancy.
+
+    ``k``/``v``: ``[L, num_blocks, heads_local, block_len, head_dim]``
+    pools.  ``lengths``: ``[num_slots]`` int32.  ``block_table``:
+    ``[num_slots, max_blocks]`` int32 — logical block ``j`` of slot
+    ``s`` lives in pool block ``block_table[s, j]`` (unassigned entries
+    hold 0; reads past a slot's occupancy are masked, so the value is
+    never observable).  Registered as a pytree so the whole cache rides
+    jit/scan carries and donation in one piece."""
+
+    k: Any
+    v: Any
+    lengths: Any
+    block_table: Any
+
+    def tree_flatten(self):
+        return (self.k, self.v, self.lengths, self.block_table), None
+
+    @classmethod
+    def tree_unflatten(cls, _, leaves):
+        return cls(*leaves)
+
+
+jax.tree_util.register_pytree_node(
+    PagedKVCache, PagedKVCache.tree_flatten, PagedKVCache.tree_unflatten)
+
+
+def init_paged_cache(num_layers: int, num_slots: int, num_heads: int,
+                     head_dim: int, max_len: int, *, block_len: int,
+                     num_blocks: int, dtype=jnp.float32) -> PagedKVCache:
+    """All-zero block pool with every slot empty and no block mapped."""
+    if block_len < 1:
+        raise ValueError("block_len must be >= 1")
+    max_blocks = blocks_for(max_len, block_len)
+    if num_blocks < max_blocks:
+        raise ValueError(
+            f"num_blocks={num_blocks} cannot hold even one full-length "
+            f"request ({max_blocks} blocks of {block_len} for "
+            f"max_len={max_len})")
+    shape = (num_layers, num_blocks, num_heads, block_len, head_dim)
+    return PagedKVCache(
+        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+        lengths=jnp.zeros((num_slots,), jnp.int32),
+        block_table=jnp.zeros((num_slots, max_blocks), jnp.int32))
+
+
+def paged_write_token(cache_arr, layer: int, kv, positions, block_table,
+                      block_len: int, write_mask=None):
+    """The paged :func:`write_token`: slot ``i``'s row lands in pool
+    block ``block_table[i, positions[i] // block_len]`` at in-block
+    offset ``positions[i] % block_len`` — still one true
+    ``dynamic_update_slice`` per slot (the block id merely becomes part
+    of the dynamic start index), so XLA aliases the write exactly like
+    the dense path.
+
+    ``write_mask`` (``[B]`` bool): slots where it is False keep the
+    target row bit-for-bit (read-modify-write).  The dense path can
+    afford garbage writes for inactive slots — each slot owns its whole
+    lane — but a paged slot holding NO reservation has a zeroed table
+    row pointing at pool block 0, which may be another slot's live
+    block, so inactive writes must be suppressed, not just masked at
+    read time.  A logical block index past the table's extent clamps
+    (jnp gather semantics) to the row's last entry, which the allocator
+    tail-fills with the slot's own last block — so a final window's
+    over-decode dirties the slot's own tail block only, the paged
+    analog of the dense path's clamped last-lane writes."""
+    B = kv.shape[0]
+    for slot in range(B):
+        pos = positions[slot]
+        blk = block_table[slot, pos // block_len]
+        upd = kv[slot, 0][None, None, :, None, :].astype(cache_arr.dtype)
+        start = (layer, blk, 0, pos % block_len, 0)
+        if write_mask is not None:
+            cur = lax.dynamic_slice(cache_arr, start, upd.shape)
+            upd = jnp.where(write_mask[slot], upd, cur)
+        cache_arr = lax.dynamic_update_slice(cache_arr, upd, start)
+    return cache_arr
+
+
+def paged_write_prompt(cache_arr, layer: int, kv, admit, block_table,
+                       block_len: int, p_lens):
+    """The paged :func:`write_prompt`: slot ``i``'s prompt rows land
+    block by block through the table when ``admit[i]``.  Unlike the
+    dense path — which writes the whole zero-padded prompt bucket into
+    the slot's private lane — a logical block holding NO real prompt
+    row (``j·block_len >= p_lens[i]``) is left untouched: a short
+    request reserves only its own blocks, so its table row past the
+    reservation points at block 0 (possibly another slot's), and the
+    padding garbage must never land there.  The final *partial* prompt
+    block (``lo < p_lens[i] < hi``) is the slot's own reserved block
+    and is overwritten WHOLE — its tail takes the prompt bucket's
+    zero-padding projections, unreachable behind the length mask (the
+    block-granular write never splits below a block, so only the
+    all-or-nothing ``lo < p_lens`` predicate decides).  Non-admitted
+    slots' mapped blocks are kept bit-for-bit via the same
+    read-modify-write select the dense path uses."""
+    B, S = kv.shape[0], kv.shape[1]
+    n_blocks = blocks_for(S, block_len)
+    for slot in range(B):
+        rows = jnp.transpose(kv[slot], (1, 0, 2))    # [heads, S, dh]
+        for j in range(n_blocks):
+            lo = j * block_len
+            hi = min(lo + block_len, S)
+            new = rows[:, lo:hi][None, None].astype(cache_arr.dtype)
+            blk = block_table[slot, j]
+            cur = lax.dynamic_slice(cache_arr, (layer, blk, 0, 0, 0),
+                                    new.shape)
+            sel = jnp.where(admit[slot] & (lo < p_lens[slot]), new, cur)
+            cache_arr = lax.dynamic_update_slice(
+                cache_arr, sel, (layer, blk, 0, 0, 0))
+    return cache_arr
+
+
+def gather_blocks(pool, block_table):
+    """Assemble per-slot contiguous K/V lanes from the pool.
+
+    ``pool``: one layer's ``[num_blocks, heads, block_len, head_dim]``
+    slice; ``block_table``: ``[B, max_blocks]`` int32.  Returns
+    ``[B, heads, max_blocks * block_len, head_dim]`` — the block-table
+    *gather* (the structural evidence the ADT115 paged program rule
+    keys on).  Positions past a slot's occupancy come from unassigned
+    table entries (block 0) and are masked by every reader."""
+    B, mb = block_table.shape
+    nb, H, bl, dh = pool.shape
+    g = jnp.take(pool, block_table, axis=0)      # [B, mb, H, bl, dh]
+    g = jnp.moveaxis(g, 2, 1)                    # [B, H, mb, bl, dh]
+    return g.reshape(B, H, mb * bl, dh)
+
+
+def paged_cached_attention(q, k_pool, v_pool, lengths, block_table, *,
+                           block_len: int, dtype=jnp.float32):
+    """One decode step's attention over a layer's *paged* cache slice:
+    gather the slot's blocks into a contiguous lane, then run the exact
+    :func:`cached_attention` masked math (T becomes the padded
+    ``max_blocks * block_len`` extent; the same ``<= length`` mask
+    hides the padded tail and any stale block content).  The composed
+    fallback the paged flash-decode kernel replaces."""
+    del block_len  # implied by the pool's block extent
+    k_layer = gather_blocks(k_pool, block_table)
+    v_layer = gather_blocks(v_pool, block_table)
+    return cached_attention(q, k_layer, v_layer, lengths, dtype=dtype)

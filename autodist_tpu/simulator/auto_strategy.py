@@ -103,34 +103,56 @@ def default_candidates() -> list[StrategyBuilder]:
     ]
 
 
-def default_serving_candidates(num_devices: int) -> list[dict]:
-    """The serving-config zoo: every (tensor_parallel, vocab_parallel)
-    shape the serving engine can lower on ``num_devices`` devices.
-    Plain dicts rather than builders — the decode program has no pipe
-    axis to build a full training strategy against, and the keys are
-    exactly the Strategy-IR ``parallel`` knobs the engine reads."""
-    candidates = [{"tensor_parallel": 1, "vocab_parallel": False}]
+def default_serving_candidates(num_devices: int,
+                               kv_layouts=("dense", "paged")) -> list[dict]:
+    """The serving-config zoo: every (tensor_parallel, vocab_parallel,
+    kv_layout) shape the serving engine can lower on ``num_devices``
+    devices.  Plain dicts rather than builders — the decode program has
+    no pipe axis to build a full training strategy against, and the
+    keys are exactly the Strategy-IR ``parallel`` knobs the engine
+    reads."""
+    shapes = [{"tensor_parallel": 1, "vocab_parallel": False}]
     tp = 2
     while tp <= num_devices:
-        candidates.append({"tensor_parallel": tp, "vocab_parallel": False})
-        candidates.append({"tensor_parallel": tp, "vocab_parallel": True})
+        shapes.append({"tensor_parallel": tp, "vocab_parallel": False})
+        shapes.append({"tensor_parallel": tp, "vocab_parallel": True})
         tp *= 2
+    candidates = []
+    for shape in shapes:
+        for layout in kv_layouts:
+            cand = dict(shape)
+            if layout != "dense":
+                cand["kv_layout"] = layout
+            candidates.append(cand)
     return candidates
 
 
 def rank_serving(trainable, resource_spec, candidates=None, *,
                  batch_slots: int = 1, max_len: int = 2048,
+                 mean_request_len=None, objective: str = "latency",
                  **cost_model_kwargs):
-    """Rank serving configs by predicted per-token decode latency —
+    """Rank serving configs by the cost model's serving objective —
     AutoStrategy's second objective (ROADMAP: "latency under load, not
     just training step time").
 
     ``candidates``: serving configs (dicts with ``tensor_parallel`` /
-    ``vocab_parallel``) or trained :class:`Strategy` objects whose
-    Strategy-IR parallel knobs describe the serving shape; defaults to
-    :func:`default_serving_candidates`.  Returns ``[(config,
-    DecodeCost)]`` best-first (feasible configs before infeasible, then
-    by token time) — the same shape as ``AutoStrategy.report``."""
+    ``vocab_parallel`` / ``kv_layout``) or trained :class:`Strategy`
+    objects whose Strategy-IR parallel knobs describe the serving
+    shape; defaults to :func:`default_serving_candidates`.
+
+    ``objective``: ``"latency"`` ranks by per-token time
+    (``DecodeCost.score`` — tp/kernel elections); ``"capacity"`` ranks
+    by :attr:`~autodist_tpu.simulator.cost_model.DecodeCost
+    .serve_score` — per-token time over the concurrent requests the
+    HBM carries under ``mean_request_len``, the objective that elects
+    ``kv_layout="paged"`` exactly when length variance makes dense
+    reservation wasteful.  Returns ``[(config, DecodeCost)]``
+    best-first (feasible configs before infeasible) — the same shape
+    as ``AutoStrategy.report``."""
+    if objective not in ("latency", "capacity"):
+        raise ValueError(
+            f"unknown serving objective {objective!r}; expected "
+            "'latency' or 'capacity'")
     cm = CostModel(resource_spec, **cost_model_kwargs)
     if candidates is None:
         candidates = default_serving_candidates(resource_spec.num_devices())
@@ -138,12 +160,15 @@ def rank_serving(trainable, resource_spec, candidates=None, *,
     for cand in candidates:
         try:
             cost = cm.decode_cost(trainable, cand,
-                                  batch_slots=batch_slots, max_len=max_len)
+                                  batch_slots=batch_slots, max_len=max_len,
+                                  mean_request_len=mean_request_len)
         except (ValueError, SpecMeshMismatch) as e:
             logging.info("serving candidate %s skipped: %s", cand, e)
             continue
         scored.append((cand, cost))
-    scored.sort(key=lambda it: it[1].score)
+    key = (lambda it: it[1].serve_score) if objective == "capacity" \
+        else (lambda it: it[1].score)
+    scored.sort(key=key)
     return scored
 
 

@@ -127,6 +127,12 @@ KERNEL_PROFILE: dict = {
     "flash_decode_crossover_len": 1024,
     "flash_decode_speedup": 1.6,
     "flash_decode_short_penalty": 0.8,
+    # Paged-KV table indirection: the attention term's multiplier under
+    # kv_layout="paged" (block-table gathers / per-block DMA setup vs
+    # the dense contiguous lane).  Strictly > 1 so dense wins whenever
+    # the request-length distribution gives paged no capacity edge —
+    # the both-ways election contract.
+    "paged_attention_overhead": 1.05,
 }
 
 # The grad slot's realization: which EF compressor a bf16/int8 gradient
@@ -291,11 +297,32 @@ class DecodeCost:
     # report can show why flash won (or lost) at this cache length.
     attn_time_s: float = 0.0
     kernel: tuple = ()
+    # The capacity side of the serving objective (PR 14): the KV-cache
+    # layout this config serves with, and the expected number of
+    # concurrent requests the post-params HBM supports under the
+    # request-length distribution — dense reserves a full max_len lane
+    # per request; paged reserves only the mean length rounded up to a
+    # block, so length variance below max_len multiplies capacity.
+    kv_layout: str = "dense"
+    request_capacity: float = 0.0
 
     @property
     def score(self) -> float:
         """Lower is better; infeasible configs rank last."""
         return self.token_time_s if self.feasible else math.inf
+
+    @property
+    def serve_score(self) -> float:
+        """The capacity-aware objective: per-token latency divided by
+        the concurrent requests the HBM carries — ~1/aggregate
+        throughput under load.  Paged outranks dense on it exactly when
+        the capacity multiplier beats the table-indirection overhead
+        (i.e. when length variance makes dense reservation wasteful);
+        at mean length == max_len the capacities tie and the overhead
+        makes dense win — pinned both ways."""
+        if not self.feasible or self.request_capacity <= 0:
+            return math.inf
+        return self.token_time_s / self.request_capacity
 
 
 class CostModel:
@@ -1232,13 +1259,15 @@ class CostModel:
     # ------------------------------------------------------------------ #
     def decode_cost(self, trainable: Trainable, config,
                     *, batch_slots: int = 1, max_len: int = 2048,
-                    kv_bytes_per_elem: float = _ACT_BYTES) -> DecodeCost:
+                    kv_bytes_per_elem: float = _ACT_BYTES,
+                    mean_request_len: Optional[float] = None,
+                    kv_block_len: int = 16) -> DecodeCost:
         """Per-token decode latency for one serving config.
 
         ``config`` is either a training :class:`Strategy` (its Strategy-
         IR parallel knobs seed the serving shape — the same IR answers
         both objectives) or a plain dict with ``tensor_parallel`` /
-        ``vocab_parallel`` keys.  The model:
+        ``vocab_parallel`` / ``kv_layout`` keys.  The model:
 
         * **compute** — a decode token's matmul passes touch every
           parameter once (2 FLOPs/element), divided across the tp group
@@ -1249,10 +1278,21 @@ class CostModel:
           forward only — decode has no backward), plus the
           vocab-parallel epilogue's lookup psum and greedy pmax/pmin;
         * **memory** — sharded parameters + the TP-sharded KV cache
-          (``2·layers·H·max_len·slots/tp`` elements), gated against HBM
-          headroom like the training costs.
+          (``2·layers·H·max_len·slots/tp`` elements; paged: the mean
+          request length rounded up to ``kv_block_len`` per slot),
+          gated against HBM headroom like the training costs;
+        * **capacity** — ``request_capacity``: concurrent requests the
+          post-params HBM supports under ``mean_request_len`` (default:
+          every request fills ``max_len`` — the no-variance worst
+          case).  Dense reserves a full ``max_len`` lane per request;
+          paged reserves ``ceil(mean/block)·block`` positions and pays
+          the calibratable ``paged_attention_overhead`` on the
+          attention term — so :attr:`DecodeCost.serve_score` elects
+          paged exactly when length variance makes dense reservation
+          wasteful, and dense when it doesn't (both directions pinned).
         """
-        from autodist_tpu.strategy.ir import normalize_kernel
+        from autodist_tpu.strategy.ir import (normalize_kernel,
+                                              normalize_kv_layout)
 
         if isinstance(config, Strategy):
             par = config.graph_config.parallel or {}
@@ -1260,10 +1300,12 @@ class CostModel:
             vocab_parallel = bool(par.get("vocab_parallel", False))
             kern = normalize_kernel(
                 getattr(config.graph_config, "kernel", None))
+            kv_layout = normalize_kv_layout(par.get("kv_layout"))
         else:
             tp = int(config.get("tensor_parallel", 1) or 1)
             vocab_parallel = bool(config.get("vocab_parallel", False))
             kern = normalize_kernel(config.get("kernel"))
+            kv_layout = normalize_kv_layout(config.get("kv_layout"))
         flash = "flash_decode" in kern
         from autodist_tpu.strategy.parallel_builders import (
             PIPELINE_TP_RULES, PIPELINE_VOCAB_RULES)
@@ -1319,6 +1361,13 @@ class CostModel:
                 attn /= float(kp["flash_decode_speedup"])
             else:
                 attn /= float(kp["flash_decode_short_penalty"])
+        if kv_layout == "paged":
+            # The block-table indirection: gathers (composed) or
+            # per-block DMA setup (the paged flash kernel) vs the
+            # dense contiguous lane.
+            attn *= float(self.kernel_profile.get(
+                "paged_attention_overhead",
+                KERNEL_PROFILE["paged_attention_overhead"]))
         compute += attn
 
         bw_link = float(self.link_profile.get(
@@ -1332,15 +1381,28 @@ class CostModel:
             comm = ring_m * boundaries * batch_slots * hidden * _ACT_BYTES \
                 / bw_link + hop_alpha * (boundaries
                                          + (2 if vocab_parallel else 0))
-        kv = 2.0 * layers * hidden * max_len * batch_slots \
-            * kv_bytes_per_elem / max(tp, 1)
+        # Per-request cache residency: dense reserves the full max_len
+        # lane whatever the request's length; paged reserves the mean
+        # length rounded up to a block.
+        mean_len = float(max_len if mean_request_len is None
+                         else min(mean_request_len, max_len))
+        bl = max(int(kv_block_len), 1)
+        resident = (float(-(-int(math.ceil(mean_len)) // bl) * bl)
+                    if kv_layout == "paged" else float(max_len))
+        lane_bytes = 2.0 * layers * hidden * kv_bytes_per_elem \
+            / max(tp, 1)
+        kv = lane_bytes * resident * batch_slots
         mem = bytes_ + kv
         hbm = self.chip.hbm_gb * 1e9 * self.hbm_headroom
+        capacity = max(hbm - bytes_, 0.0) / max(lane_bytes * resident,
+                                                1e-30)
         return DecodeCost(token_time_s=compute + comm, comm_time_s=comm,
                           compute_time_s=compute, kv_bytes_per_device=kv,
                           mem_bytes_per_device=mem, feasible=mem <= hbm,
                           tensor_parallel=tp, vocab_parallel=vocab_parallel,
-                          attn_time_s=attn, kernel=tuple(sorted(kern)))
+                          attn_time_s=attn, kernel=tuple(sorted(kern)),
+                          kv_layout=kv_layout,
+                          request_capacity=capacity)
 
     def strategy_cost(self, trainable: Trainable,
                       strategy: Strategy) -> StrategyCost:

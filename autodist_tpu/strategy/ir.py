@@ -94,6 +94,36 @@ def normalize_kernel(policy) -> dict:
     return {k: True for k in KERNEL_CHOICES if k in out}
 
 
+# --------------------------------------------------------------------------- #
+# Serving KV-cache layout (PR 14): the ``parallel`` dict's serving knob.
+# ``"dense"`` reserves one [max_len] lane per batch slot (the pre-PR-14
+# behavior, what every earlier strategy JSON deserializes to);
+# ``"paged"`` elects the block-paged pool + block-table layout
+# (serving/kv_cache.py PagedKVCache), admitted against free blocks —
+# the capacity side the cost model's decode objective prices.
+# --------------------------------------------------------------------------- #
+KV_LAYOUTS = ("dense", "paged")
+
+
+class UnknownKVLayoutError(ValueError):
+    """A kv_layout outside :data:`KV_LAYOUTS` — the named error a
+    hand-edited strategy JSON (or engine kwarg) gets instead of a
+    silently dense cache."""
+
+
+def normalize_kv_layout(value) -> str:
+    """Canonicalize the serving KV-cache layout knob.  ``None``/``""``
+    -> ``"dense"`` (every pre-PR-14 strategy); unknown names raise
+    :class:`UnknownKVLayoutError`."""
+    if value in (None, ""):
+        return "dense"
+    if value not in KV_LAYOUTS:
+        raise UnknownKVLayoutError(
+            f"unknown kv_layout {value!r}; expected one of "
+            f"{list(KV_LAYOUTS)}")
+    return str(value)
+
+
 PRECISION_BOUNDARIES = (
     # dp gradient sync (all-reduce / reduce-scatter).  Realized through
     # the compressor machinery — the one boundary with persistent error-

@@ -524,12 +524,34 @@ def _bench_flash(dog):
     telemetry.flush()
 
 
+def _kv_layout_arg() -> str:
+    """`bench.py serve --kv-layout {dense,paged}` (sys.argv scan like
+    the mode words — the UNAVAILABLE fresh-process retry re-execs the
+    argv verbatim, so the flag survives the backoff)."""
+    from autodist_tpu.strategy.ir import normalize_kv_layout
+
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == "--kv-layout" and i + 1 < len(argv):
+            return normalize_kv_layout(argv[i + 1])
+        if a.startswith("--kv-layout="):
+            return normalize_kv_layout(a.split("=", 1)[1])
+    return "dense"
+
+
 def _bench_serve(dog):
     """`bench.py serve`: decode tokens/sec + TTFT through the serving
     engine, emitted as the same provenance-stamped one-line JSON record
     shape as the training bench (hw_session.sh greps the same keys;
     UNAVAILABLE backends take the same fresh-process backoff via
-    main())."""
+    main()).
+
+    ``--kv-layout paged`` serves from the block-paged pool at the SAME
+    pool bytes as the dense cache (``num_slots_dense`` full lanes) with
+    4x the admission slots, so the recorded
+    ``serve_capacity_requests`` — the peak concurrently-admitted
+    requests over a short-request mix — measures the paged capacity
+    multiplier directly against the dense run's slot ceiling."""
     import jax.numpy as jnp
     import optax
 
@@ -538,6 +560,7 @@ def _bench_serve(dog):
     from autodist_tpu.models.transformer import TransformerConfig
     from autodist_tpu.resource import ResourceSpec
 
+    kv_layout = _kv_layout_arg()
     on_accel = jax.default_backend() != "cpu"
     rs = ResourceSpec({})
     n = rs.num_devices()
@@ -558,16 +581,29 @@ def _bench_serve(dog):
         slots, K, prefill_len, max_new, requests = 2, 4, 8, 8, 4
         tp = 1
     telemetry.annotate(bench="serve_decode_tokens_per_sec", devices=n,
-                       chip=rs.chip.name)
+                       chip=rs.chip.name, kv_layout=kv_layout)
 
-    dog.stage = f"serve bench (tp{tp}/slots{slots}: build+compile+decode)"
+    # Paged: same pool bytes (`slots` full max_len lanes), 4x the
+    # admission slots — short requests reserve only their own blocks,
+    # so the peak concurrency the pool carries is the capacity story.
+    engine_kwargs = {}
+    if kv_layout == "paged":
+        engine_kwargs = {"kv_layout": "paged",
+                         "kv_num_blocks": None,   # resolved below
+                         "kv_block_len": 16}
+        bl = engine_kwargs["kv_block_len"]
+        engine_kwargs["kv_num_blocks"] = slots * (-(-cfg.max_len // bl))
+        slots = slots * 4
+
+    dog.stage = (f"serve bench (tp{tp}/slots{slots}/{kv_layout}: "
+                 "build+compile+decode)")
     try:
         trainable = make_pipeline_lm_trainable(
             cfg, optax.adam(1e-3), jax.random.PRNGKey(0))
         engine = serving.ServingEngine(
             cfg, trainable.params, tensor_parallel=tp,
             vocab_parallel=tp > 1, num_slots=slots, max_len=cfg.max_len,
-            prefill_len=prefill_len, decode_steps=K)
+            prefill_len=prefill_len, decode_steps=K, **engine_kwargs)
         batcher = serving.ContinuousBatcher(engine)
         r = np.random.RandomState(0)
         # warm the two compiled programs before the timed run (run()
@@ -577,11 +613,22 @@ def _bench_serve(dog):
             r.randint(0, cfg.vocab_size, (4,)).tolist(), max_new_tokens=K)
         batcher.run()
         t0 = time.perf_counter()
+        # Short-request mix: every request's prompt + budget spans well
+        # under max_len, the shape where dense reservation wastes lane
+        # bytes and paged admission (free blocks, not slots) wins.
         for _ in range(requests):
             plen = int(r.randint(1, prefill_len + 1))
             batcher.submit(r.randint(0, cfg.vocab_size, (plen,)).tolist(),
                            max_new_tokens=max_new)
-        done = batcher.run()
+        # Step the scheduler by hand so the peak concurrently-admitted
+        # count is observable between rounds (run() loops internally).
+        capacity = 0
+        before = set(batcher.completions)
+        while batcher._queue or batcher.active_slots:
+            batcher.step()
+            capacity = max(capacity, batcher.active_slots)
+        done = {rid: c for rid, c in batcher.completions.items()
+                if rid not in before}
         wall = time.perf_counter() - t0
     except Exception as e:
         dog.disarm()
@@ -590,6 +637,7 @@ def _bench_serve(dog):
         print(json.dumps({
             "metric": "serve_decode_tokens_per_sec", "value": 0.0,
             "unit": "tokens_per_sec", "vs_baseline": 0.0,
+            "kv_layout": kv_layout,
             "error": f"serve bench failed: {e}",
             "provenance": _provenance()}))
         sys.exit(4)
@@ -602,6 +650,8 @@ def _bench_serve(dog):
         "unit": "tokens_per_sec", "vs_baseline": round(rate, 2),
         "devices": n, "chip": rs.chip.name, "tensor_parallel": tp,
         "vocab_parallel": tp > 1, "slots": slots, "decode_steps": K,
+        "kv_layout": kv_layout,
+        "serve_capacity_requests": capacity,
         "requests": len(done), "tokens": tokens,
         "ttft_ms_p50": round(ttfts[len(ttfts) // 2] * 1e3, 2),
         "inter_token_ms_p50": round(float(np.percentile(itls, 50)), 3)

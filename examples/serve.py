@@ -106,7 +106,8 @@ def main():
     print("serving configs by predicted token latency:")
     for cand, cost in ranked[:4]:
         print(f"  tp={cand['tensor_parallel']} "
-              f"vocab_parallel={cand['vocab_parallel']}: "
+              f"vocab_parallel={cand['vocab_parallel']} "
+              f"kv={cand.get('kv_layout', 'dense')}: "
               f"{cost.token_time_s * 1e6:.2f} us/token "
               f"(comm {cost.comm_time_s * 1e6:.2f})")
 

@@ -274,7 +274,7 @@ def test_mutation_matrix_covers_the_required_rules():
     # full-param step boundary, quantized wire, host transfer,
     # donated copy — plus the rest of the shipped rules
     assert {"ADT108", "ADT105", "ADT106", "ADT109", "ADT101",
-            "ADT103", "ADT104"} <= codes
+            "ADT103", "ADT104", "ADT115"} <= codes
     assert len(codes) >= 10
 
 

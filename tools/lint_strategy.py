@@ -133,8 +133,8 @@ def _train_program_text(strategy, spec, trainable, batch) -> str:
 
 
 def lint_zoo(max_programs=None, plan_only=False, decode=True,
-             reshard=True, kernel=True, out=print) -> tuple[int, int,
-                                                            list]:
+             reshard=True, kernel=True, paged=True,
+             out=print) -> tuple[int, int, list]:
     """Sweep the zoo; returns ``(n_errors, n_warnings, results)``."""
     from autodist_tpu.analysis import (lint_plan, lint_program,
                                        rules_for_decode,
@@ -188,8 +188,17 @@ def lint_zoo(max_programs=None, plan_only=False, decode=True,
         results.append(rec)
 
     if decode and not plan_only:
-        for tp, vocab_parallel in ((1, False), (2, False), (2, True)):
-            name = f"decode/tp{tp}" + ("+vocab" if vocab_parallel else "")
+        decode_cases = [(1, False, "dense"), (2, False, "dense"),
+                        (2, True, "dense")]
+        if paged:
+            # The paged-KV decode configs sweep through the ADT115
+            # paged-cache rule (plus the shared decode contract);
+            # --no-paged opts out, and the --max-programs budget guard
+            # skips LOUDLY like every other program here.
+            decode_cases += [(1, False, "paged"), (2, True, "paged")]
+        for tp, vocab_parallel, layout in decode_cases:
+            name = f"decode/tp{tp}" + ("+vocab" if vocab_parallel else "") \
+                + ("+paged" if layout == "paged" else "")
             if max_programs is not None and compiled >= max_programs:
                 out(f"{name}: SKIPPED (--max-programs budget)")
                 results.append({"candidate": name,
@@ -197,14 +206,17 @@ def lint_zoo(max_programs=None, plan_only=False, decode=True,
                                            "budget)"})
                 continue
             compiled += 1
-            text = programs.decode_step_text(tp, vocab_parallel)
+            text = programs.decode_step_text(tp, vocab_parallel,
+                                             kv_layout=layout)
             rules = rules_for_decode(
                 tp, vocab_parallel, vocab_size=programs.DEC_V,
                 max_len=programs.DEC_T,
                 num_layers=programs.DEC_LAYERS,
                 num_slots=programs.DEC_SLOTS,
                 heads_local=max(2 // tp, 1),
-                head_dim=programs.DEC_HEAD_DIM)
+                head_dim=programs.DEC_HEAD_DIM,
+                kv_layout=layout,
+                pool_blocks=programs.DEC_POOL_BLOCKS)
             prog = lint_program(text, rules, where=name)
             n_err += len(prog.errors)
             n_warn += len(prog.warnings)
@@ -293,23 +305,31 @@ def lint_zoo(max_programs=None, plan_only=False, decode=True,
                             "plan": [d.to_dict() for d in plan],
                             "program": [d.to_dict() for d in prog],
                             "program_rules": [r.name for r in rules]})
-        name = "kernel/flash_decode"
-        if max_programs is not None and compiled >= max_programs:
-            out(f"{name}: SKIPPED (--max-programs budget)")
-            results.append({"candidate": name,
-                            "program": "skipped (--max-programs "
-                                       "budget)"})
-        else:
+        flash_cases = [("kernel/flash_decode", "dense")]
+        if paged:
+            # The paged-cache flash decode: ADT120's marker proof plus
+            # the ADT115 dense-lane ban (the in-kernel page walk leaves
+            # no HLO gather, so the rule's gather half stays off).
+            flash_cases.append(("kernel/flash_decode_paged", "paged"))
+        for name, layout in flash_cases:
+            if max_programs is not None and compiled >= max_programs:
+                out(f"{name}: SKIPPED (--max-programs budget)")
+                results.append({"candidate": name,
+                                "program": "skipped (--max-programs "
+                                           "budget)"})
+                continue
             compiled += 1
             text = programs.decode_step_text(1, False,
-                                             kernel=("flash_decode",))
+                                             kernel=("flash_decode",),
+                                             kv_layout=layout)
             rules = rules_for_decode(
                 1, False, vocab_size=programs.DEC_V,
                 max_len=programs.DEC_T,
                 num_layers=programs.DEC_LAYERS,
                 num_slots=programs.DEC_SLOTS, heads_local=2,
                 head_dim=programs.DEC_HEAD_DIM,
-                kernel=("flash_decode",))
+                kernel=("flash_decode",), kv_layout=layout,
+                pool_blocks=programs.DEC_POOL_BLOCKS)
             prog = lint_program(text, rules, where=name)
             n_err += len(prog.errors)
             n_warn += len(prog.warnings)
@@ -468,6 +488,8 @@ def main(argv=None) -> int:
                     help="skip the elastic reshard program")
     ap.add_argument("--no-kernel", action="store_true",
                     help="skip the Pallas kernel-elected programs")
+    ap.add_argument("--no-paged", action="store_true",
+                    help="skip the paged-KV decode programs")
     ap.add_argument("--max-programs", type=int, default=None,
                     metavar="N",
                     help="compile at most N programs (CI budget "
@@ -492,7 +514,8 @@ def main(argv=None) -> int:
         zoo_err, zoo_warn, report["zoo"] = lint_zoo(
             max_programs=args.max_programs, plan_only=args.plan_only,
             decode=not args.no_decode, reshard=not args.no_reshard,
-            kernel=not args.no_kernel, out=out)
+            kernel=not args.no_kernel, paged=not args.no_paged,
+            out=out)
         n_err += zoo_err
         print(f"zoo sweep: {zoo_err} error(s), {zoo_warn} warning(s) "
               f"across {len(report['zoo'])} candidate(s)")

@@ -35,7 +35,14 @@ _PRECISION_BITS = {"fp32": 32, "bf16": 16, "int8": 8}
 _KERNEL_CHOICES = ("flash_decode", "quant_ring", "collective_matmul")
 # Per-request serving records (autodist_tpu/serving/batcher.py): the
 # latency facts the serving section aggregates.
-_SERVE_KEYS = {"kind", "request", "tokens", "ttft_ms", "tokens_per_sec"}
+_SERVE_KEYS = {"kind", "request", "tokens", "ttft_ms", "tokens_per_sec",
+               "kv_layout"}
+# Paged-KV pool gauges (autodist_tpu/serving/engine.py): a paged
+# engine emits serve/kv_blocks_free + serve/kv_blocks_used on every
+# block reservation/release.  A run whose serve records declare
+# kv_layout="paged" but whose metrics lack the pool gauges means the
+# block accounting silently never ran — --check fails it.
+_KV_BLOCK_GAUGES = ("serve/kv_blocks_free", "serve/kv_blocks_used")
 # Per-reshard records (autodist_tpu/elastic/reshard.py): one per
 # executed reshard — route taken (compiled fast path vs host-staged),
 # payload moved, and the host-memory high-water mark the staged route
@@ -191,6 +198,18 @@ def check_schema(run_dir: str) -> list[str]:
                     f"metrics.jsonl: {name} = {rec.get('value')!r} — an "
                     "elected-kernel gauge must be 1")
 
+    # A paged serving run must carry the block-pool gauges: their
+    # absence means the free-list accounting (the admission predicate's
+    # ground truth) silently never ran.
+    if any(r.get("kind") == "serve" and r.get("kv_layout") == "paged"
+           for r in records):
+        for gname in _KV_BLOCK_GAUGES:
+            if gname not in gauges:
+                problems.append(
+                    f"metrics.jsonl: serve records declare "
+                    f"kv_layout=\"paged\" but the {gname} gauge is "
+                    "missing — the block-pool accounting never emitted")
+
     manifest = os.path.join(run_dir, "manifest.json")
     if os.path.exists(manifest):
         try:
@@ -343,18 +362,28 @@ def render(run_dir: str) -> str:
                  if r.get("tokens_per_sec")]
         depth = next((g["value"] for g in gauges
                       if g["name"] == "serve/queue_depth"), None)
+        layouts = sorted({r.get("kv_layout", "dense") for r in serves})
         lines += ["## serving", "",
-                  "| requests | tokens | ttft p50 ms | ttft p99 ms | "
-                  "inter-token p50 ms | inter-token p99 ms | tokens/s "
-                  "(per-request p50) | queue depth |",
-                  "|---|---|---|---|---|---|---|---|",
+                  "| requests | tokens | kv layout | ttft p50 ms | "
+                  "ttft p99 ms | inter-token p50 ms | "
+                  "inter-token p99 ms | tokens/s (per-request p50) | "
+                  "queue depth |",
+                  "|---|---|---|---|---|---|---|---|---|",
                   f"| {len(serves)} | {tokens} "
+                  f"| {'/'.join(layouts)} "
                   f"| {_fmt(float(np.percentile(ttft, 50)))} "
                   f"| {_fmt(float(np.percentile(ttft, 99)))} "
                   f"| {_fmt(itl['p50'] if itl else None)} "
                   f"| {_fmt(itl['p99'] if itl else None)} "
                   f"| {_fmt(float(np.percentile(rates, 50)) if rates else None)} "
                   f"| {_fmt(depth)} |", ""]
+        if "paged" in layouts:
+            free = next((g["value"] for g in gauges
+                         if g["name"] == "serve/kv_blocks_free"), None)
+            used = next((g["value"] for g in gauges
+                         if g["name"] == "serve/kv_blocks_used"), None)
+            lines += [f"- kv block pool (final): {_fmt(used)} used / "
+                      f"{_fmt(free)} free", ""]
 
     if reshards:
         lines += ["## reshards", "",
