@@ -83,6 +83,17 @@ CODES: dict[str, tuple[str, str]] = {
     "ADT082": (WARNING, "worst-case restart backoff exceeds the SSP "
                         "staleness window (every peer stalls at the "
                         "gate while the worker restarts)"),
+    "ADT085": (ERROR, "fleet hedge timeout at or beyond the request "
+                      "deadline (every request expires before its "
+                      "hedge can fire: hedging is dead config)"),
+    "ADT086": (ERROR, "fleet replicas x tensor_parallel exceeds the "
+                      "topology's device count"),
+    "ADT087": (WARNING, "fleet replacement budget with no engine "
+                        "source to rebuild from (every replica death "
+                        "or drain escalates to a permanent shrink)"),
+    "ADT088": (ERROR, "fleet tensor_parallel spans the cross-slice DCN "
+                      "boundary (tp stays within a slice's ICI; only "
+                      "replica dispatch rides DCN)"),
     # --- program lint (optimized HLO) -------------------------------- #
     "ADT101": (ERROR, "step program contains a host transfer"),
     "ADT102": (ERROR, "multi-step window lowered without a fused loop"),

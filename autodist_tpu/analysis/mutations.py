@@ -278,6 +278,72 @@ def _supervision_mutations() -> list[SupervisionMutation]:
     ]
 
 
+def _fleet_fixture():
+    """A CLEAN serving-fleet shape on a two-slice 8-device topology
+    (2 replicas of a tp=2 group, hedge deadline well under the request
+    deadline, sane heartbeat cadence, replacement budget backed by an
+    engine source) — the base every ADT085+ mutation doctors."""
+    from autodist_tpu.resource import ResourceSpec
+
+    spec = ResourceSpec({"topology": {"num_devices": 8, "num_slices": 2}})
+    config = {"replicas": 2, "tensor_parallel": 2, "kv_layout": "paged",
+              "hedge_timeout_s": 0.5, "request_deadline_s": 10.0,
+              "max_replacements": 1, "has_engine_source": True,
+              "heartbeat_interval_s": 0.5, "heartbeat_timeout_s": 5.0}
+    return config, spec
+
+
+@dataclasses.dataclass
+class FleetMutation:
+    """Doctor a clean fleet config; the fleet lint must fire ``code``
+    on the doctored shape and stay silent on the honest one."""
+
+    name: str
+    code: str
+    description: str
+    mutate: Callable  # (dict) -> dict
+    kind: str = "fleet"
+
+    def run(self) -> dict:
+        from autodist_tpu.analysis.plan_rules import lint_fleet
+
+        config, spec = _fleet_fixture()
+        clean = lint_fleet(config, resource_spec=spec)
+        mutated = lint_fleet(self.mutate(dict(config)),
+                             resource_spec=spec)
+        return {"name": self.name, "kind": self.kind, "code": self.code,
+                "clean_ok": self.code not in clean.codes(),
+                "fired": self.code in mutated.codes(),
+                "description": self.description}
+
+
+def _fleet_mutations() -> list[FleetMutation]:
+    return [
+        FleetMutation(
+            "hedge_beyond_request_deadline", "ADT085",
+            "hedge timeout raised past the request deadline — every "
+            "request expires before its hedge can fire (the straggler "
+            "path is dead config)",
+            lambda c: dict(c, hedge_timeout_s=20.0)),
+        FleetMutation(
+            "fleet_overflows_topology", "ADT086",
+            "replica count raised until replicas x tp exceeds the "
+            "device budget",
+            lambda c: dict(c, replicas=8)),
+        FleetMutation(
+            "replacement_without_engine_source", "ADT087",
+            "replacement budget kept but the engine source detached — "
+            "every replica death or drain escalates to a permanent "
+            "shrink",
+            lambda c: dict(c, has_engine_source=False)),
+        FleetMutation(
+            "fleet_tp_across_dcn", "ADT088",
+            "tp degree raised past a slice's ICI degree — the "
+            "per-token boundary all-reduces would ride DCN",
+            lambda c: dict(c, replicas=1, tensor_parallel=8)),
+    ]
+
+
 def _reshard_mutations() -> list[ReshardMutation]:
     def drop_leaf(src, dst):
         dst["leaves"].pop("params/b")
@@ -742,7 +808,8 @@ def _program_mutations() -> list[ProgramMutation]:
 
 def all_mutations() -> list:
     return (_plan_mutations() + _program_mutations()
-            + _reshard_mutations() + _supervision_mutations())
+            + _reshard_mutations() + _supervision_mutations()
+            + _fleet_mutations())
 
 
 def run_mutations(names=None, kinds=None) -> list[dict]:

@@ -760,6 +760,105 @@ def lint_supervision(config, strategy: Optional[Strategy] = None
 
 
 # --------------------------------------------------------------------------- #
+# Serving-fleet lint (fault-tolerant multi-host serving, ADT085-ADT088)
+# --------------------------------------------------------------------------- #
+def lint_fleet(config, resource_spec=None) -> LintReport:
+    """Check a serving-fleet shape (a
+    :class:`~autodist_tpu.serving.fleet.FleetConfig`, a
+    ``ServingFleet.describe()`` dict, or a hand-written config dict
+    with the same keys) BEFORE any replica is built — the plan-level
+    gate for the configs that quietly disable the fleet's recovery
+    machinery.  Pass the target ``resource_spec`` so the topology
+    rules see the device/slice budget the fleet must fit.
+
+    * **ADT085** (error): ``hedge_timeout_s >= request_deadline_s`` —
+      every request hits its deadline before its hedge can fire, so
+      the straggler path is dead config wearing a live knob.
+    * **ADT081** (error, shared with supervision lint): heartbeat
+      interval at or beyond the timeout — a healthy replica is
+      declared dead between two scheduled beats.
+    * **ADT086** (error): ``replicas × tensor_parallel`` exceeds the
+      topology's device count.
+    * **ADT088** (error): ``tensor_parallel`` exceeds a slice's ICI
+      degree — tp's per-token all-reduces must never ride DCN; spread
+      replicas across slices instead (the serving analog of ADT060).
+    * **ADT087** (warning): a replacement budget with no engine source
+      (``has_engine_source=False``) — a dead or drained replica can
+      never be rebuilt, so every death escalates to a permanent
+      shrink; the drain path silently becomes an escalation path.
+    """
+    d = config.to_dict() if hasattr(config, "to_dict") else dict(config)
+    report = LintReport()
+    hedge = d.get("hedge_timeout_s")
+    deadline = d.get("request_deadline_s")
+    if hedge is not None and deadline is not None and hedge >= deadline:
+        report.extend([Diagnostic(
+            "ADT085",
+            f"hedge_timeout_s={hedge} >= request_deadline_s={deadline}: "
+            "every request completes deadline_exceeded before a hedge "
+            "can be dispatched",
+            where="fleet.hedge_timeout_s",
+            fix="keep the hedge timeout well under the request deadline "
+                "(a hedge needs time to win the race), or drop the "
+                "deadline")])
+    interval = d.get("heartbeat_interval_s")
+    timeout = d.get("heartbeat_timeout_s")
+    if interval is not None and timeout is not None \
+            and interval >= timeout:
+        report.extend([Diagnostic(
+            "ADT081",
+            f"heartbeat_interval_s={interval} >= "
+            f"heartbeat_timeout_s={timeout}: a healthy replica's beat "
+            "counter looks stalled between two scheduled rounds",
+            where="fleet.heartbeat_interval_s",
+            fix="keep the interval well under the timeout (3-5 beats "
+                "per window absorbs scheduler jitter)")])
+    replicas = int(d.get("replicas", 1) or 1)
+    tp = int(d.get("tensor_parallel", 1) or 1)
+    if resource_spec is not None:
+        try:
+            num_devices = resource_spec.num_devices()
+        except (ValueError, RuntimeError):
+            num_devices = None
+        if num_devices is not None and replicas * tp > num_devices:
+            report.extend([Diagnostic(
+                "ADT086",
+                f"replicas={replicas} x tensor_parallel={tp} needs "
+                f"{replicas * tp} devices; the topology has "
+                f"{num_devices}",
+                where="fleet.replicas",
+                fix="shrink the fleet or the tp degree until "
+                    "replicas x tp fits the device count")])
+        num_slices = max(int(getattr(resource_spec, "num_slices", 1)
+                             or 1), 1)
+        if num_devices is not None and num_slices > 1 \
+                and tp > num_devices // num_slices:
+            report.extend([Diagnostic(
+                "ADT088",
+                f"tensor_parallel={tp} exceeds the "
+                f"{num_devices // num_slices} devices a slice's ICI "
+                f"connects ({num_slices} slices): the per-token "
+                "boundary all-reduces would ride DCN",
+                where="fleet.tensor_parallel",
+                fix="keep tp within a slice and spread replicas "
+                    "across slices (the router's per-request dispatch "
+                    "is the only fleet traffic DCN should carry)")])
+    if int(d.get("max_replacements", 0) or 0) > 0 \
+            and not d.get("has_engine_source", True):
+        report.extend([Diagnostic(
+            "ADT087",
+            f"max_replacements={d.get('max_replacements')} but the "
+            "fleet has no engine source to rebuild a replica from: "
+            "every death or drain permanently shrinks the fleet",
+            where="fleet.max_replacements",
+            fix="give the fleet an engine factory backed by a params "
+                "source (exported artifact / checkpoint), or set "
+                "max_replacements=0 to make the shrink-only policy "
+                "explicit")])
+    return report.sorted()
+
+
+# --------------------------------------------------------------------------- #
 # Entry point
 # --------------------------------------------------------------------------- #
 def lint_plan(strategy: Strategy, resource_spec=None, trainable=None,

@@ -10,7 +10,8 @@ from autodist_tpu.runtime.coordination import (CoordClient,  # noqa: F401
                                                CoordUnavailableError,
                                                SSPController,
                                                service_client)
-from autodist_tpu.runtime.faults import (FAULT_KINDS, FaultInjector,  # noqa: F401,E501
+from autodist_tpu.runtime.faults import (FAULT_KINDS,  # noqa: F401
+                                         SERVING_FAULT_KINDS, FaultInjector,
                                          FaultPlan, FaultSpec,
                                          install_ckpt_write_fail,
                                          load_fault_plan)
@@ -22,7 +23,8 @@ __all__ = [
     "SupervisionConfig", "WorkerHandle", "heartbeat", "make_global_batch",
     "CoordClient", "CoordServer", "CoordUnavailableError", "SSPController",
     "service_client",
-    "FAULT_KINDS", "FaultInjector", "FaultPlan", "FaultSpec",
+    "FAULT_KINDS", "SERVING_FAULT_KINDS", "FaultInjector", "FaultPlan",
+    "FaultSpec",
     "install_ckpt_write_fail", "load_fault_plan",
     "RetryError", "RetryPolicy", "backoff_delay",
 ]

@@ -496,38 +496,47 @@ class HeartbeatMonitor(threading.Thread):
                     continue
                 if client is None:
                     continue
-            for w in list(self.coordinator.workers):
-                if not w.running or w.superseded:
+            client = self.poll_once(client)
+
+    def poll_once(self, client):
+        """One freshness sweep over the coordinator's live workers —
+        the loop body of :meth:`run`, factored out so a synchronous
+        driver (the serving fleet's per-round health check) runs the
+        SAME detection semantics the threaded monitor does.  Returns
+        the client to use next round (``None`` after a control-plane
+        error — never declare deaths on a blind sample)."""
+        for w in list(self.coordinator.workers):
+            if not w.running or w.superseded:
+                self._last.pop(w, None)
+                continue
+            try:
+                count = client.counter_add(f"hb/{w.name}", 0)
+            except OSError:
+                # Control plane briefly unreachable (coord_drop):
+                # never declare deaths on a blind sample.
+                return None
+            now = time.monotonic()
+            last = self._last.get(w)
+            if last is None:
+                # First sight of this handle: its window starts at
+                # launch (a restarted worker is a NEW handle, so a
+                # fresh incarnation never inherits stale state).
+                self._last[w] = [count, max(now, w.started_s), False]
+            elif count != last[0]:
+                self._last[w] = [count, now, True]
+            else:
+                # Not-yet-first-beat gets the startup grace
+                # (interpreter + backend init); a worker that HAS
+                # beaten gets the steady-state timeout.
+                limit = self.timeout_s if last[2] \
+                    else max(self.startup_grace_s, self.timeout_s)
+                if now - last[1] > limit:
                     self._last.pop(w, None)
-                    continue
-                try:
-                    count = client.counter_add(f"hb/{w.name}", 0)
-                except OSError:
-                    # Control plane briefly unreachable (coord_drop):
-                    # never declare deaths on a blind sample.
-                    client = None
-                    break
-                now = time.monotonic()
-                last = self._last.get(w)
-                if last is None:
-                    # First sight of this handle: its window starts at
-                    # launch (a restarted worker is a NEW handle, so a
-                    # fresh incarnation never inherits stale state).
-                    self._last[w] = [count, max(now, w.started_s), False]
-                elif count != last[0]:
-                    self._last[w] = [count, now, True]
-                else:
-                    # Not-yet-first-beat gets the startup grace
-                    # (interpreter + backend init); a worker that HAS
-                    # beaten gets the steady-state timeout.
-                    limit = self.timeout_s if last[2] \
-                        else max(self.startup_grace_s, self.timeout_s)
-                    if now - last[1] > limit:
-                        self._last.pop(w, None)
-                        self.coordinator.declare_dead(
-                            w, reason=f"no heartbeat for "
-                                      f"{now - last[1]:.1f}s "
-                                      f"(timeout {limit}s)")
+                    self.coordinator.declare_dead(
+                        w, reason=f"no heartbeat for "
+                                  f"{now - last[1]:.1f}s "
+                                  f"(timeout {limit}s)")
+        return client
 
 
 def heartbeat(client, name: str, interval_s: float,

@@ -24,7 +24,12 @@ layers — is a ``kind="fault"`` telemetry record;
 ``tools/telemetry_report.py --check`` schema-gates them and fails a run
 whose injections have no matching recovery/teardown record.
 ``tools/chaos_run.py --matrix`` sweeps every kind against a
-``LocalCluster`` training job.  See ``docs/usage/robustness.md``.
+``LocalCluster`` training job, and ``--matrix --plane serving`` sweeps
+the serving-plane kinds (:data:`SERVING_FAULT_KINDS` —
+``replica_crash``/``replica_hang``/``replica_slow``, targeting a
+:class:`~autodist_tpu.serving.fleet.ServingFleet` replica via the
+injector's ``fleet=`` binding) against a two-replica fleet.  See
+``docs/usage/robustness.md``.
 """
 from __future__ import annotations
 
@@ -40,6 +45,16 @@ from autodist_tpu.utils import logging
 
 FAULT_KINDS = ("worker_crash", "worker_hang", "slow_host", "coord_drop",
                "ckpt_write_fail", "preempt_signal")
+
+# Serving-plane faults (the fleet rung): injected against a
+# :class:`~autodist_tpu.serving.fleet.ServingFleet` replica rather than
+# a training worker — a replica dying/hanging/straggling mid-stream is
+# the failure mode the router's failover/hedging paths exist for, and
+# each path is proven by its injection (``tools/chaos_run.py --matrix
+# --plane serving``).  Kept in their own tuple so the training chaos
+# matrix stays exactly the six kinds above.
+SERVING_FAULT_KINDS = ("replica_crash", "replica_hang", "replica_slow")
+ALL_FAULT_KINDS = FAULT_KINDS + SERVING_FAULT_KINDS
 
 # The lifecycle vocabulary of kind="fault" records; the report's schema
 # gate keys on it.  injected -> one of the terminal phases.
@@ -79,9 +94,9 @@ class FaultSpec:
     times: int = 1
 
     def __post_init__(self):
-        if self.kind not in FAULT_KINDS:
+        if self.kind not in ALL_FAULT_KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}; expected "
-                             f"one of {list(FAULT_KINDS)}")
+                             f"one of {list(ALL_FAULT_KINDS)}")
         if (self.at_step is None) == (self.at_s is None):
             raise ValueError(
                 f"{self.kind} needs exactly one trigger: at_step "
@@ -199,12 +214,14 @@ class FaultInjector:
     def __init__(self, plan: FaultPlan, self_target: str = "chief", *,
                  workers: Any = None, saver: Any = None,
                  coord_bounce: Optional[Callable[[float], None]] = None,
+                 fleet: Any = None,
                  clock: Callable[[], float] = time.monotonic):
         self.plan = plan
         self.self_target = self_target
         self._workers = workers
         self._saver = saver
         self._coord_bounce = coord_bounce
+        self._fleet = fleet
         self._clock = clock
         self._t0 = clock()
         self._pending = list(plan.faults)
@@ -226,6 +243,12 @@ class FaultInjector:
         return elapsed >= spec.at_s
 
     def _owns(self, spec: FaultSpec) -> bool:
+        if spec.kind in SERVING_FAULT_KINDS:
+            # Replica faults land on the fleet that owns the replica —
+            # the router/health plane must observe the failure, so only
+            # the process holding the ServingFleet can inject it.
+            return self._fleet is not None \
+                and self._fleet.has_replica(spec.target)
         if spec.target == self.self_target:
             return True
         if spec.kind == "coord_drop" and self._coord_bounce is not None:
@@ -342,3 +365,26 @@ class FaultInjector:
 
     def _fire_preempt_signal(self, spec, step, elapsed):
         os.kill(os.getpid(), signal.SIGTERM)
+
+    # ---- the serving-plane kinds (fleet replicas) --------------------- #
+    def _require_fleet(self, spec):
+        if self._fleet is None:
+            raise RuntimeError(
+                f"{spec.kind} fired with no fleet attached (pass "
+                "fleet= to the FaultInjector)")
+        return self._fleet
+
+    def _fire_replica_crash(self, spec, step, elapsed):
+        self._require_fleet(spec).inject(spec.target, "crash")
+
+    def _fire_replica_hang(self, spec, step, elapsed):
+        # Detected only by the heartbeat freshness check — the replica
+        # stops beating AND stops making progress, exactly a SIGSTOP.
+        self._require_fleet(spec).inject(spec.target, "hang")
+
+    def _fire_replica_slow(self, spec, step, elapsed):
+        # A straggler, not a death: the replica keeps beating (healthy
+        # to the monitor) but its dispatch rounds stall for duration_s —
+        # the shape the router's hedging exists for.
+        self._require_fleet(spec).inject(spec.target, "slow",
+                                         duration_s=spec.duration_s)

@@ -10,7 +10,12 @@ the steps-per-loop fused dispatch; this package adds the decode loop:
 * :mod:`~autodist_tpu.serving.engine` — prefill/decode split with a
   fused multi-token decode loop and last-position-only logits;
 * :mod:`~autodist_tpu.serving.batcher` — continuous batching with a
-  request queue, slot allocation/eviction, and per-token telemetry.
+  request queue, slot allocation/eviction, and per-token telemetry;
+* :mod:`~autodist_tpu.serving.fleet` /
+  :mod:`~autodist_tpu.serving.router` — the fault-tolerant multi-
+  replica tier: N engine+batcher replica groups behind a queue-depth-
+  aware router with health-checked lifecycle, failover re-dispatch
+  (at-most-once token emission), hedging, and drain/replacement.
 
 Typical use (see ``docs/usage/serving.md`` / ``examples/serve.py``)::
 
@@ -26,10 +31,15 @@ from autodist_tpu.serving.batcher import (FINISH_REASONS, Completion,
                                           ContinuousBatcher,
                                           OverloadedError, Request)
 from autodist_tpu.serving.engine import ServingEngine, serving_param_specs
+from autodist_tpu.serving.fleet import (FleetConfig, FleetDrainedError,
+                                        Replica, ReplicaCrashedError,
+                                        ServingFleet)
 from autodist_tpu.serving.kv_cache import (BlockAllocator, KVCache,
                                            PagedKVCache,
                                            PoolExhaustedError, init_cache,
                                            init_paged_cache)
+from autodist_tpu.serving.router import (DISPATCH_REASONS, FleetCompletion,
+                                         Router)
 
 __all__ = [
     "ServingEngine", "ContinuousBatcher", "Request", "Completion",
@@ -37,6 +47,9 @@ __all__ = [
     "KVCache", "init_cache", "serve", "serving_param_specs",
     "PagedKVCache", "init_paged_cache", "BlockAllocator",
     "PoolExhaustedError",
+    "ServingFleet", "FleetConfig", "Replica", "Router",
+    "FleetCompletion", "DISPATCH_REASONS", "ReplicaCrashedError",
+    "FleetDrainedError",
 ]
 
 

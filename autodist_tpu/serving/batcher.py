@@ -51,10 +51,11 @@ class OverloadedError(RuntimeError):
 
 # Every way a request can end.  The first three are the classic decode
 # terminals; the rest are the graceful-degradation terminals (deadline
-# pressure, overload shedding, engine drain) — absent entirely when no
-# deadline/queue-bound/drain is in play.
+# pressure, overload shedding, engine drain, caller-side cancellation —
+# the router's hedge loser) — absent entirely when no
+# deadline/queue-bound/drain/cancel is in play.
 FINISH_REASONS = ("eos", "max_tokens", "max_len", "deadline_exceeded",
-                  "shed", "drained")
+                  "shed", "drained", "cancelled")
 
 
 @dataclasses.dataclass
@@ -175,6 +176,40 @@ class ContinuousBatcher:
     def active_slots(self) -> int:
         return sum(s is not None for s in self._slots)
 
+    @property
+    def queue_depth(self) -> int:
+        """Queued-but-unadmitted requests — with :attr:`active_slots`,
+        the load signal the fleet router dispatches on."""
+        return len(self._queue)
+
+    def cancel(self, rid: str) -> bool:
+        """Withdraw a live request wherever it is: still queued — it
+        completes ``"cancelled"`` with no tokens; in flight — its slot
+        is evicted NOW (tokens decoded so far kept on the completion,
+        paged blocks back on the free list immediately — a hedge
+        loser's reservation must not outlive the race it lost).
+        Returns False when ``rid`` is not live (already completed, or
+        never submitted)."""
+        for req in self._queue:
+            if req.rid == rid:
+                self._queue.remove(req)
+                now = time.perf_counter()
+                telemetry.counter("serve/cancelled").inc()
+                self._finish(req, tokens=[], reason="cancelled",
+                             ttft_s=now - req.submit_s,
+                             queue_wait_s=now - req.submit_s,
+                             decode_s=0.0, inter_token_ms=[])
+                telemetry.gauge("serve/queue_depth").set(len(self._queue))
+                return True
+        for i, slot in enumerate(self._slots):
+            if slot is not None and slot.req.rid == rid:
+                if slot.done is None:
+                    slot.done = "cancelled"
+                    telemetry.counter("serve/cancelled").inc()
+                self._evict(i)
+                return True
+        return False
+
     # ------------------------------------------------------------------ #
     def _expire_queued(self):
         """Complete queued requests already past their deadline — a
@@ -249,9 +284,22 @@ class ContinuousBatcher:
         if not taken:
             return
         now = time.perf_counter()
-        with telemetry.span("serve/prefill", admitted=len(taken)):
-            toks = self.engine.prefill(prompts, p_lens, admit,
-                                       seeds=seeds)
+        try:
+            with telemetry.span("serve/prefill", admitted=len(taken)):
+                toks = self.engine.prefill(prompts, p_lens, admit,
+                                           seeds=seeds)
+        except Exception:
+            # The engine died mid-prefill (a crashed replica): the
+            # reservations made above have no slot to be evicted from —
+            # without this release they would strand pool blocks
+            # forever in a batcher that outlives the error.  Requests
+            # go back to the queue head (original order) so a
+            # router-side drain/failover can re-dispatch them.
+            for i, req in reversed(taken):
+                self.engine.release_slot(i)
+                self._queue.appendleft(req)
+            telemetry.gauge("serve/queue_depth").set(len(self._queue))
+            raise
         t_first = time.perf_counter()
         for i, req in taken:
             slot = _Slot(req=req, tokens=[int(toks[i])], admitted_s=now,

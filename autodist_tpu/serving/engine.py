@@ -591,6 +591,24 @@ class ServingEngine:
             self._sync_table()
             self._emit_block_gauges()
 
+    def block_accounting(self) -> tuple:
+        """``(free, used, total)`` pool blocks — the invariant every
+        terminal state must restore is ``free + used == total`` (and
+        ``free == total`` once no request is resident).  Dense engines
+        report the vacuous ``(0, 0, 0)``."""
+        if self._allocator is None:
+            return (0, 0, 0)
+        return (self._allocator.free_blocks, self._allocator.used_blocks,
+                self.kv_num_blocks)
+
+    def release_all_slots(self) -> None:
+        """Return EVERY slot's blocks to the free list — the abandon
+        path: a fleet replica declared dead releases its engine
+        wholesale (a real crashed host frees its HBM with it; the
+        in-process model must not let the bookkeeping say otherwise)."""
+        for slot in range(self.num_slots):
+            self.release_slot(slot)
+
     def _emit_block_gauges(self):
         from autodist_tpu import telemetry
 

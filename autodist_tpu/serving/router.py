@@ -1,0 +1,473 @@
+"""Queue-depth-aware request router with failover, hedging, and an
+at-most-once token-emission contract.
+
+The router is the fleet's single client-facing surface: requests enter
+here, get dispatched to the least-loaded admitting replica, and leave
+as exactly one :class:`FleetCompletion` each — whatever dies in
+between.  The robustness contracts:
+
+* **At-most-once emission** — the router owns the per-request *emitted*
+  stream (the tokens a client has already seen).  Replicas only ever
+  extend it: a token index is appended exactly once, and any dispatch
+  re-covering an already-emitted index must agree with it (the
+  interleave-parity property extended across replicas) — the
+  ``kind="dispatch"`` telemetry record's ``re_emitted`` count is
+  structurally 0 and the report's ``--check`` gates it.
+* **Failover re-dispatch** — a dead replica's open requests re-prefill
+  *prompt + already-emitted tokens* on a healthy replica (the paged
+  block table stores arbitrary prefixes, so the re-prefill is one
+  admission) and continue the stream where it stopped: greedy decode
+  continues identically because both paths pin to the sequential
+  reference, and sampled decode continues identically because the
+  gumbel keys fold (request seed, context length, vocab row) — a
+  position-keyed draw is re-dispatch-invariant by construction.
+* **Hedging** — a request still open past the hedge deadline (explicit
+  ``hedge_timeout_s``, or calibrated from the completed-latency
+  percentile) gets a duplicate dispatch on a second replica; the first
+  terminal wins, the loser is cancelled and its blocks freed the same
+  round.
+* **Drain** — a draining replica's queued-but-unadmitted dispatches are
+  withdrawn and re-homed (``reason="drain"``); its in-flight ones
+  finish in place.
+
+Every dispatch decision is one ``kind="dispatch"`` record
+(``request``/``replica``/``reason ∈ {route, failover, hedge, drain}``/
+``re_emitted``), schema-gated by ``tools/telemetry_report.py --check``
+— a failover record additionally requires the paired replica fault
+record the fleet emitted when it declared the replica dead.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from autodist_tpu import telemetry
+from autodist_tpu.serving.batcher import OverloadedError
+from autodist_tpu.serving.fleet import FleetDrainedError, Replica, \
+    ServingFleet
+from autodist_tpu.utils import logging
+
+DISPATCH_REASONS = ("route", "failover", "hedge", "drain")
+
+
+@dataclasses.dataclass
+class FleetCompletion:
+    """One finished fleet request: the emitted stream + how it got
+    there (which replica won, how many failovers it survived, whether a
+    hedge raced — the facts the fleet report aggregates)."""
+
+    rid: str
+    tokens: list
+    finish_reason: str
+    ttft_s: float
+    e2e_s: float
+    replica: Optional[str]       # the winning dispatch's replica
+    failovers: int = 0
+    hedged: bool = False
+    hedge_won: bool = False
+
+
+@dataclasses.dataclass
+class _Dispatch:
+    replica: Replica
+    rid: str                     # the replica-batcher request id
+    base: int                    # request tokens already emitted at dispatch
+    reason: str                  # one of DISPATCH_REASONS
+    t_s: float
+
+
+@dataclasses.dataclass
+class _Open:
+    rid: str
+    prompt: list
+    max_new_tokens: int
+    eos_id: Optional[int]
+    seed: int
+    submit_s: float
+    deadline_abs: Optional[float]
+    emitted: list = dataclasses.field(default_factory=list)
+    dispatches: list = dataclasses.field(default_factory=list)
+    first_tok_s: Optional[float] = None
+    failovers: int = 0
+    hedged: bool = False
+    # The replica the request must fail over FROM, remembered across
+    # replica-less gaps: a re-home delayed by a replacement compile is
+    # still a failover and must be recorded as one, not relabeled a
+    # plain route once a replica appears.  drain_pending is the drain
+    # sweep's sibling flag (a drain re-home delayed the same way).
+    failover_from: Optional[str] = None
+    drain_pending: bool = False
+
+
+class Router:
+    """Dispatch/failover/hedge driver over a :class:`ServingFleet`.
+
+    The scheduler is explicit and single-threaded like the batcher's:
+    :meth:`step` runs one fleet round (health check → replica rounds →
+    harvest → failover/drain re-dispatch → hedging → replacement);
+    :meth:`run` steps until every submitted request has its completion.
+    """
+
+    def __init__(self, fleet: ServingFleet):
+        self.fleet = fleet
+        self.config = fleet.config
+        self._open: dict[str, _Open] = {}
+        self._ids = itertools.count()
+        self.completions: dict[str, FleetCompletion] = {}
+        # Completed e2e_s for the hedge-percentile calibration: a
+        # bounded recent window, not the full history — a long-lived
+        # router must not grow memory (or its per-round percentile
+        # cost) with every request it ever served.
+        self._latencies: deque = deque(maxlen=512)
+
+    # ------------------------------------------------------------------ #
+    # submission + dispatch
+    # ------------------------------------------------------------------ #
+    def submit(self, prompt, *, max_new_tokens: int = 16,
+               eos_id: Optional[int] = None, seed: int = 0,
+               rid: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> str:
+        """Queue one request with the fleet; returns its id.  The
+        failover contract needs room to re-prefill *prompt + emitted*,
+        so ``len(prompt) + max_new_tokens - 1`` must fit the engines'
+        prompt bucket (chunked prefill is the ROADMAP rung that lifts
+        this)."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        bucket = min(r.engine.prefill_len for r in self.fleet.replicas)
+        if len(prompt) + max_new_tokens - 1 > bucket:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) - 1 exceeds the fleet's prefill "
+                f"bucket ({bucket}); a failover could not re-prefill "
+                "the emitted stream")
+        if deadline_s is None:
+            deadline_s = self.config.request_deadline_s
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0")
+        rid = rid if rid is not None else f"freq-{next(self._ids)}"
+        now = time.perf_counter()
+        req = _Open(rid=rid, prompt=prompt,
+                    max_new_tokens=int(max_new_tokens), eos_id=eos_id,
+                    seed=int(seed), submit_s=now,
+                    deadline_abs=(now + deadline_s
+                                  if deadline_s is not None else None))
+        self._open[rid] = req
+        self._dispatch(req, reason="route")
+        return rid
+
+    def _pick(self, exclude=()) -> Optional[Replica]:
+        """Least-loaded admitting replica (deterministic tie-break on
+        name) — the queue-depth-aware dispatch policy."""
+        targets = [r for r in self.fleet.admitting if r not in exclude]
+        if not targets:
+            return None
+        return min(targets, key=lambda r: (r.load, r.name))
+
+    def _dispatch(self, req: _Open, reason: str, exclude=(),
+                  from_replica: Optional[str] = None
+                  ) -> Optional[_Dispatch]:
+        """One dispatch of ``req``'s remaining stream onto a replica;
+        ``None`` when no admitting replica exists (the request stays
+        pending and re-dispatches on a later round)."""
+        replica = self._pick(exclude=exclude)
+        if replica is None:
+            return None
+        base = len(req.emitted)
+        budget = req.max_new_tokens - base
+        remaining = None
+        if req.deadline_abs is not None:
+            remaining = req.deadline_abs - time.perf_counter()
+            if remaining <= 0:
+                return None   # the deadline sweep completes it
+        sub = f"{req.rid}@{replica.name}i{replica.incarnation}" \
+              f".{len(req.dispatches)}"
+        try:
+            replica.batcher.submit(
+                req.prompt + req.emitted, max_new_tokens=budget,
+                eos_id=req.eos_id, rid=sub, seed=req.seed,
+                deadline_s=remaining)
+        except OverloadedError:
+            # Shed at the replica (it started draining between pick and
+            # submit, or its queue bound tripped): try the others.
+            return self._dispatch(req, reason,
+                                  exclude=tuple(exclude) + (replica,),
+                                  from_replica=from_replica)
+        disp = _Dispatch(replica=replica, rid=sub, base=base,
+                         reason=reason, t_s=time.perf_counter())
+        req.dispatches.append(disp)
+        if reason == "failover":
+            telemetry.counter("fleet/failovers").inc()
+        elif reason == "hedge":
+            telemetry.counter("fleet/hedges").inc()
+        # The dispatch record: one per routing decision.  re_emitted is
+        # the at-most-once contract made auditable — the router never
+        # re-emits an already-streamed token, so it is structurally 0
+        # and the report's schema gate fails anything else.
+        telemetry.record_event(
+            "dispatch", request=req.rid, replica=replica.name,
+            reason=reason, re_emitted=0, base=base,
+            queue_depth=replica.load, from_replica=from_replica)
+        self._emit_depth_gauges()
+        return disp
+
+    def _emit_depth_gauges(self):
+        for r in self.fleet.live:
+            telemetry.gauge(f"fleet/{r.name}/queue_depth").set(r.load)
+
+    # ------------------------------------------------------------------ #
+    # harvest: at-most-once emission + completion resolution
+    # ------------------------------------------------------------------ #
+    def _tokens_of(self, disp: _Dispatch):
+        """``(tokens, finish_reason|None)`` of one dispatch as its
+        replica currently knows them — completion, in-flight slot, or
+        still queued.  A dead replica's state is unreadable (lost with
+        the host); callers drop the dispatch instead."""
+        batcher = disp.replica.batcher
+        comp = batcher.completions.get(disp.rid)
+        if comp is not None:
+            return list(comp.tokens), comp.finish_reason
+        for slot in batcher._slots:
+            if slot is not None and slot.req.rid == disp.rid:
+                return list(slot.tokens), None
+        return [], None
+
+    def _harvest(self):
+        now = time.perf_counter()
+        for req in list(self._open.values()):
+            terminal: Optional[str] = None
+            winner: Optional[_Dispatch] = None
+            for disp in list(req.dispatches):
+                if not disp.replica.running:
+                    continue   # the failover sweep handles it
+                toks, finish = self._tokens_of(disp)
+                stream = disp.base + len(toks)
+                for idx in range(len(req.emitted), stream):
+                    req.emitted.append(toks[idx - disp.base])
+                    if req.first_tok_s is None:
+                        req.first_tok_s = now
+                # Overlap agreement: a token the client already saw can
+                # never be re-emitted, and parity guarantees the
+                # re-covering dispatch AGREES with it — a disagreement
+                # is a correctness bug, surfaced loudly.
+                for idx in range(disp.base, min(len(req.emitted), stream)):
+                    if toks[idx - disp.base] != req.emitted[idx]:
+                        raise RuntimeError(
+                            f"replica {disp.replica.name} diverged on "
+                            f"{req.rid} token {idx}: "
+                            f"{toks[idx - disp.base]} != already-"
+                            f"emitted {req.emitted[idx]} — the at-most-"
+                            "once contract would re-emit")
+                if finish in ("shed", "drained", "cancelled"):
+                    # Replica-local terminals, not request terminals:
+                    # the dispatch is gone, the request re-homes.
+                    req.dispatches.remove(disp)
+                elif finish in ("max_len", "deadline_exceeded") \
+                        and terminal is None:
+                    terminal, winner = finish, disp
+            # Router-side terminals rule (a crash can eat a replica's
+            # completion record, but never the emitted stream):
+            if req.eos_id is not None and req.eos_id in req.emitted:
+                req.emitted = req.emitted[:req.emitted.index(req.eos_id)
+                                          + 1]
+                terminal = "eos"
+                winner = winner or self._covering(req)
+            elif len(req.emitted) >= req.max_new_tokens:
+                req.emitted = req.emitted[:req.max_new_tokens]
+                terminal = "max_tokens"
+                winner = winner or self._covering(req)
+            if req.deadline_abs is not None and now >= req.deadline_abs \
+                    and terminal is None:
+                terminal = "deadline_exceeded"
+                winner = self._covering(req)
+            if terminal is not None:
+                self._complete(req, terminal, winner)
+
+    def _covering(self, req: _Open) -> Optional[_Dispatch]:
+        """The dispatch whose stream reached the request's last emitted
+        token (the winner of a hedge race)."""
+        best = None
+        for disp in req.dispatches:
+            if disp.replica.running:
+                toks, _ = self._tokens_of(disp)
+                if disp.base + len(toks) >= len(req.emitted) \
+                        and (best is None or disp.t_s < best.t_s):
+                    best = disp
+        return best
+
+    def _complete(self, req: _Open, reason: str,
+                  winner: Optional[_Dispatch]):
+        now = time.perf_counter()
+        # Withdraw EVERY live dispatch — the hedge loser's, and the
+        # winner's own slot when the router resolved the terminal ahead
+        # of the replica (eos/budget seen in the emitted stream): a
+        # completed request must not hold cache blocks one round longer
+        # (cancel is a no-op for a dispatch the replica already
+        # evicted).
+        for disp in req.dispatches:
+            if disp.replica.running:
+                disp.replica.batcher.cancel(disp.rid)
+        hedge_won = winner is not None and winner.reason == "hedge"
+        if hedge_won:
+            telemetry.counter("fleet/hedge_wins").inc()
+        comp = FleetCompletion(
+            rid=req.rid, tokens=list(req.emitted), finish_reason=reason,
+            ttft_s=(req.first_tok_s or now) - req.submit_s,
+            e2e_s=now - req.submit_s,
+            replica=winner.replica.name if winner is not None else None,
+            failovers=req.failovers, hedged=req.hedged,
+            hedge_won=hedge_won)
+        self.completions[req.rid] = comp
+        del self._open[req.rid]
+        self._latencies.append(comp.e2e_s)
+        telemetry.counter("fleet/requests").inc()
+        self._emit_depth_gauges()
+
+    # ------------------------------------------------------------------ #
+    # recovery sweeps
+    # ------------------------------------------------------------------ #
+    def _sweep_failover(self):
+        """Re-home requests whose every dispatch died with its replica:
+        re-prefill prompt + emitted on a healthy replica.  With no
+        healthy replica this round, the request stays pending — but
+        keeps its failover provenance, so the eventual re-dispatch
+        (after the replacement sweep mints a replica) is still
+        recorded as the failover it is."""
+        for req in list(self._open.values()):
+            live = [d for d in req.dispatches if d.replica.running]
+            if live:
+                req.dispatches = live
+                continue
+            if req.dispatches:
+                req.failover_from = req.dispatches[-1].replica.name
+                req.dispatches = []
+            if req.failover_from is not None:
+                disp = self._dispatch(req, reason="failover",
+                                      from_replica=req.failover_from)
+                if disp is not None:
+                    req.failovers += 1
+                    req.failover_from = None
+            elif req.drain_pending:
+                # A drain re-home that found no target last round —
+                # still a drain move, recorded as one.
+                if self._dispatch(req, reason="drain") is not None:
+                    req.drain_pending = False
+            else:
+                # Never dispatched (submitted into a replica-less gap):
+                # plain routing, not a failover.
+                self._dispatch(req, reason="route")
+
+    def _sweep_drain(self):
+        """Withdraw queued-but-unadmitted dispatches from draining
+        replicas and re-home them (``reason="drain"``); in-flight ones
+        finish where they run."""
+        for req in list(self._open.values()):
+            for disp in list(req.dispatches):
+                replica = disp.replica
+                if replica.state != "draining":
+                    continue
+                batcher = replica.batcher
+                if any(r.rid == disp.rid for r in batcher._queue):
+                    batcher.cancel(disp.rid)
+                    req.dispatches.remove(disp)
+                    if not req.dispatches \
+                            and self._dispatch(req, reason="drain",
+                                               exclude=(replica,)) \
+                            is None:
+                        # No target this round (single-replica rolling
+                        # restart): keep the drain provenance so the
+                        # delayed re-home is still recorded as one.
+                        req.drain_pending = True
+
+    def _hedge_deadline(self) -> Optional[float]:
+        cfg = self.config
+        if cfg.hedge_timeout_s is not None:
+            return cfg.hedge_timeout_s
+        if cfg.hedge_percentile is None \
+                or len(self._latencies) < cfg.hedge_min_samples:
+            return None
+        return float(np.percentile(
+            np.asarray(self._latencies, float),
+            cfg.hedge_percentile)) * cfg.hedge_factor
+
+    def _sweep_hedge(self):
+        deadline = self._hedge_deadline()
+        if deadline is None:
+            return
+        now = time.perf_counter()
+        for req in list(self._open.values()):
+            if req.hedged or not req.dispatches:
+                continue
+            primary = req.dispatches[0]
+            if now - primary.t_s <= deadline:
+                continue
+            disp = self._dispatch(
+                req, reason="hedge",
+                exclude=tuple(d.replica for d in req.dispatches))
+            if disp is not None:
+                req.hedged = True
+
+    def _sweep_shed(self):
+        """The no-replicas backstop: with every replica gone and the
+        replacement budget spent, open requests complete ``"shed"``
+        (coded — resubmittable elsewhere) instead of hanging
+        :meth:`run` forever."""
+        if self.fleet.live or not self._open:
+            return
+        logging.error(
+            "[%s] fleet has no live replicas; shedding %d open "
+            "request(s)", FleetDrainedError.code, len(self._open))
+        telemetry.counter("fleet/shed").inc(len(self._open))
+        for req in list(self._open.values()):
+            self._complete(req, "shed", None)
+
+    # ------------------------------------------------------------------ #
+    # the scheduler
+    # ------------------------------------------------------------------ #
+    def step(self):
+        """One fleet round: health check → replica scheduler rounds
+        (a crash surfaces here and is declared) → harvest/emit →
+        drain + failover re-dispatch → hedging → replacement →
+        drained-replica retirement."""
+        self.fleet.poll_health()
+        for replica in list(self.fleet.live):
+            try:
+                replica.step()
+            except Exception as e:  # noqa: BLE001 — a replica death
+                #   must never take the router down with it
+                self.fleet.declare_dead(replica, reason=str(e),
+                                        fault="replica_crash")
+        self._harvest()
+        self._sweep_drain()
+        self._sweep_failover()
+        self._sweep_hedge()
+        for replica in list(self.fleet.replicas):
+            if replica.state == "dead" and not replica.superseded:
+                self.fleet.maybe_replace(replica)
+        self.fleet.retire_drained()
+        self._sweep_shed()
+        self._emit_depth_gauges()
+
+    def run(self) -> dict:
+        """Step until every submitted request has completed; returns
+        the completions this call produced (the batcher ``run()``
+        contract)."""
+        before = set(self.completions)
+        while self._open:
+            self.step()
+        return {rid: c for rid, c in self.completions.items()
+                if rid not in before}
+
+    def drain_replica(self, name: str):
+        """Drain one replica through the fleet and immediately re-home
+        its queued dispatches."""
+        self.fleet.drain(name)
+        self._sweep_drain()

@@ -127,6 +127,31 @@ def default_serving_candidates(num_devices: int,
     return candidates
 
 
+def default_fleet_candidates(num_devices: int, num_slices: int = 1,
+                             kv_layouts=("dense", "paged")) -> list[dict]:
+    """The fleet-shape zoo: every ``(replicas × tensor_parallel ×
+    kv_layout)`` the topology admits — tp bounded by a slice's ICI
+    degree (tp never crosses DCN; the cost model rejects it), replicas
+    bounded by ``num_devices // tp`` (they may span slices — the
+    router's dispatch hop is priced, not forbidden)."""
+    per_slice = max(num_devices // max(num_slices, 1), 1)
+    candidates = []
+    tp = 1
+    while tp <= per_slice:
+        r = 1
+        while r * tp <= num_devices:
+            for layout in kv_layouts:
+                cand = {"tensor_parallel": tp, "vocab_parallel": tp > 1}
+                if r > 1:
+                    cand["replicas"] = r
+                if layout != "dense":
+                    cand["kv_layout"] = layout
+                candidates.append(cand)
+            r *= 2
+        tp *= 2
+    return candidates
+
+
 def rank_serving(trainable, resource_spec, candidates=None, *,
                  batch_slots: int = 1, max_len: int = 2048,
                  mean_request_len=None, objective: str = "latency",
@@ -146,16 +171,27 @@ def rank_serving(trainable, resource_spec, candidates=None, *,
     .serve_score` — per-token time over the concurrent requests the
     HBM carries under ``mean_request_len``, the objective that elects
     ``kv_layout="paged"`` exactly when length variance makes dense
-    reservation wasteful.  Returns ``[(config, DecodeCost)]``
-    best-first (feasible configs before infeasible) — the same shape
-    as ``AutoStrategy.report``."""
-    if objective not in ("latency", "capacity"):
+    reservation wasteful; ``"fleet"`` ranks by
+    :attr:`~autodist_tpu.simulator.cost_model.DecodeCost.fleet_score`
+    over the ``(replicas × tp × kv_layout)`` shapes
+    (:func:`default_fleet_candidates`) — aggregate throughput for the
+    traffic mix, with replicas priced across DCN and tp held within a
+    slice's ICI.  Returns ``[(config, DecodeCost)]`` best-first
+    (feasible configs before infeasible) — the same shape as
+    ``AutoStrategy.report``."""
+    if objective not in ("latency", "capacity", "fleet"):
         raise ValueError(
             f"unknown serving objective {objective!r}; expected "
-            "'latency' or 'capacity'")
+            "'latency', 'capacity', or 'fleet'")
     cm = CostModel(resource_spec, **cost_model_kwargs)
     if candidates is None:
-        candidates = default_serving_candidates(resource_spec.num_devices())
+        if objective == "fleet":
+            candidates = default_fleet_candidates(
+                resource_spec.num_devices(),
+                max(int(getattr(resource_spec, "num_slices", 1) or 1), 1))
+        else:
+            candidates = default_serving_candidates(
+                resource_spec.num_devices())
     scored = []
     for cand in candidates:
         try:
@@ -166,8 +202,9 @@ def rank_serving(trainable, resource_spec, candidates=None, *,
             logging.info("serving candidate %s skipped: %s", cand, e)
             continue
         scored.append((cand, cost))
-    key = (lambda it: it[1].serve_score) if objective == "capacity" \
-        else (lambda it: it[1].score)
+    key = {"capacity": lambda it: it[1].serve_score,
+           "fleet": lambda it: it[1].fleet_score,
+           "latency": lambda it: it[1].score}[objective]
     scored.sort(key=key)
     return scored
 

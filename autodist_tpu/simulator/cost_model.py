@@ -305,6 +305,14 @@ class DecodeCost:
     # block, so length variance below max_len multiplies capacity.
     kv_layout: str = "dense"
     request_capacity: float = 0.0
+    # The fleet shape (PR 15): dp replicas of the tp group behind one
+    # router.  Replicas multiply capacity without touching per-token
+    # latency; a fleet spanning slices pays the router's cross-slice
+    # dispatch hop (priced at DCN constants, amortized per token) —
+    # replicas ride DCN, tp never does (the serving ADT060 analog,
+    # rejected at pricing time).
+    replicas: int = 1
+    dispatch_time_s: float = 0.0
 
     @property
     def score(self) -> float:
@@ -323,6 +331,22 @@ class DecodeCost:
         if not self.feasible or self.request_capacity <= 0:
             return math.inf
         return self.token_time_s / self.request_capacity
+
+    @property
+    def fleet_score(self) -> float:
+        """The fleet objective: per-token latency (+ the amortized
+        cross-slice dispatch hop) over the requests the WHOLE fleet
+        carries (``replicas × request_capacity``) — ~1/aggregate fleet
+        throughput for the traffic mix.  Elects the
+        (replicas × tp × kv_layout) shape: replicas multiply the
+        denominator for free until the device budget binds, tp trades
+        per-token comm against the compute win within a slice, and the
+        kv layout moves ``request_capacity`` exactly as in
+        :attr:`serve_score`."""
+        if not self.feasible or self.request_capacity <= 0:
+            return math.inf
+        return (self.token_time_s + self.dispatch_time_s) \
+            / (max(self.replicas, 1) * self.request_capacity)
 
 
 class CostModel:
@@ -1289,7 +1313,15 @@ class CostModel:
           the calibratable ``paged_attention_overhead`` on the
           attention term — so :attr:`DecodeCost.serve_score` elects
           paged exactly when length variance makes dense reservation
-          wasteful, and dense when it doesn't (both directions pinned).
+          wasteful, and dense when it doesn't (both directions pinned);
+        * **fleet** — a ``replicas`` key prices the router's shape: the
+          tp group must fit a slice's ICI (rejected otherwise — the
+          serving ADT060 analog), ``replicas × tp`` must fit the
+          topology, and a fleet spanning slices pays the per-request
+          DCN dispatch hop amortized per token
+          (:attr:`DecodeCost.dispatch_time_s`) —
+          :attr:`DecodeCost.fleet_score` then ranks aggregate
+          throughput for the mix.
         """
         from autodist_tpu.strategy.ir import (normalize_kernel,
                                               normalize_kv_layout)
@@ -1301,11 +1333,34 @@ class CostModel:
             kern = normalize_kernel(
                 getattr(config.graph_config, "kernel", None))
             kv_layout = normalize_kv_layout(par.get("kv_layout"))
+            replicas = int(par.get("replicas", 1) or 1)
         else:
             tp = int(config.get("tensor_parallel", 1) or 1)
             vocab_parallel = bool(config.get("vocab_parallel", False))
             kern = normalize_kernel(config.get("kernel"))
             kv_layout = normalize_kv_layout(config.get("kv_layout"))
+            replicas = int(config.get("replicas", 1) or 1)
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        # The fleet placement contract (arxiv 2110.10548's hierarchy,
+        # serving-side): tp's per-layer boundary all-reduces live on
+        # every decoded token, so the tp group must stay within a
+        # slice's ICI; only the router's per-REQUEST dispatch may cross
+        # DCN — replicas spread across slices, tp never does.
+        num_devices = self.spec.num_devices()
+        num_slices = max(int(getattr(self.spec, "num_slices", 1) or 1), 1)
+        per_slice = num_devices // num_slices
+        if tp > per_slice:
+            raise ValueError(
+                f"tensor_parallel={tp} exceeds the {per_slice} devices "
+                f"a slice's ICI connects ({num_slices} slice(s) of "
+                f"{per_slice}); tp must stay within a slice — spread "
+                "replicas across slices instead")
+        if replicas * tp > num_devices:
+            raise ValueError(
+                f"replicas={replicas} x tensor_parallel={tp} needs "
+                f"{replicas * tp} devices; the topology has "
+                f"{num_devices}")
         flash = "flash_decode" in kern
         from autodist_tpu.strategy.parallel_builders import (
             PIPELINE_TP_RULES, PIPELINE_VOCAB_RULES)
@@ -1396,13 +1451,28 @@ class CostModel:
         hbm = self.chip.hbm_gb * 1e9 * self.hbm_headroom
         capacity = max(hbm - bytes_, 0.0) / max(lane_bytes * resident,
                                                 1e-30)
+        # Router dispatch across DCN: a fleet too big for one slice
+        # spreads replicas across slices, and a request routed to a
+        # remote-slice replica ships its prompt over DCN once —
+        # amortized over the tokens it then decodes locally.  A fleet
+        # that fits one slice pays nothing (the both-ways pin: replicas
+        # are PRICED across DCN, never free, never forbidden).
+        dispatch = 0.0
+        if replicas > 1 and replicas * tp > per_slice:
+            bw_dcn, dcn_alpha = self._dcn_link()
+            remote_frac = (num_slices - 1) / num_slices
+            prompt_bytes = mean_len * 4.0   # token ids on the wire
+            dispatch = remote_frac * (dcn_alpha
+                                      + prompt_bytes / bw_dcn) \
+                / max(mean_len, 1.0)
         return DecodeCost(token_time_s=compute + comm, comm_time_s=comm,
                           compute_time_s=compute, kv_bytes_per_device=kv,
                           mem_bytes_per_device=mem, feasible=mem <= hbm,
                           tensor_parallel=tp, vocab_parallel=vocab_parallel,
                           attn_time_s=attn, kernel=tuple(sorted(kern)),
                           kv_layout=kv_layout,
-                          request_capacity=capacity)
+                          request_capacity=capacity,
+                          replicas=replicas, dispatch_time_s=dispatch)
 
     def strategy_cost(self, trainable: Trainable,
                       strategy: Strategy) -> StrategyCost:

@@ -539,6 +539,124 @@ def _kv_layout_arg() -> str:
     return "dense"
 
 
+def _replicas_arg() -> int:
+    """`bench.py serve --replicas N` (same argv-scan contract)."""
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == "--replicas" and i + 1 < len(argv):
+            return max(int(argv[i + 1]), 1)
+        if a.startswith("--replicas="):
+            return max(int(a.split("=", 1)[1]), 1)
+    return 1
+
+
+def _bench_serve_fleet(dog, replicas: int):
+    """`bench.py serve --replicas N`: the fleet record — aggregate
+    tokens/sec through the router over N replicas, and the robustness
+    number the fleet exists for: TTFT p99 over the same mix WITH and
+    WITHOUT one replica killed mid-run (the failover path's latency
+    cost, measured not promised).  Same provenance-stamped one-line
+    JSON shape and UNAVAILABLE fresh-process backoff as every bench
+    mode."""
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import serving, telemetry
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+    from autodist_tpu.resource import ResourceSpec
+
+    kv_layout = _kv_layout_arg()
+    on_accel = jax.default_backend() != "cpu"
+    rs = ResourceSpec({})
+    n = rs.num_devices()
+    if on_accel:
+        cfg = TransformerConfig(vocab_size=32768, hidden_size=1024,
+                                num_layers=8, num_heads=16, mlp_dim=4096,
+                                max_len=1024, dtype=jnp.bfloat16,
+                                dropout_rate=0.0,
+                                attention_dropout_rate=0.0)
+        slots, K, prefill_len, max_new, requests = 8, 16, 512, 128, 24
+    else:  # CPU dev smoke: same code path, toy size
+        cfg = TransformerConfig(vocab_size=128, hidden_size=32,
+                                num_layers=2, num_heads=2, mlp_dim=64,
+                                max_len=64, dtype=jnp.float32,
+                                dropout_rate=0.0,
+                                attention_dropout_rate=0.0)
+        slots, K, prefill_len, max_new, requests = 2, 4, 24, 8, 8
+    telemetry.annotate(bench="serve_fleet_tokens_per_sec", devices=n,
+                       chip=rs.chip.name, kv_layout=kv_layout,
+                       replicas=replicas)
+    dog.stage = (f"serve fleet bench (replicas={replicas}/"
+                 f"{kv_layout}: build+compile+route)")
+    engine_kwargs = {}
+    if kv_layout == "paged":
+        engine_kwargs = {"kv_layout": "paged", "kv_block_len": 16}
+
+    def run_mix(kill: bool):
+        trainable = make_pipeline_lm_trainable(
+            cfg, optax.adam(1e-3), jax.random.PRNGKey(0))
+
+        def factory():
+            return serving.ServingEngine(
+                cfg, trainable.params, num_slots=slots,
+                max_len=cfg.max_len, prefill_len=prefill_len,
+                decode_steps=K, **engine_kwargs)
+
+        fleet = serving.ServingFleet(factory, replicas=replicas)
+        router = serving.Router(fleet)
+        r = np.random.RandomState(0)
+        t0 = time.perf_counter()
+        for _ in range(requests):
+            plen = int(r.randint(1, prefill_len - max_new + 1))
+            router.submit(
+                r.randint(0, cfg.vocab_size, (plen,)).tolist(),
+                max_new_tokens=max_new)
+        rounds = 0
+        while router._open:
+            router.step()
+            rounds += 1
+            if kill and rounds == 2 and fleet.has_replica("replica-0"):
+                fleet.inject("replica-0", "crash")
+        wall = time.perf_counter() - t0
+        done = router.completions
+        tokens = sum(len(c.tokens) for c in done.values())
+        ttfts = sorted(c.ttft_s for c in done.values())
+        p99 = float(np.percentile(np.asarray(ttfts), 99)) * 1e3
+        failovers = sum(c.failovers for c in done.values())
+        return tokens / wall if wall > 0 else 0.0, p99, failovers
+
+    try:
+        rate, ttft_p99, _ = run_mix(kill=False)
+        rate_killed, ttft_p99_killed, failovers = run_mix(kill=True)
+    except Exception as e:
+        dog.disarm()
+        if "UNAVAILABLE" in str(e) or "Connection" in str(e):
+            _unavailable_exit(f"transport: {e}")
+        print(json.dumps({
+            "metric": "serve_fleet_tokens_per_sec", "value": 0.0,
+            "unit": "tokens_per_sec", "vs_baseline": 0.0,
+            "replicas": replicas, "kv_layout": kv_layout,
+            "error": f"serve fleet bench failed: {e}",
+            "provenance": _provenance()}))
+        sys.exit(4)
+    record = {
+        "metric": "serve_fleet_tokens_per_sec", "value": round(rate, 2),
+        "unit": "tokens_per_sec", "vs_baseline": round(rate, 2),
+        "devices": n, "chip": rs.chip.name, "replicas": replicas,
+        "kv_layout": kv_layout, "requests": requests,
+        "ttft_ms_p99": round(ttft_p99, 2),
+        "ttft_ms_p99_replica_killed": round(ttft_p99_killed, 2),
+        "tokens_per_sec_replica_killed": round(rate_killed, 2),
+        "failovers_on_kill": failovers,
+        "scored": True, "provenance": _provenance(),
+    }
+    dog.disarm()
+    print(json.dumps(record), flush=True)
+    telemetry.gauge("fleet/bench_tokens_per_sec").set(rate)
+    telemetry.flush()
+
+
 def _bench_serve(dog):
     """`bench.py serve`: decode tokens/sec + TTFT through the serving
     engine, emitted as the same provenance-stamped one-line JSON record
@@ -551,7 +669,15 @@ def _bench_serve(dog):
     4x the admission slots, so the recorded
     ``serve_capacity_requests`` — the peak concurrently-admitted
     requests over a short-request mix — measures the paged capacity
-    multiplier directly against the dense run's slot ceiling."""
+    multiplier directly against the dense run's slot ceiling.
+
+    ``--replicas N`` (N > 1) switches to the fleet bench
+    (:func:`_bench_serve_fleet`): the same mix through a
+    ``ServingFleet`` + ``Router``, recorded with and without one
+    injected replica kill mid-run."""
+    replicas = _replicas_arg()
+    if replicas > 1:
+        return _bench_serve_fleet(dog, replicas)
     import jax.numpy as jnp
     import optax
 

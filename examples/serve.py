@@ -29,6 +29,66 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _serve_fleet(args, cfg, trainable):
+    """The ``--replicas N`` path: serve the request mix through a
+    fleet behind the router, then kill one replica mid-run to show the
+    failover path re-homing its in-flight requests (the fleet lint is
+    printed first, the launch-gate habit)."""
+    import time
+
+    import numpy as np
+
+    from autodist_tpu import serving, telemetry
+    from autodist_tpu.resource import ResourceSpec
+
+    def factory():
+        return serving.ServingEngine(
+            cfg, trainable.params,
+            tensor_parallel=args.tensor_parallel,
+            vocab_parallel=args.vocab_parallel, num_slots=args.slots,
+            max_len=args.max_len, prefill_len=args.prefill_len,
+            decode_steps=args.decode_steps)
+
+    fleet = serving.ServingFleet(factory, replicas=args.replicas)
+    report = fleet.lint(resource_spec=ResourceSpec(
+        {"topology": {"num_devices":
+                      max(args.replicas * args.tensor_parallel, 1)}}))
+    print(report.render("fleet lint") if not report.ok
+          else "fleet lint: clean")
+    router = serving.Router(fleet)
+    r = np.random.RandomState(7)
+    t0 = time.perf_counter()
+    rids = []
+    for _ in range(args.requests):
+        plen = int(r.randint(1, max(args.prefill_len - args.max_new, 1)
+                             + 1))
+        prompt = r.randint(0, args.vocab, (plen,)).tolist()
+        rids.append(router.submit(prompt, max_new_tokens=args.max_new))
+    router.step()
+    if fleet.has_replica("replica-0"):
+        fleet.inject("replica-0", "crash")   # the failover demo
+    done = router.run()
+    wall = time.perf_counter() - t0
+    tokens = sum(len(c.tokens) for c in done.values())
+    failovers = sum(c.failovers for c in done.values())
+    print(f"fleet served {len(done)} requests / {tokens} tokens in "
+          f"{wall:.2f}s across {args.replicas} replicas "
+          f"({failovers} failover(s) after the mid-run replica kill); "
+          f"replicas: "
+          f"{[(x.name, x.incarnation, x.state) for x in fleet.replicas]}")
+    if args.telemetry_dir:
+        telemetry.annotate(serve=True, replicas=args.replicas,
+                           requests=len(done), tokens=tokens)
+        telemetry.flush()
+    if args.smoke:
+        assert len(done) == args.requests
+        assert all(c.finish_reason in ("eos", "max_tokens", "max_len")
+                   for c in done.values())
+        acc = fleet.block_accounting()
+        assert all(u == 0 for _, u, _ in acc.values()), acc
+        print("fleet serve smoke ok")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=6)
@@ -61,6 +121,12 @@ def main():
                     help="flush serving telemetry here (per-request "
                          "serve records, TTFT/inter-token histograms, "
                          "manifest)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="> 1 serves through a ServingFleet + Router "
+                         "(N replica engine+batcher groups, queue-"
+                         "depth-aware dispatch, failover/hedging) and "
+                         "prints the fleet-objective ranking + a "
+                         "mid-run replica-kill failover demo")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CI preset: shrink everything and assert "
                          "the serve loop end to end")
@@ -110,6 +176,22 @@ def main():
               f"kv={cand.get('kv_layout', 'dense')}: "
               f"{cost.token_time_s * 1e6:.2f} us/token "
               f"(comm {cost.comm_time_s * 1e6:.2f})")
+
+    if args.replicas > 1:
+        # The fleet objective: rank (replicas x tp x kv_layout) by
+        # aggregate throughput for a short-request mix before
+        # committing devices (replicas priced across DCN, tp held
+        # within a slice's ICI).
+        fleet_ranked = rank_serving(
+            trainable, rs, objective="fleet", batch_slots=args.slots,
+            max_len=args.max_len, mean_request_len=args.max_new * 2)
+        print("fleet shapes by predicted aggregate throughput:")
+        for cand, cost in fleet_ranked[:4]:
+            print(f"  replicas={cand.get('replicas', 1)} "
+                  f"tp={cand['tensor_parallel']} "
+                  f"kv={cand.get('kv_layout', 'dense')}: "
+                  f"fleet_score={cost.fleet_score:.3e}")
+        return _serve_fleet(args, cfg, trainable)
 
     strategy = None
     if args.train_steps > 0:

@@ -21,6 +21,12 @@ the post-recovery loss trajectory matching the fault-free golden::
     # dropped — the lint_strategy --max-programs pattern)
     JAX_PLATFORMS=cpu python tools/chaos_run.py --matrix --max-scenarios 3
 
+    # the serving plane: replica_crash / replica_hang / replica_slow
+    # against a 2-replica ServingFleet behind a Router — every request
+    # must complete exactly once, token-for-token equal to the
+    # single-replica fault-free golden, with zero leaked KV blocks
+    JAX_PLATFORMS=cpu python tools/chaos_run.py --matrix --plane serving
+
 Per-kind expected outcome:
 
 =================  =====================================================
@@ -60,8 +66,14 @@ if __name__ == "__main__":  # simulated mesh before the first jax import
 # The one registry: a fault kind added to runtime/faults.py joins the
 # matrix (and this CLI's choices) automatically.
 from autodist_tpu.runtime.faults import FAULT_KINDS as FAULTS  # noqa: E402
+from autodist_tpu.runtime.faults import \
+    SERVING_FAULT_KINDS as SERVING_FAULTS  # noqa: E402
 
 SCENARIOS = ("none",) + FAULTS
+# The serving plane (--plane serving): the replica fault kinds against
+# a two-replica ServingFleet behind a Router, fixed request mix,
+# token-for-token parity vs the single-replica fault-free golden.
+SERVING_SCENARIOS = ("none",) + SERVING_FAULTS
 
 # Loss tolerance vs the fault-free golden: faults that never touch the
 # chief's math must reproduce it exactly; preempt_signal reshards onto
@@ -336,6 +348,232 @@ def _check_outcome(kind: str, tel_dir: str) -> list[str]:
 
 
 # --------------------------------------------------------------------------- #
+# The serving plane: replica faults against a 2-replica fleet
+# --------------------------------------------------------------------------- #
+# The fixed request mix every serving scenario serves (prompt,
+# max_new_tokens): short ragged prompts whose decode spans the
+# injection point, so a mid-stream failure always has in-flight
+# requests to re-home.
+SERVE_MIX = ([1, 2, 3], 8), ([4, 5], 8), ([6], 8), ([7, 8, 9], 8), \
+    ([3, 1], 8), ([2, 9, 4], 8)
+
+
+def _build_fleet(kind: str):
+    """The scenario fleet: 1 fault-free replica for the golden, 2 for
+    every fault — hedging armed only for the straggler scenario so the
+    crash/hang recoveries are unambiguously the failover path's."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+    from autodist_tpu.serving import (FleetConfig, ServingEngine,
+                                      ServingFleet)
+
+    cfg = TransformerConfig(vocab_size=33, hidden_size=16, num_layers=2,
+                            num_heads=2, mlp_dim=32, max_len=24,
+                            dtype=jnp.float32, dropout_rate=0.0,
+                            attention_dropout_rate=0.0)
+    params = make_pipeline_lm_trainable(
+        cfg, optax.sgd(0.1), jax.random.PRNGKey(0)).params
+
+    def factory():
+        return ServingEngine(cfg, params, num_slots=2, max_len=24,
+                             prefill_len=16, decode_steps=2,
+                             kv_layout="paged", kv_block_len=5)
+
+    fleet_config = FleetConfig(
+        replicas=1 if kind == "none" else 2,
+        hedge_timeout_s=0.2 if kind == "replica_slow" else None,
+        hedge_percentile=None,
+        max_replacements=1,
+        heartbeat_interval_s=0.05, heartbeat_timeout_s=0.5,
+        heartbeat_startup_grace_s=0.5)
+    return ServingFleet(factory, config=fleet_config)
+
+
+def run_serving_scenario(kind: str, tel_dir: str, out_path: str) -> int:
+    """One serving scenario: the fixed mix through a fleet under one
+    injected replica fault; every request must complete exactly once
+    with zero leaked KV blocks and a schema-clean dispatch/fault
+    trail.  Token parity vs the golden is the matrix driver's join."""
+    from autodist_tpu import telemetry
+    from autodist_tpu.runtime.faults import (FaultInjector, FaultPlan,
+                                             FaultSpec)
+    from autodist_tpu.serving import Router
+
+    telemetry.configure(out_dir=tel_dir)
+    fleet = _build_fleet(kind)
+    router = Router(fleet)
+    spec = None
+    if kind != "none":
+        spec = FaultSpec(kind, target="replica-0", at_step=2,
+                         duration_s=1.0)
+    plan = FaultPlan(faults=[spec] if spec else [], seed=1234)
+    injector = FaultInjector(plan, self_target="chief", fleet=fleet)
+    rids = [router.submit(p, max_new_tokens=m) for p, m in SERVE_MIX[:4]]
+    rnd = 0
+    while router._open or rnd < 4:
+        injector.maybe_fire(rnd)
+        if rnd == 3:   # late arrivals keep the queue live mid-fault
+            rids += [router.submit(p, max_new_tokens=m)
+                     for p, m in SERVE_MIX[4:]]
+        router.step()
+        rnd += 1
+    # A short mix can finish inside a transient fault's window (every
+    # request hedged off the straggler): keep the scheduler alive until
+    # the fault resolves — the injector.drain_pending analog; ending
+    # early would green-light a resume record that never fired.
+    while any(r._fault is not None for r in fleet.live):
+        router.step()
+        time.sleep(0.02)
+    telemetry.flush()
+    problems = _check_serving_outcome(kind, tel_dir, fleet, router, rids)
+    record = {"kind": "chaos_scenario", "plane": "serving", "fault": kind,
+              "tokens": {rid: router.completions[rid].tokens
+                         for rid in rids if rid in router.completions},
+              "finish": {rid: router.completions[rid].finish_reason
+                         for rid in rids if rid in router.completions},
+              "problems": problems, "ok": not problems}
+    with open(out_path, "w") as f:
+        json.dump(record, f)
+    print(f"chaos[serving/{kind}]: {'OK' if not problems else problems}")
+    return 0 if not problems else 1
+
+
+def _check_serving_outcome(kind, tel_dir, fleet, router, rids) -> list:
+    """Exactly-once + zero-leak + per-kind recovery shape (the
+    schema gate covers the dispatch/fault record contracts)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from telemetry_report import check_schema, load_jsonl
+
+    problems = list(check_schema(tel_dir))
+    # exactly once: every submitted request has exactly one completion,
+    # and a *decode* terminal (nothing shed/expired/stranded)
+    missing = [r for r in rids if r not in router.completions]
+    if missing:
+        problems.append(f"requests never completed: {missing}")
+    for rid in rids:
+        comp = router.completions.get(rid)
+        if comp is not None and comp.finish_reason not in (
+                "eos", "max_tokens", "max_len"):
+            problems.append(f"{rid} ended {comp.finish_reason!r}, not a "
+                            "decode terminal")
+    # zero leaked KV blocks on every live replica
+    for name, (free, used, total) in fleet.block_accounting().items():
+        if used != 0 or free != total:
+            problems.append(f"{name} leaked KV blocks: free={free} "
+                            f"used={used} total={total}")
+    records = load_jsonl(os.path.join(tel_dir, "metrics.jsonl"))
+    faults = [r for r in records if r.get("kind") == "fault"]
+    dispatches = [r for r in records if r.get("kind") == "dispatch"]
+
+    def has(phase, **kv):
+        return any(r.get("phase") == phase
+                   and all(r.get(k) == v for k, v in kv.items())
+                   for r in faults)
+
+    reasons = {r.get("reason") for r in dispatches}
+    if kind == "none":
+        if faults:
+            problems.append(f"golden run emitted fault records: {faults}")
+        if reasons - {"route"}:
+            problems.append(f"golden run dispatched non-route reasons: "
+                            f"{sorted(reasons - {'route'})}")
+        return problems
+    if not has("injected", fault=kind):
+        problems.append(f"no injected record for {kind}")
+    if kind in ("replica_crash", "replica_hang"):
+        if not has("detected", fault=kind, target="replica-0"):
+            problems.append(f"{kind}: the fleet never detected the "
+                            "dead replica")
+        if "failover" not in reasons:
+            problems.append(f"{kind}: no failover dispatch — the "
+                            "re-home path never ran")
+        if not has("recovered", fault=kind, action="replace"):
+            problems.append(f"{kind}: the dead replica was never "
+                            "replaced")
+    elif kind == "replica_slow":
+        if not has("recovered", fault=kind, action="resumed"):
+            problems.append("replica_slow: the straggler never "
+                            "recorded its resume")
+        if "hedge" not in reasons:
+            problems.append("replica_slow: no hedged dispatch — the "
+                            "straggler path never ran")
+        if has("detected", fault="replica_hang") \
+                or has("detected", fault="replica_slow"):
+            problems.append("replica_slow: a slow-but-beating replica "
+                            "was declared dead (hedging territory, "
+                            "not the health check's)")
+    return problems
+
+
+def run_serving_matrix(scenario_timeout: float,
+                       max_scenarios: int | None, out_dir: str) -> int:
+    """Golden + every serving fault kind, each subprocessed and
+    watchdogged; token-for-token parity joined against the golden."""
+    results = {}
+    golden_tokens = None
+    todo = list(SERVING_SCENARIOS)
+    skipped = []
+    if max_scenarios is not None and len(todo) > max_scenarios:
+        todo, skipped = todo[:max_scenarios], todo[max_scenarios:]
+    for kind in todo:
+        tel_dir = os.path.join(out_dir, kind)
+        out_json = os.path.join(out_dir, f"{kind}.json")
+        os.makedirs(tel_dir, exist_ok=True)
+        argv = [sys.executable, os.path.abspath(__file__),
+                "--plane", "serving", "--run-one", kind,
+                "--telemetry-dir", tel_dir, "--out", out_json]
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(argv, timeout=scenario_timeout,
+                                  env=dict(os.environ))
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            results[kind] = {"ok": False,
+                             "problems": [f"scenario hung beyond "
+                                          f"{scenario_timeout}s"]}
+            print(f"chaos[serving/{kind}]: HUNG after "
+                  f"{scenario_timeout}s")
+            continue
+        rec = {"ok": False, "problems": [f"scenario exited rc={rc} "
+                                         "with no result record"]}
+        if os.path.exists(out_json):
+            with open(out_json) as f:
+                rec = json.load(f)
+        rec["rc"] = rc
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
+        if kind == "none":
+            golden_tokens = rec.get("tokens")
+        elif golden_tokens and rec.get("tokens"):
+            # Token-for-token: a failure mode may re-route, hedge, or
+            # re-prefill a request, but the client stream must be the
+            # golden's, byte for byte.
+            for rid, want in golden_tokens.items():
+                got = rec["tokens"].get(rid)
+                if got != want:
+                    rec["ok"] = False
+                    rec.setdefault("problems", []).append(
+                        f"{rid}: tokens {got} != golden {want}")
+        results[kind] = rec
+    print("\n== serving chaos matrix ==")
+    failed = []
+    for kind, rec in results.items():
+        status = "OK" if rec.get("ok") and rec.get("rc", 1) == 0 \
+            else f"FAIL ({rec.get('problems')})"
+        print(f"  {kind:16s} {status}  [{rec.get('wall_s', '?')}s]")
+        if "OK" not in status:
+            failed.append(kind)
+    for kind in skipped:
+        print(f"  {kind:16s} SKIPPED (--max-scenarios budget)")
+    with open(os.path.join(out_dir, "matrix.json"), "w") as f:
+        json.dump(results, f, indent=1)
+    return 1 if failed else 0
+
+
+# --------------------------------------------------------------------------- #
 # The matrix driver
 # --------------------------------------------------------------------------- #
 def run_matrix(steps: int, scenario_timeout: float,
@@ -406,9 +644,14 @@ def main(argv=None) -> int:
     if const.ENV.AUTODIST_TPU_WORKER.val:
         return run_worker()   # we ARE a launched worker
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--fault", choices=SCENARIOS,
+    ap.add_argument("--plane", choices=("train", "serving"),
+                    default="train",
+                    help="which chaos plane to sweep: the LocalCluster "
+                         "training run (default) or the 2-replica "
+                         "serving fleet (replica_* fault kinds)")
+    ap.add_argument("--fault", choices=SCENARIOS + SERVING_FAULTS,
                     help="run one scenario inline")
-    ap.add_argument("--run-one", choices=SCENARIOS,
+    ap.add_argument("--run-one", choices=SCENARIOS + SERVING_FAULTS,
                     help="(internal) one scenario in this process")
     ap.add_argument("--matrix", action="store_true",
                     help="golden + every fault kind, each subprocessed "
@@ -423,13 +666,23 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.run_one or args.fault:
         kind = args.run_one or args.fault
+        plane = "serving" if kind in SERVING_FAULTS else args.plane
+        valid = SERVING_SCENARIOS if plane == "serving" else SCENARIOS
+        if kind not in valid:
+            ap.error(f"fault {kind!r} is not a --plane {plane} "
+                     f"scenario (choose from {list(valid)})")
         tel_dir = args.telemetry_dir or tempfile.mkdtemp(
             prefix=f"chaos_{kind}_")
         out = args.out or os.path.join(tel_dir, "result.json")
+        if plane == "serving":
+            return run_serving_scenario(kind, tel_dir, out)
         return run_scenario(kind, args.steps, tel_dir, out)
     if args.matrix:
         out_dir = args.telemetry_dir or tempfile.mkdtemp(prefix="chaos_")
         print(f"chaos matrix artifacts: {out_dir}")
+        if args.plane == "serving":
+            return run_serving_matrix(args.scenario_timeout,
+                                      args.max_scenarios, out_dir)
         return run_matrix(args.steps, args.scenario_timeout,
                           args.max_scenarios, out_dir)
     ap.error("pick one of --fault/--matrix")

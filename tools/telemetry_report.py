@@ -55,12 +55,24 @@ _RESHARD_KEYS = {"kind", "route", "leaves", "bytes_moved",
 # claims chaos coverage it never proved — --check fails it.
 _FAULT_KEYS = {"kind", "fault", "target", "phase"}
 _FAULT_KINDS = ("worker_crash", "worker_hang", "slow_host", "coord_drop",
-                "ckpt_write_fail", "preempt_signal")
+                "ckpt_write_fail", "preempt_signal",
+                # serving plane (fleet replicas)
+                "replica_crash", "replica_hang", "replica_slow")
 _FAULT_PHASES = ("injected", "detected", "recovered", "degraded",
                  "escalated", "teardown")
 _FAULT_TERMINAL = ("recovered", "degraded", "escalated", "teardown")
-_KINDS = ("step", "serve", "reshard", "fault", "counter", "gauge",
-          "histogram")
+# Fleet dispatch records (autodist_tpu/serving/router.py): one per
+# routing decision.  reason names why the request moved; re_emitted is
+# the at-most-once contract made auditable — the router NEVER re-emits
+# an already-streamed token, so any nonzero value is a broken stream
+# and --check fails it.  A failover record must pair with the replica
+# fault/health record the fleet emitted when it declared the source
+# replica dead — a failover with no recorded cause is a recovery path
+# that cannot be audited.
+_DISPATCH_KEYS = {"kind", "request", "replica", "reason", "re_emitted"}
+_DISPATCH_REASONS = ("route", "failover", "hedge", "drain")
+_KINDS = ("step", "serve", "reshard", "fault", "dispatch", "counter",
+          "gauge", "histogram")
 
 
 def load_jsonl(path: str) -> list[dict]:
@@ -134,6 +146,24 @@ def check_schema(run_dir: str) -> list[str]:
                     problems.append(
                         f"metrics.jsonl:{i + 1}: unknown fault phase "
                         f"{rec['phase']!r}")
+        elif kind == "dispatch":
+            missing = _DISPATCH_KEYS - set(rec)
+            if missing:
+                problems.append(
+                    f"metrics.jsonl:{i + 1}: dispatch record missing "
+                    f"{sorted(missing)}")
+            else:
+                if rec["reason"] not in _DISPATCH_REASONS:
+                    problems.append(
+                        f"metrics.jsonl:{i + 1}: unknown dispatch "
+                        f"reason {rec['reason']!r} (have "
+                        f"{list(_DISPATCH_REASONS)})")
+                if rec["re_emitted"] != 0:
+                    problems.append(
+                        f"metrics.jsonl:{i + 1}: dispatch re_emitted="
+                        f"{rec['re_emitted']!r} — the at-most-once "
+                        "contract re-emitted tokens to a client "
+                        "stream")
         elif "name" not in rec:
             problems.append(f"metrics.jsonl:{i + 1}: {kind} without name")
         elif kind == "histogram" and "count" not in rec:
@@ -160,6 +190,24 @@ def check_schema(run_dir: str) -> list[str]:
                 f"{rec['fault']}@{rec['target']} has no matching "
                 f"recovery/degrade/escalation/teardown record — the "
                 "recovery path never ran or never recorded")
+
+    # A failover dispatch must pair with the fault/health record the
+    # fleet emitted for the replica it failed AWAY from: a failover
+    # with no recorded cause is a recovery nobody can audit (and a
+    # telltale of a router re-homing healthy replicas' work).
+    dispatches = [r for r in records if r.get("kind") == "dispatch"
+                  and _DISPATCH_KEYS <= set(r)]
+    fault_targets = {r.get("target") for r in faults}
+    for rec in dispatches:
+        if rec["reason"] != "failover":
+            continue
+        src = rec.get("from_replica")
+        if src is None or src not in fault_targets:
+            problems.append(
+                f"metrics.jsonl: failover dispatch for "
+                f"{rec.get('request')} names from_replica={src!r} with "
+                "no paired fault/health record for that replica — an "
+                "unaudited failover")
 
     trace = os.path.join(run_dir, "trace.json")
     if os.path.exists(trace):
@@ -304,6 +352,7 @@ def render(run_dir: str) -> str:
     records = load_jsonl(os.path.join(run_dir, "metrics.jsonl"))
     steps = [r for r in records if r.get("kind") == "step"]
     serves = [r for r in records if r.get("kind") == "serve"]
+    dispatches = [r for r in records if r.get("kind") == "dispatch"]
     reshards = [r for r in records if r.get("kind") == "reshard"]
     faults = [r for r in records if r.get("kind") == "fault"]
     counters = [r for r in records if r.get("kind") == "counter"]
@@ -384,6 +433,40 @@ def render(run_dir: str) -> str:
                          if g["name"] == "serve/kv_blocks_used"), None)
             lines += [f"- kv block pool (final): {_fmt(used)} used / "
                       f"{_fmt(free)} free", ""]
+
+    if dispatches:
+        # The fleet section: routing decisions by reason, the hedge
+        # win rate, and each replica's final queue depth (the
+        # fleet/<name>/queue_depth gauges the router emits per round).
+        by_reason = {}
+        for r in dispatches:
+            by_reason[r.get("reason")] = by_reason.get(r.get("reason"),
+                                                       0) + 1
+        counter_vals = {r["name"]: r["value"] for r in counters}
+        hedges = counter_vals.get("fleet/hedges", 0)
+        hedge_wins = counter_vals.get("fleet/hedge_wins", 0)
+        win_rate = hedge_wins / hedges if hedges else None
+        lines += ["## fleet", "",
+                  "| dispatches | route | failover | hedge | drain | "
+                  "hedge win rate | replacements |",
+                  "|---|---|---|---|---|---|---|",
+                  f"| {len(dispatches)} "
+                  f"| {by_reason.get('route', 0)} "
+                  f"| {by_reason.get('failover', 0)} "
+                  f"| {by_reason.get('hedge', 0)} "
+                  f"| {by_reason.get('drain', 0)} "
+                  f"| {_fmt(win_rate)} "
+                  f"| {_fmt(counter_vals.get('fleet/replacements'))} |",
+                  ""]
+        depth = {g["name"]: g["value"] for g in gauges
+                 if g["name"].startswith("fleet/")
+                 and g["name"].endswith("/queue_depth")}
+        if depth:
+            lines += ["| replica | queue depth (final) |", "|---|---|"]
+            for name in sorted(depth):
+                replica = name[len("fleet/"):-len("/queue_depth")]
+                lines.append(f"| {replica} | {_fmt(depth[name])} |")
+            lines.append("")
 
     if reshards:
         lines += ["## reshards", "",
