@@ -30,6 +30,7 @@ from autodist_tpu.analysis.plan_rules import (PLAN_RULES,  # noqa: F401
                                               lint_supervision)
 from autodist_tpu.analysis.program_rules import (Rule,  # noqa: F401
                                                  check_program,
+                                                 lint_block_trace,
                                                  lint_program,
                                                  rules_for_decode,
                                                  rules_for_reshard,
@@ -40,6 +41,6 @@ __all__ = [
     "ProgramFacts", "PLAN_RULES", "degraded_diagnostics", "lint_fleet",
     "lint_plan", "lint_reshard", "lint_supervision", "Rule",
     "check_program",
-    "lint_program",
+    "lint_block_trace", "lint_program",
     "rules_for_decode", "rules_for_reshard", "rules_for_strategy",
 ]

@@ -121,6 +121,12 @@ CODES: dict[str, tuple[str, str]] = {
     "ADT114": (ERROR, "expected model-axis collectives are missing"),
     "ADT115": (ERROR, "paged decode carries a dense cache reservation "
                       "(or reads K/V without the block table)"),
+    "ADT116": (ERROR, "write through a shared (refcount > 1) block "
+                      "table entry without copy-on-write (one request "
+                      "corrupts another's cached prefix)"),
+    "ADT117": (ERROR, "pool block freed beyond its refcount (a double "
+                      "free hands the same physical block to two "
+                      "requests)"),
     "ADT120": (ERROR, "elected fused kernel missing from the compiled "
                       "program (the composed op soup survived)"),
     # --- source lint (repo AST) -------------------------------------- #

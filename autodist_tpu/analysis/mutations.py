@@ -344,6 +344,93 @@ def _fleet_mutations() -> list[FleetMutation]:
     ]
 
 
+def _block_trace_fixture() -> list:
+    """An HONEST allocator event trace: the exact sequence the serving
+    engine's prefix-caching path produces for two requests sharing a
+    3-block prompt (2 full blocks + a partial tail), CoW on the tail's
+    first decode write, then both released — every reference freed
+    exactly once, every shared write behind a copy."""
+    from autodist_tpu.serving.kv_cache import BlockAllocator
+
+    a = BlockAllocator(8)
+    b0, b1, b2 = a.alloc(3)          # request A admits: 3 novel blocks
+    a.note("write", b2)              # A's first decode fills the tail
+    a.share(b0)                      # request B: 2 full-prefix hits...
+    a.share(b1)
+    a.share(b2)                      # ...plus the partial tail
+    (r,) = a.alloc(1)                # B's CoW reserve for that tail
+    a.note("cow", b2, r)             # B's first write: copy...
+    a.free_one(b2)                   # ...drop B's ref on the shared src
+    a.note("write", r)               # ...write the private replica
+    a.note("write", b2)              # A keeps writing its own tail
+    a.free([b0, b1, b2])             # A releases
+    a.free([b0, b1, r])              # B releases
+    return list(a.events)
+
+
+@dataclasses.dataclass
+class BlockTraceMutation:
+    """Doctor an honest block-allocator event trace; the trace lint
+    must fire ``code`` on the doctored replay and stay silent on the
+    honest one."""
+
+    name: str
+    code: str
+    description: str
+    mutate: Callable  # (list[tuple]) -> list[tuple]
+    kind: str = "block_trace"
+
+    def run(self) -> dict:
+        from autodist_tpu.analysis.program_rules import lint_block_trace
+
+        events = _block_trace_fixture()
+        clean = lint_block_trace(events, where=self.name)
+        mutated = lint_block_trace(self.mutate(list(events)),
+                                   where=self.name)
+        return {"name": self.name, "kind": self.kind, "code": self.code,
+                "clean_ok": self.code not in clean.codes(),
+                "fired": self.code in mutated.codes(),
+                "description": self.description}
+
+
+def _block_trace_mutations() -> list[BlockTraceMutation]:
+    def drop_cow(t):
+        # The engine skips _cow_protect: the copy and the ref-drop
+        # vanish and the write lands on the still-shared source.
+        i = t.index(("cow", 2, 3))
+        return t[:i] + [("write", 2)] + t[i + 3:] \
+            + [("free", 2), ("free", 3)]
+
+    def double_free(t):
+        # release_slot runs twice for the same request (the failover /
+        # hedging-loser race the chaos matrix hunts).
+        return t + [("free", 0), ("free", 1)]
+
+    def stale_write(t):
+        # a decode write lands after the slot released its blocks.
+        return t + [("write", 2)]
+
+    return [
+        BlockTraceMutation(
+            "shared_block_written_without_cow", "ADT116",
+            "the copy-on-write step is skipped — a decode write lands "
+            "on a refcount-2 shared prefix block and the other "
+            "holder's cached tokens silently change",
+            drop_cow),
+        BlockTraceMutation(
+            "pool_block_double_freed", "ADT117",
+            "a request's blocks are freed twice (the failover / "
+            "hedge-loser double-release) — the pool would hand a "
+            "still-mapped physical block to the next admission",
+            double_free),
+        BlockTraceMutation(
+            "stale_table_entry_written", "ADT116",
+            "a decode write lands through a table entry whose block "
+            "was already released (stale mapping outliving the slot)",
+            stale_write),
+    ]
+
+
 def _reshard_mutations() -> list[ReshardMutation]:
     def drop_leaf(src, dst):
         dst["leaves"].pop("params/b")
@@ -809,7 +896,7 @@ def _program_mutations() -> list[ProgramMutation]:
 def all_mutations() -> list:
     return (_plan_mutations() + _program_mutations()
             + _reshard_mutations() + _supervision_mutations()
-            + _fleet_mutations())
+            + _fleet_mutations() + _block_trace_mutations())
 
 
 def run_mutations(names=None, kinds=None) -> list[dict]:

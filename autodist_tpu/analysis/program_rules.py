@@ -64,6 +64,104 @@ def lint_program(hlo_text: str, rules, where: str = "") -> LintReport:
                          where=where)
 
 
+def lint_block_trace(events, where: str = "block-trace") -> LintReport:
+    """Replay a :class:`~autodist_tpu.serving.kv_cache.BlockAllocator`
+    event trace against the copy-on-write sharing contract (the PR-16
+    prefix-caching rung's runtime artifact — the serving analog of a
+    compiled program, linted by the same diagnostic vocabulary).
+
+    Trace grammar (each event a tuple, first element the kind):
+
+    * ``("alloc", b)`` / ``("share", b)`` / ``("free", b)`` — the
+      allocator's own refcount movements;
+    * ``("write", b)`` — the engine is about to write K/V positions
+      into physical block ``b`` (noted per protected decode span);
+    * ``("cow", src, dst)`` — the engine copied shared ``src`` into
+      privately-held ``dst`` and redirected its table row.
+
+    Two rules:
+
+    * **ADT116** — a ``write`` lands on a block whose replayed refcount
+      is > 1 (a shared prefix written in place: the OTHER holder's
+      cached tokens silently change) or 0 (a stale table entry outlives
+      its block's release);
+    * **ADT117** — a ``free`` or ``share`` on a block whose replayed
+      refcount is already 0: the double-free that puts one physical
+      block on the free list while a table row still maps it — the
+      next admission gets handed memory another request is decoding
+      through.
+    """
+    rc: dict = {}
+    out = []
+    for i, ev in enumerate(events):
+        kind = ev[0]
+        b = ev[1] if len(ev) > 1 else None
+        if kind == "alloc":
+            if rc.get(b, 0) > 0:
+                out.append(Diagnostic(
+                    "ADT117",
+                    f"event {i}: alloc handed out block {b} while its "
+                    f"refcount is still {rc[b]} — a prior double-free "
+                    "put a live block back on the free list",
+                    where=where, rule="block_cow_trace",
+                    fix="free exactly once per reference; route every "
+                        "release through BlockAllocator.free_one"))
+            rc[b] = 1
+        elif kind == "share":
+            if rc.get(b, 0) < 1:
+                out.append(Diagnostic(
+                    "ADT117",
+                    f"event {i}: share of block {b} which is not live "
+                    "(refcount 0) — a prefix-index entry outlived its "
+                    "block's release",
+                    where=where, rule="block_cow_trace",
+                    fix="deregister prefix keys when the last "
+                        "reference drops (the _block_keys reverse "
+                        "map)"))
+            else:
+                rc[b] += 1
+        elif kind == "free":
+            if rc.get(b, 0) < 1:
+                out.append(Diagnostic(
+                    "ADT117",
+                    f"event {i}: free of block {b} whose refcount is "
+                    "already 0 — double free (the pool would hand the "
+                    "same physical block to two requests)",
+                    where=where, rule="block_cow_trace",
+                    fix="drop exactly one reference per holder; a "
+                        "shared block's LAST holder frees it"))
+            else:
+                rc[b] -= 1
+                if rc[b] == 0:
+                    del rc[b]
+        elif kind == "write":
+            n = rc.get(b, 0)
+            if n > 1:
+                out.append(Diagnostic(
+                    "ADT116",
+                    f"event {i}: write to block {b} at refcount {n} "
+                    "without copy-on-write — the other "
+                    f"{n - 1} holder(s)' cached prefix silently "
+                    "changes under them",
+                    where=where, rule="block_cow_trace",
+                    fix="copy the shared block into a private one and "
+                        "redirect the writer's table row before the "
+                        "write (the engine's _cow_protect)"))
+            elif n == 0:
+                out.append(Diagnostic(
+                    "ADT116",
+                    f"event {i}: write to block {b} which is not live "
+                    "(refcount 0) — a stale table entry outlived its "
+                    "block's release",
+                    where=where, rule="block_cow_trace",
+                    fix="clear the slot's table row on release_slot "
+                        "before the block recycles"))
+        # ("cow", src, dst) moves no references: dst was privately
+        # alloc'd into the reserve earlier and src's drop is the
+        # explicit ("free", src) the engine logs right after.
+    return LintReport(out)
+
+
 # --------------------------------------------------------------------------- #
 # Rule factories
 # --------------------------------------------------------------------------- #

@@ -36,10 +36,12 @@ from __future__ import annotations
 
 # The Strategy IR's kernel-slot vocabulary (strategy/ir.py
 # normalize_kernel re-exports this; kernel code stays IR-agnostic).
-KERNEL_CHOICES = ("flash_decode", "quant_ring", "collective_matmul")
+KERNEL_CHOICES = ("flash_decode", "flash_prefill", "quant_ring",
+                  "collective_matmul")
 
 # Kernels that change the *training* program (the pipeline lowering
-# honors them); flash_decode is serving-side (the decode program).
+# honors them); flash_decode/flash_prefill are serving-side (the
+# decode and chunked-prefill programs).
 TRAINING_KERNELS = ("quant_ring", "collective_matmul")
 
 # Op-metadata marker prefix: `with jax.named_scope(kernel_marker(name))`
@@ -72,6 +74,10 @@ def __getattr__(name):
         from autodist_tpu.kernel.pallas.flash_decode import \
             flash_decode_attention
         return flash_decode_attention
+    if name == "flash_prefill_attention_paged":
+        from autodist_tpu.kernel.pallas.flash_prefill import \
+            flash_prefill_attention_paged
+        return flash_prefill_attention_paged
     if name == "quantized_ring_all_reduce":
         from autodist_tpu.kernel.pallas.quant_ring import \
             quantized_ring_all_reduce

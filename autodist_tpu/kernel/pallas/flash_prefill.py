@@ -1,0 +1,145 @@
+"""Paged flash prefill: a prompt chunk's causal attention over the
+block table — the kernel-tier item's prefill half.
+
+Chunked prefill (``serving/engine.py``) writes a prompt ``C`` tokens at
+a time through the block table and needs every chunk row to attend over
+ALL cache so far: earlier chunks, prefix-cache hit blocks, and the
+chunk's own rows (written first — the decode step's write-then-attend
+ordering).  The composed fallback
+(``serving/kv_cache.paged_chunk_attention``) gathers the slot's blocks
+into a contiguous ``[B, heads, max_blocks·block_len, head_dim]`` lane
+and materializes a ``[B, heads, C, T]`` score tensor — three HBM-shaped
+passes over the cache per layer per chunk.  This kernel walks the pool
+block-by-block exactly like the paged flash decode: the grid's
+innermost dimension is the logical block index, the scalar-prefetched
+block table routes one ``[block_len, d]`` pool tile into VMEM per step,
+and the online-softmax carry — now ``[C, 1]`` running max/sum and a
+``[C, d]`` accumulator, one row per chunk query — persists across the
+block walk in VMEM scratch.
+
+Masking is the causal chunk rule: chunk row ``r`` of slot ``b`` sits at
+absolute position ``starts[b] + r`` and sees key positions
+``<= starts[b] + r``.  Position 0 is visible to every row, so the
+running max is finite from block 0 on and fully-masked later blocks
+contribute ``exp(NEG_INF - finite) == 0`` — the same guarantee the
+decode kernel leans on.  Per-slot ``starts`` (not one scalar) let the
+speculative verify pass reuse the kernel, where each slot's window
+begins at its own length.
+
+Interpreter mode off-TPU (``default_interpret``); the parity golden
+pins this kernel against the composed gather path token-for-token.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from autodist_tpu.kernel.pallas import default_interpret, kernel_marker
+
+NEG_INF = float(np.finfo(np.float32).min)
+
+
+def _paged_prefill_kernel(start_ref, tab_ref, q_ref, k_ref, v_ref,
+                          o_ref, m_ref, s_ref, acc_ref, *,
+                          block_len: int, chunk: int, scale: float,
+                          out_dtype):
+    """One (slot, head, logical-block) program: ``C`` chunk queries
+    against one pool block, online-softmax carries keyed per row."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    d = q_ref.shape[-1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        s_ref[...] = jnp.zeros_like(s_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    start = start_ref[b, 0]
+    q = q_ref[...].reshape(chunk, d).astype(jnp.float32)
+    kblk = k_ref[...].reshape(block_len, d).astype(jnp.float32)
+    scores = jax.lax.dot_general(
+        q, kblk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale    # [C, bl]
+    idx = j * block_len + jax.lax.broadcasted_iota(
+        jnp.int32, (chunk, block_len), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (chunk, block_len), 0)
+    scores = jnp.where(idx <= start + row, scores, NEG_INF)
+    m, s, acc = m_ref[...], s_ref[...], acc_ref[...]
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_new)                         # [C, 1]
+    p = jnp.exp(scores - m_new)                        # [C, bl]
+    vblk = v_ref[...].reshape(block_len, d).astype(jnp.float32)
+    m_ref[...] = m_new
+    s_ref[...] = s * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc * alpha + jax.lax.dot_general(
+        p, vblk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [C, d]
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _emit():
+        # Position 0 is visible to every chunk row, so s > 0 rowwise.
+        o_ref[...] = (acc_ref[...] / s_ref[...]) \
+            .reshape(o_ref.shape).astype(out_dtype)
+
+
+def flash_prefill_attention_paged(q, k_pool, v_pool, starts, block_table,
+                                  *, block_len: int, dtype=jnp.float32,
+                                  interpret: Optional[bool] = None):
+    """Drop-in fused replacement for :func:`autodist_tpu.serving.
+    kv_cache.paged_chunk_attention` — the paged-cache flash prefill.
+
+    ``q``: ``[B, C, heads, head_dim]`` (one chunk's queries);
+    ``k_pool``/``v_pool``: one layer's ``[num_blocks, heads, block_len,
+    head_dim]`` pool slice; ``starts``: ``[B]`` int32 absolute position
+    of each slot's chunk row 0; ``block_table``: ``[B, max_blocks]``
+    int32.  Returns ``[B, C, heads, head_dim]`` in ``dtype``.
+
+    No gather, no ``[B, heads, C, T]`` score tensor: the VMEM working
+    set is one ``[block_len, d]`` tile per operand plus the ``[C, d]``
+    carry, independent of pool size.
+    """
+    B, C, H, d = q.shape
+    mb = block_table.shape[1]
+    interp = default_interpret() if interpret is None else bool(interpret)
+    scale = 1.0 / float(np.sqrt(d))
+
+    q2 = jnp.swapaxes(q, 1, 2)                 # [B, H, C, d]
+    start2d = starts.astype(jnp.int32).reshape(B, 1)
+    tab = block_table.astype(jnp.int32)
+
+    kern = functools.partial(_paged_prefill_kernel, block_len=block_len,
+                             chunk=C, scale=scale, out_dtype=dtype)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                 # start2d, tab (SMEM)
+        grid=(B, H, mb),
+        in_specs=[
+            pl.BlockSpec((1, 1, C, d),
+                         lambda b, h, j, st, t: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_len, d),
+                         lambda b, h, j, st, t: (t[b, j], h, 0, 0)),
+            pl.BlockSpec((1, 1, block_len, d),
+                         lambda b, h, j, st, t: (t[b, j], h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, C, d),
+                               lambda b, h, j, st, t: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((C, 1), jnp.float32),   # running max per row
+            pltpu.VMEM((C, 1), jnp.float32),   # running sum per row
+            pltpu.VMEM((C, d), jnp.float32),   # accumulator per row
+        ],
+    )
+    with jax.named_scope(kernel_marker("flash_prefill")):
+        out = pl.pallas_call(
+            kern,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((B, H, C, d), dtype),
+            interpret=interp,
+        )(start2d, tab, q2, k_pool, v_pool)
+    return jnp.swapaxes(out, 1, 2)             # [B, C, H, d]

@@ -30,7 +30,8 @@ Typical use (see ``docs/usage/serving.md`` / ``examples/serve.py``)::
 from autodist_tpu.serving.batcher import (FINISH_REASONS, Completion,
                                           ContinuousBatcher,
                                           OverloadedError, Request)
-from autodist_tpu.serving.engine import ServingEngine, serving_param_specs
+from autodist_tpu.serving.engine import (DecodeWindow, ServingEngine,
+                                         serving_param_specs)
 from autodist_tpu.serving.fleet import (FleetConfig, FleetDrainedError,
                                         Replica, ReplicaCrashedError,
                                         ServingFleet)
@@ -39,14 +40,14 @@ from autodist_tpu.serving.kv_cache import (BlockAllocator, KVCache,
                                            PoolExhaustedError, init_cache,
                                            init_paged_cache)
 from autodist_tpu.serving.router import (DISPATCH_REASONS, FleetCompletion,
-                                         Router)
+                                         PromptBudgetError, Router)
 
 __all__ = [
     "ServingEngine", "ContinuousBatcher", "Request", "Completion",
-    "FINISH_REASONS", "OverloadedError",
+    "FINISH_REASONS", "OverloadedError", "DecodeWindow",
     "KVCache", "init_cache", "serve", "serving_param_specs",
     "PagedKVCache", "init_paged_cache", "BlockAllocator",
-    "PoolExhaustedError",
+    "PoolExhaustedError", "PromptBudgetError",
     "ServingFleet", "FleetConfig", "Replica", "Router",
     "FleetCompletion", "DISPATCH_REASONS", "ReplicaCrashedError",
     "FleetDrainedError",

@@ -87,6 +87,14 @@ class Completion:
     queue_wait_s: float          # submit -> slot admission
     decode_s: float              # first token -> last token
     inter_token_ms: list         # per-token latency (window/K attributed)
+    # Throughput-ladder facts (all zero off the respective rungs):
+    # pool blocks the request's prefix shared instead of allocating,
+    # draft tokens proposed/accepted across its windows, and prefill
+    # dispatches its prompt took (1 single-shot; ceil(len/C) chunked).
+    prefix_hit_blocks: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    prefill_chunks: int = 1
 
     @property
     def tokens_per_sec(self) -> Optional[float]:
@@ -103,6 +111,10 @@ class _Slot:
     first_tok_s: float
     inter_token_ms: list
     done: Optional[str] = None   # finish reason once terminal
+    prefix_hit_blocks: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    prefill_chunks: int = 1
 
 
 class ContinuousBatcher:
@@ -142,10 +154,14 @@ class ContinuousBatcher:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
-        if len(prompt) > self.engine.prefill_len:
+        cap = getattr(self.engine, "max_prompt_tokens",
+                      self.engine.prefill_len)
+        if len(prompt) > cap:
             raise ValueError(
                 f"prompt of {len(prompt)} tokens exceeds the engine's "
-                f"prefill_len={self.engine.prefill_len}")
+                f"admissible {cap} (prefill_len="
+                f"{self.engine.prefill_len}; chunked prefill lifts the "
+                "bucket to the whole context)")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if deadline_s is not None and deadline_s <= 0:
@@ -258,28 +274,37 @@ class ContinuousBatcher:
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free or not self._queue:
             return
-        B, S = self.engine.num_slots, self.engine.prefill_len
+        B = self.engine.num_slots
+        S = getattr(self.engine, "max_prompt_tokens",
+                    self.engine.prefill_len)
         prompts = np.zeros((B, S), np.int32)
         p_lens = np.ones((B,), np.int32)
         admit = np.zeros((B,), bool)
         seeds = np.zeros((B,), np.int32)
-        taken: list[tuple[int, Request]] = []
+        taken: list[tuple[int, Request, int]] = []
         for i in free:
             if not self._queue:
                 break
             head = self._queue[0]
+            # Prefix caching prices the head's prompt at its NOVEL
+            # suffix: shared leading blocks are free, so an engine
+            # whose pool is full of popular prefixes still admits.
             needed = self.engine.blocks_needed(len(head.prompt),
-                                               head.max_new_tokens)
+                                               head.max_new_tokens,
+                                               prompt=head.prompt)
             if needed > self.engine.free_blocks:
                 break   # pool-bound: the head request waits its turn
             req = self._queue.popleft()
-            self.engine.reserve_slot(i, len(req.prompt),
-                                     req.max_new_tokens)
+            hits = self.engine.reserve_slot(i, len(req.prompt),
+                                            req.max_new_tokens,
+                                            prompt=req.prompt) or 0
+            if hits:
+                telemetry.counter("serve/prefix_hit_blocks").inc(hits)
             prompts[i, :len(req.prompt)] = req.prompt
             p_lens[i] = len(req.prompt)
             admit[i] = True
             seeds[i] = req.seed
-            taken.append((i, req))
+            taken.append((i, req, hits))
         telemetry.gauge("serve/queue_depth").set(len(self._queue))
         if not taken:
             return
@@ -295,15 +320,19 @@ class ContinuousBatcher:
             # forever in a batcher that outlives the error.  Requests
             # go back to the queue head (original order) so a
             # router-side drain/failover can re-dispatch them.
-            for i, req in reversed(taken):
+            for i, req, _hits in reversed(taken):
                 self.engine.release_slot(i)
                 self._queue.appendleft(req)
             telemetry.gauge("serve/queue_depth").set(len(self._queue))
             raise
         t_first = time.perf_counter()
-        for i, req in taken:
+        chunk = getattr(self.engine, "prefill_chunk", None)
+        for i, req, hits in taken:
             slot = _Slot(req=req, tokens=[int(toks[i])], admitted_s=now,
-                         first_tok_s=t_first, inter_token_ms=[])
+                         first_tok_s=t_first, inter_token_ms=[],
+                         prefix_hit_blocks=hits,
+                         prefill_chunks=(-(-len(req.prompt) // chunk)
+                                         if chunk else 1))
             ttft = t_first - req.submit_s
             telemetry.histogram("serve/ttft_ms").observe(ttft * 1e3)
             telemetry.counter("serve/tokens").inc()
@@ -333,7 +362,9 @@ class ContinuousBatcher:
 
     def _finish(self, req: Request, *, tokens: list, reason: str,
                 ttft_s: float, queue_wait_s: float, decode_s: float,
-                inter_token_ms: list) -> Completion:
+                inter_token_ms: list, prefix_hit_blocks: int = 0,
+                spec_proposed: int = 0, spec_accepted: int = 0,
+                prefill_chunks: int = 1) -> Completion:
         """The ONE completion path: record, count, and file the
         :class:`Completion` — used by slot eviction, queued-deadline
         expiry, and drain shedding alike, so every request that ever
@@ -343,7 +374,11 @@ class ContinuousBatcher:
         comp = Completion(
             rid=req.rid, tokens=list(tokens), finish_reason=reason,
             ttft_s=ttft_s, queue_wait_s=queue_wait_s, decode_s=decode_s,
-            inter_token_ms=list(inter_token_ms))
+            inter_token_ms=list(inter_token_ms),
+            prefix_hit_blocks=int(prefix_hit_blocks),
+            spec_proposed=int(spec_proposed),
+            spec_accepted=int(spec_accepted),
+            prefill_chunks=int(prefill_chunks))
         self.completions[req.rid] = comp
         telemetry.counter("serve/requests").inc()
         itl = np.asarray(comp.inter_token_ms) if comp.inter_token_ms \
@@ -359,7 +394,11 @@ class ContinuousBatcher:
                                 if itl is not None else None),
             inter_token_p99_ms=(float(np.percentile(itl, 99))
                                 if itl is not None else None),
-            tokens_per_sec=comp.tokens_per_sec)
+            tokens_per_sec=comp.tokens_per_sec,
+            prefix_hit_blocks=comp.prefix_hit_blocks,
+            spec_proposed=comp.spec_proposed,
+            spec_accepted=comp.spec_accepted,
+            prefill_chunks=comp.prefill_chunks)
         return comp
 
     def _evict(self, i: int):
@@ -375,7 +414,11 @@ class ContinuousBatcher:
                      ttft_s=slot.first_tok_s - req.submit_s,
                      queue_wait_s=slot.admitted_s - req.submit_s,
                      decode_s=t_end - slot.first_tok_s,
-                     inter_token_ms=slot.inter_token_ms)
+                     inter_token_ms=slot.inter_token_ms,
+                     prefix_hit_blocks=slot.prefix_hit_blocks,
+                     spec_proposed=slot.spec_proposed,
+                     spec_accepted=slot.spec_accepted,
+                     prefill_chunks=slot.prefill_chunks)
 
     def _decode_window(self):
         """One fused decode dispatch; distribute tokens, evict terminal
@@ -387,14 +430,30 @@ class ContinuousBatcher:
         K = self.engine.decode_steps
         t0 = time.perf_counter()
         with telemetry.span("serve/decode", tokens=int(active.sum()) * K):
-            toks = self.engine.decode(active)      # [K, B]
+            if hasattr(self.engine, "decode_window"):
+                w = self.engine.decode_window(active)
+                toks, counts = w.tokens, w.counts
+                proposed, accepted = w.spec_proposed, w.spec_accepted
+            else:
+                # Minimal engines (test doubles) expose only decode().
+                toks = self.engine.decode(active)
+                counts = np.where(active, K, 0)
+                proposed = accepted = np.zeros_like(counts)
         dt = time.perf_counter() - t0
-        per_tok_ms = dt / K * 1e3
+        per_tok_ms = dt / max(int(np.max(counts)), 1) * 1e3
         for i, slot in enumerate(self._slots):
             if slot is None or not active[i]:
                 continue
             before = len(slot.tokens)
-            slot.tokens.extend(int(toks[k, i]) for k in range(K))
+            slot.tokens.extend(int(toks[k, i])
+                               for k in range(int(counts[i])))
+            slot.spec_proposed += int(proposed[i])
+            slot.spec_accepted += int(accepted[i])
+            if proposed[i]:
+                telemetry.counter("serve/spec_proposed").inc(
+                    int(proposed[i]))
+                telemetry.counter("serve/spec_accepted").inc(
+                    int(accepted[i]))
             self._check_terminal(i)
             # Only tokens the request actually keeps count: a window's
             # over-decode past EOS/budget is discarded above, and the

@@ -29,6 +29,7 @@ the entry-point conveniences.
 """
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import jax
@@ -44,6 +45,22 @@ from autodist_tpu.parallel.tensor import (column_parallel,
                                           row_parallel, vocab_pad,
                                           vocab_parallel_embedding,
                                           vocab_parallel_greedy_token)
+
+
+@dataclasses.dataclass
+class DecodeWindow:
+    """One decode window's host-visible outcome — the batcher's unit of
+    emission.  ``tokens`` is ``[n, B]`` with column ``i`` valid through
+    ``counts[i]`` (vanilla windows emit a fixed ``decode_steps`` per
+    active slot; speculative windows emit ``accepted + 1`` — variable,
+    but never zero for an active slot, so forward progress is
+    unconditional).  ``spec_proposed``/``spec_accepted`` feed the
+    acceptance-rate telemetry; both all-zero on vanilla windows."""
+
+    tokens: np.ndarray
+    counts: np.ndarray
+    spec_proposed: np.ndarray
+    spec_accepted: np.ndarray
 
 
 def serving_param_specs(params, tp: int, vocab_parallel: bool):
@@ -90,7 +107,10 @@ def seed_engine_kwargs(engine_kwargs: dict, strategy) -> dict:
     Strategy-IR serving knob cannot be seeded by one path and missed by
     another."""
     if strategy is not None:
-        from autodist_tpu.strategy.ir import normalize_kv_layout
+        from autodist_tpu.strategy.ir import (normalize_kv_layout,
+                                              normalize_prefill_chunk,
+                                              normalize_prefix_caching,
+                                              normalize_speculative)
 
         par = strategy.graph_config.parallel or {}
         engine_kwargs.setdefault(
@@ -100,6 +120,20 @@ def seed_engine_kwargs(engine_kwargs: dict, strategy) -> dict:
         engine_kwargs.setdefault("comm_overlap", par.get("comm_overlap"))
         engine_kwargs.setdefault(
             "kv_layout", normalize_kv_layout(par.get("kv_layout")))
+        # The throughput-ladder knobs (PR 16) ride the same parallel
+        # record; all three normalize to OFF when absent, so pre-PR-16
+        # strategies seed exactly the pre-PR-16 engine.  A speculative
+        # election still needs the caller to hand the engine its draft
+        # model (draft_cfg/draft_params) — the IR records the decision,
+        # not the weights.
+        engine_kwargs.setdefault(
+            "prefill_chunk",
+            normalize_prefill_chunk(par.get("prefill_chunk")))
+        engine_kwargs.setdefault(
+            "prefix_caching",
+            normalize_prefix_caching(par.get("prefix_caching")))
+        engine_kwargs.setdefault(
+            "speculative", normalize_speculative(par.get("speculative")))
         kern = getattr(strategy.graph_config, "kernel", None)
         if kern:
             engine_kwargs.setdefault("kernel", dict(kern))
@@ -151,9 +185,16 @@ class ServingEngine:
                  kv_block_len: Optional[int] = None,
                  kv_num_blocks: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_caching: bool = False,
+                 speculative: Optional[int] = None,
+                 draft_cfg=None, draft_params=None,
                  devices=None):
         from autodist_tpu.strategy.ir import (normalize_kernel,
-                                              normalize_kv_layout)
+                                              normalize_kv_layout,
+                                              normalize_prefill_chunk,
+                                              normalize_prefix_caching,
+                                              normalize_speculative)
 
         self.cfg = cfg
         # The fused-kernel election (Strategy IR kernel slot): only
@@ -227,6 +268,31 @@ class ServingEngine:
                 f"kv_num_blocks={self.kv_num_blocks} cannot hold even "
                 f"one full-length request ({self.max_blocks} blocks of "
                 f"{self.kv_block_len})")
+        # ---- throughput-ladder knobs (PR 16): chunked prefill, prefix
+        # caching, speculative decoding — all Strategy-IR seeded ---------
+        self.prefill_chunk = normalize_prefill_chunk(prefill_chunk)
+        if self.prefill_chunk is not None:
+            if self.kv_layout != "paged":
+                raise ValueError(
+                    "prefill_chunk writes prompt chunks through the "
+                    "block table — it requires kv_layout='paged'")
+            if self.prefill_chunk % self.kv_block_len:
+                raise ValueError(
+                    f"prefill_chunk={self.prefill_chunk} must be a "
+                    f"multiple of kv_block_len={self.kv_block_len} so "
+                    "chunk writes stay block-granular")
+        self.prefix_caching = normalize_prefix_caching(prefix_caching)
+        if self.prefix_caching and self.kv_layout != "paged":
+            raise ValueError(
+                "prefix_caching shares physical pool blocks — it "
+                "requires kv_layout='paged'")
+        self.speculative = normalize_speculative(speculative)
+        if self.speculative is not None \
+                and (draft_cfg is None or draft_params is None):
+            raise ValueError(
+                "speculative decoding needs a draft model: pass "
+                "draft_cfg and draft_params (the Strategy IR records "
+                "the k election, not the weights)")
         # ---- sampling rung (temperature == 0 is the exact greedy
         # program: the sampler is never traced, so the compiled decode
         # stays bit-identical to the greedy goldens) ----------------------
@@ -282,6 +348,21 @@ class ServingEngine:
             self._table = np.zeros((self.num_slots, self.max_blocks),
                                    np.int32)
             self._slot_blocks: list = [[] for _ in range(self.num_slots)]
+            # Prefix-cache state: block-content keys -> ready physical
+            # block (registered only AFTER the owning prefill dispatch
+            # wrote it — a same-batch sibling must never share an
+            # unwritten block), the reverse map for retirement at
+            # refcount 0, per-slot novel-write floor and hit telemetry,
+            # registrations pending the prefill, and the CoW reserve
+            # pool: one pre-allocated replacement block per extra
+            # reference on a shared *partial-tail* block, so a
+            # copy-on-write can never hit an exhausted pool mid-stream.
+            self._prefix_index: dict = {}
+            self._block_keys: dict = {}
+            self._pending_register: dict = {}
+            self._cow_reserve: dict = {}
+            self._write_from = np.zeros((self.num_slots,), np.int32)
+            self._slot_hits = np.zeros((self.num_slots,), np.int32)
             if self.mesh is not None:
                 csh = NamedSharding(self.mesh, kv_cache.cache_spec())
                 rep = NamedSharding(self.mesh, P())
@@ -306,14 +387,45 @@ class ServingEngine:
                         cache.lengths, NamedSharding(self.mesh, P())))
         self.cache = cache
 
-        self._prefill_jit = self._build_prefill()
+        self._prefill_jit = (self._build_chunk_prefill()
+                             if self.prefill_chunk is not None
+                             else self._build_prefill())
         self._decode_jit = self._build_decode()
-        if self.kernel.get("flash_decode"):
+        self._decode1_jit = None           # lazy K=1 program (catch-up)
+        self._copy_block_jit = None        # lazy CoW device copy
+        self.last_prefill_chunks = 0
+
+        # ---- speculative draft: a nested engine sharing the cache
+        # layout (same block scheme, own pool/params), run unsharded —
+        # the draft is small by construction and its choices are shard-
+        # invariant anyway (the gumbel keys are (seed, position)) -------
+        self.draft = None
+        if self.speculative is not None:
+            if draft_cfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size={draft_cfg.vocab_size} must "
+                    f"match the target's {cfg.vocab_size} — accept/"
+                    "reject compares token ids")
+            self.draft = ServingEngine(
+                draft_cfg, draft_params, tensor_parallel=1,
+                vocab_parallel=False, num_slots=self.num_slots,
+                max_len=self.max_len, prefill_len=self.prefill_len,
+                decode_steps=self.speculative, kv_layout=self.kv_layout,
+                kv_block_len=self.kv_block_len,
+                temperature=self.temperature, top_k=self.top_k,
+                prefill_chunk=self.prefill_chunk)
+            self._spec_verify_jit = self._build_spec_verify()
+            self._spec_catch = np.zeros((self.num_slots,), bool)
+            self._spec_catch_tok = np.zeros((self.num_slots,), np.int32)
+
+        gauges = {k: True for k in ("flash_decode", "flash_prefill")
+                  if self.kernel.get(k)}
+        if gauges:
             # The serving-side kernel/<name>_elected gauge (the pipeline
             # lowering emits the training kernels' gauges) — schema-
             # gated by `tools/telemetry_report.py --check`.
             from autodist_tpu.parallel._spmd import emit_kernel_gauges
-            emit_kernel_gauges({"flash_decode": True})
+            emit_kernel_gauges(gauges)
 
     # ------------------------------------------------------------------ #
     # constructors from the training stack
@@ -419,6 +531,54 @@ class ServingEngine:
                          model_axis=axis, comm_overlap=overlap)
         return _flax_layer_norm(x + m, chunk["ln_mlp"], dtype), kc, vc
 
+    def _layer_chunk(self, chunk, x, kc, vc, layer, starts, table, write):
+        """One encoder layer for a ``[B, C]`` token *window* against the
+        live cache — the shape chunked prefill and the speculative
+        verify pass share.  Project the window's qkv, hand k/v to the
+        caller's ``write`` (block-granular for prompt chunks,
+        token-granular for the verify window), then attend the window's
+        queries over the cache — which now holds every earlier position
+        AND this window's own rows (write-then-attend, the decode
+        step's ordering), masked causally at ``key <= starts + row``."""
+        from autodist_tpu.models.pipeline_lm import _flax_layer_norm
+
+        cfg, axis, overlap = self.cfg, self._axis, self.comm_overlap
+        dtype = cfg.dtype
+        att = chunk["attention"]
+        x = x.astype(dtype)
+        qkv = column_parallel(x, att["qkv"]["kernel"].astype(dtype),
+                              att["qkv"]["bias"].astype(dtype),
+                              model_axis=axis, comm_overlap=overlap)
+        q, k, v = jnp.moveaxis(qkv, -3, 0)          # [B, C, heads, dh]
+        kc, vc = write(kc, vc, k, v)
+        if table is not None:
+            bl = self.kv_block_len
+            if self.kernel.get("flash_prefill"):
+                from autodist_tpu.kernel.pallas.flash_prefill import \
+                    flash_prefill_attention_paged
+                out = flash_prefill_attention_paged(
+                    q, kc[layer], vc[layer], starts, table,
+                    block_len=bl, dtype=dtype)
+            else:
+                out = kv_cache.paged_chunk_attention(
+                    q, kc[layer], vc[layer], starts, table,
+                    block_len=bl, dtype=dtype)
+        else:
+            out = kv_cache.chunk_attention(q, kc[layer], vc[layer],
+                                           starts, dtype=dtype)
+        a = row_parallel(out, att["out"]["kernel"].astype(dtype),
+                         att["out"]["bias"].astype(dtype),
+                         model_axis=axis, axes=2, comm_overlap=overlap)
+        x = _flax_layer_norm(x + a, chunk["ln_attention"], dtype)
+        h = column_parallel(x, chunk["mlp"]["wi"]["kernel"].astype(dtype),
+                            chunk["mlp"]["wi"]["bias"].astype(dtype),
+                            model_axis=axis, comm_overlap=overlap)
+        h = jax.nn.gelu(h)
+        m = row_parallel(h, chunk["mlp"]["wo"]["kernel"].astype(dtype),
+                         chunk["mlp"]["wo"]["bias"].astype(dtype),
+                         model_axis=axis, comm_overlap=overlap)
+        return _flax_layer_norm(x + m, chunk["ln_mlp"], dtype), kc, vc
+
     def _greedy(self, shared, h):
         """Next token from ``[B, H]`` last-position hidden states (the
         training loss head's ``_layer_norm`` + tied unembedding)."""
@@ -473,9 +633,14 @@ class ServingEngine:
     def _build_prefill(self):
         L, S = self.cfg.num_layers, self.prefill_len
         paged = self.kv_layout == "paged"
+        prefix = self.prefix_caching
 
         def prefill(params, kc, vc, lengths, tok, table, seeds, prompts,
-                    p_lens, admit):
+                    p_lens, admit, *rest):
+            # Prefix-caching engines thread a per-slot novel-write
+            # floor; without the knob the program keeps its pre-PR-16
+            # signature and HLO bit-for-bit.
+            wf = rest[0] if prefix else None
             stages, shared = params["stages"], params["shared"]
             x = self._embed(shared, prompts, jnp.arange(S))
             mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
@@ -485,10 +650,10 @@ class ServingEngine:
                 if paged:
                     kc = kv_cache.paged_write_prompt(
                         kc, layer, k, admit, table, self.kv_block_len,
-                        p_lens)
+                        p_lens, write_from=wf)
                     vc = kv_cache.paged_write_prompt(
                         vc, layer, v, admit, table, self.kv_block_len,
-                        p_lens)
+                        p_lens, write_from=wf)
                 else:
                     kc = kv_cache.write_prompt(kc, layer, k, admit)
                     vc = kv_cache.write_prompt(vc, layer, v, admit)
@@ -501,10 +666,114 @@ class ServingEngine:
             lengths = jnp.where(admit, p_lens, lengths)
             return kc, vc, lengths, tok
 
-        return self._wrap(prefill, n_in_rest=7, n_out_rest=2)
+        return self._wrap(prefill, n_in_rest=7 + (1 if prefix else 0),
+                          n_out_rest=2)
 
-    def _build_decode(self):
-        L, K = self.cfg.num_layers, self.decode_steps
+    def _build_chunk_prefill(self):
+        """The chunked prefill program: ONE compiled ``[B, C]`` window
+        serves every chunk of every prompt length (``chunk_start`` is a
+        traced scalar), writing k/v block-granularly through the table
+        and attending across chunks via the paged chunk attention (the
+        flash-prefill kernel when elected).  The slot whose final
+        prompt token falls inside this chunk emits its first generated
+        token here — other slots pass through — so the host loop's last
+        relevant chunk completes exactly what single-shot prefill does,
+        token-for-token (the parity golden)."""
+        L, C = self.cfg.num_layers, self.prefill_chunk
+        bl = self.kv_block_len
+        prefix = self.prefix_caching
+
+        def chunk_prefill(params, kc, vc, lengths, tok, table, seeds,
+                          chunk_toks, chunk_start, p_lens, admit, *rest):
+            wf = rest[0] if prefix else None
+            stages, shared = params["stages"], params["shared"]
+            x = self._embed(shared, chunk_toks,
+                            chunk_start + jnp.arange(C))
+            starts = jnp.zeros_like(p_lens) + chunk_start
+            for layer in range(L):
+                chunk = jax.tree.map(lambda p: p[layer], stages)
+
+                def write(kc, vc, k, v, layer=layer):
+                    kc = kv_cache.paged_write_chunk(
+                        kc, layer, k, admit, table, bl, chunk_start,
+                        p_lens, write_from=wf)
+                    vc = kv_cache.paged_write_chunk(
+                        vc, layer, v, admit, table, bl, chunk_start,
+                        p_lens, write_from=wf)
+                    return kc, vc
+
+                x, kc, vc = self._layer_chunk(chunk, x, kc, vc, layer,
+                                              starts, table, write)
+            emit_here = admit & (p_lens > chunk_start) \
+                & (p_lens <= chunk_start + C)
+            last_idx = jnp.clip(p_lens - 1 - chunk_start, 0, C - 1)
+            last = jnp.take_along_axis(
+                x, last_idx[:, None, None], axis=1)[:, 0]
+            first_tok, _ = self._next_token(shared, last, seeds, p_lens)
+            tok = jnp.where(emit_here, first_tok, tok)
+            lengths = jnp.where(emit_here, p_lens, lengths)
+            return kc, vc, lengths, tok
+
+        return self._wrap(chunk_prefill,
+                          n_in_rest=8 + (1 if prefix else 0),
+                          n_out_rest=2)
+
+    def _build_spec_verify(self):
+        """The speculative verify program: feed the current token plus
+        the draft's k proposals as one ``[B, k+1]`` window starting at
+        each slot's own length, write their k/v token-granularly, and
+        return the target's choice at EVERY window position — computed
+        by the same epilogue and the same (seed, position) keys vanilla
+        decode would use, so the accepted prefix is token-for-token
+        (greedy) and draw-for-draw (sampled) what vanilla would have
+        emitted.  Lengths do NOT advance here: the host applies the
+        accept/reject rule and rolls the rejected tail back by setting
+        lengths, which un-materializes the stale rows behind the length
+        mask (their blocks stay within the slot's reservation)."""
+        L, C = self.cfg.num_layers, self.speculative + 1
+        paged = self.kv_layout == "paged"
+        bl = self.kv_block_len
+
+        def verify(params, kc, vc, lengths, tok, table, seeds,
+                   tokens_in, active):
+            stages, shared = params["stages"], params["shared"]
+            positions = lengths[:, None] + jnp.arange(C)[None, :]
+            x = self._embed(shared, tokens_in, positions)
+            for layer in range(L):
+                chunk = jax.tree.map(lambda p: p[layer], stages)
+
+                def write(kc, vc, k, v, layer=layer):
+                    for c in range(C):
+                        if paged:
+                            kc = kv_cache.paged_write_token(
+                                kc, layer, k[:, c:c + 1], lengths + c,
+                                table, bl, write_mask=active)
+                            vc = kv_cache.paged_write_token(
+                                vc, layer, v[:, c:c + 1], lengths + c,
+                                table, bl, write_mask=active)
+                        else:
+                            kc = kv_cache.write_token(
+                                kc, layer, k[:, c:c + 1], lengths + c)
+                            vc = kv_cache.write_token(
+                                vc, layer, v[:, c:c + 1], lengths + c)
+                    return kc, vc
+
+                x, kc, vc = self._layer_chunk(
+                    chunk, x, kc, vc, layer, lengths,
+                    table if paged else None, write)
+            # Choice at window row c conditions on lengths + 1 + c
+            # tokens — exactly the position key the c-th vanilla decode
+            # step would use.
+            choices = jnp.stack(
+                [self._next_token(shared, x[:, c], seeds,
+                                  lengths + 1 + c)[0]
+                 for c in range(C)], axis=1)         # [B, C]
+            return kc, vc, lengths, tok, choices
+
+        return self._wrap(verify, n_in_rest=6, n_out_rest=3)
+
+    def _build_decode(self, steps: Optional[int] = None):
+        L, K = self.cfg.num_layers, int(steps or self.decode_steps)
         paged = self.kv_layout == "paged"
 
         def decode(params, kc, vc, lengths, tok, table, seeds, active):
@@ -535,14 +804,57 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
     # host-side block accounting (the batcher's admission predicate)
     # ------------------------------------------------------------------ #
-    def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+    def _prefix_lookup(self, prompt, prompt_len):
+        """Walk the prefix index for ``prompt``'s leading blocks.
+        Returns ``(hits, novel, partial_hit)``: ``hits`` — physical
+        blocks already holding the shared prefix (a contiguous leading
+        run; the chained keys make the first miss terminal), ``novel``
+        — ``{logical_index: key}`` for the blocks THIS request must
+        compute (registered only after its prefill lands, so a same-
+        batch sharer can never read an unwritten block), and
+        ``partial_hit`` — the shared partial-tail physical block, or
+        ``None``.  A partial hit is the one shared block decode will
+        write into, so admission pre-funds its copy-on-write."""
+        if not self.prefix_caching or prompt is None:
+            return [], {}, None
+        toks = np.asarray(prompt).reshape(-1)[:int(prompt_len)]
+        full_keys, partial_key = kv_cache.prefix_block_keys(
+            toks, self.kv_block_len)
+        hits, novel, partial_hit = [], {}, None
+        miss = False
+        for j, key in enumerate(full_keys):
+            phys = None if miss else self._prefix_index.get(key)
+            if phys is None:
+                miss = True
+                novel[j] = key
+            else:
+                hits.append(phys)
+        if partial_key is not None:
+            j = len(full_keys)
+            phys = None if miss else self._prefix_index.get(partial_key)
+            if phys is None:
+                novel[j] = partial_key
+            else:
+                hits.append(phys)
+                partial_hit = phys
+        return hits, novel, partial_hit
+
+    def blocks_needed(self, prompt_len: int, max_new_tokens: int,
+                      prompt=None) -> int:
         """Pool blocks a request reserves: its worst-case occupancy
         ``min(prompt + budget, max_len)`` rounded up to blocks (0 under
-        the dense layout — admission gates on slots alone there)."""
+        the dense layout — admission gates on slots alone there).
+        Under prefix caching, pass ``prompt`` and the charge drops to
+        the NOVEL suffix — shared leading blocks cost nothing (plus one
+        pre-funded copy-on-write reserve when the partial tail is
+        shared: the block decode writes into must have a private copy
+        standing by, or a full pool could deadlock the write)."""
         if self.kv_layout != "paged":
             return 0
         span = min(int(prompt_len) + int(max_new_tokens), self.max_len)
-        return kv_cache.blocks_for(span, self.kv_block_len)
+        n = kv_cache.blocks_for(span, self.kv_block_len)
+        hits, _, partial_hit = self._prefix_lookup(prompt, prompt_len)
+        return n - len(hits) + (1 if partial_hit is not None else 0)
 
     @property
     def free_blocks(self) -> int:
@@ -553,21 +865,45 @@ class ServingEngine:
                 if self._allocator is not None else 0)
 
     def reserve_slot(self, slot: int, prompt_len: int,
-                     max_new_tokens: int) -> None:
+                     max_new_tokens: int, prompt=None) -> int:
         """Map a request's blocks into ``slot``'s table row (paged;
-        dense is a no-op).  Raises
+        dense is a no-op).  Under prefix caching (``prompt`` given) the
+        leading shared blocks are reference-bumped instead of
+        allocated; only the novel suffix (plus one copy-on-write
+        reserve for a shared partial tail) draws on the pool.  Returns
+        the number of prefix-hit blocks.  Raises
         :class:`~autodist_tpu.serving.kv_cache.PoolExhaustedError` when
         the pool cannot cover it — the batcher checks
         :meth:`blocks_needed` against :attr:`free_blocks` first, so a
-        raise here is a bookkeeping bug surfacing loudly."""
+        raise here is a bookkeeping bug surfacing loudly (and it raises
+        BEFORE any refcount is bumped, so a failed admission leaves the
+        pool untouched)."""
         if self._allocator is None:
-            return
+            return 0
         if self._slot_blocks[slot]:
             raise ValueError(f"slot {slot} already holds blocks "
                              f"{self._slot_blocks[slot]}")
-        n = self.blocks_needed(prompt_len, max_new_tokens)
-        blocks = self._allocator.alloc(n)
+        span = min(int(prompt_len) + int(max_new_tokens), self.max_len)
+        n = kv_cache.blocks_for(span, self.kv_block_len)
+        hits, novel, partial_hit = self._prefix_lookup(prompt, prompt_len)
+        n_hit = len(hits)
+        need = n - n_hit + (1 if partial_hit is not None else 0)
+        new_blocks = self._allocator.alloc(need)
+        if partial_hit is not None:
+            # The shared partial-tail block WILL be written (the first
+            # generated token lands inside it): park one replacement
+            # block per extra reference so the copy-on-write in
+            # _cow_protect never has to allocate mid-stream.
+            self._cow_reserve.setdefault(partial_hit, []).append(
+                new_blocks.pop())
+        for b in hits:
+            self._allocator.share(b)
+        blocks = hits + new_blocks
         self._slot_blocks[slot] = blocks
+        self._write_from[slot] = n_hit
+        self._slot_hits[slot] = n_hit
+        if novel:
+            self._pending_register[slot] = novel
         # Tail-fill the row with the slot's LAST block: an over-decode
         # position past the reservation (a final fused window's
         # overshoot, or the clamped >= max_len write) then routes into
@@ -577,19 +913,54 @@ class ServingEngine:
         self._table[slot, :n] = blocks
         self._sync_table()
         self._emit_block_gauges()
+        if self.draft is not None:
+            self.draft.reserve_slot(slot, prompt_len, max_new_tokens)
+        return n_hit
+
+    def _trim_reserves(self, block: int) -> None:
+        """Keep ``_cow_reserve[block]`` at one replacement per EXTRA
+        reference (``max(rc - 1, 0)``) — a sharer releasing, or a
+        copy-on-write consuming a reference, returns the now-surplus
+        reserve to the pool."""
+        pool = self._cow_reserve.get(block)
+        if pool is None:
+            return
+        want = max(self._allocator.refcount(block) - 1, 0)
+        while len(pool) > want:
+            self._allocator.free_one(pool.pop())
+        if not pool:
+            del self._cow_reserve[block]
+
+    def _free_blocks(self, blocks) -> None:
+        """Drop one reference per block; fully-released blocks retire
+        their prefix-index registration, and shared survivors shed any
+        now-surplus copy-on-write reserves."""
+        for b in blocks:
+            if self._allocator.free_one(b):
+                key = self._block_keys.pop(b, None)
+                if key is not None and self._prefix_index.get(key) == b:
+                    del self._prefix_index[key]
+            self._trim_reserves(b)
 
     def release_slot(self, slot: int) -> None:
         """Return ``slot``'s blocks to the free list (paged; dense is a
-        no-op).  The pool rows keep their stale content — unreachable
-        behind the next owner's length mask."""
-        if self._allocator is None:
-            return
-        if self._slot_blocks[slot]:
-            self._allocator.free(self._slot_blocks[slot])
+        no-op) — under prefix caching this drops ONE reference per
+        block, so shared prefixes survive their sharers.  The pool rows
+        keep their stale content — unreachable behind the next owner's
+        length mask."""
+        if self._allocator is not None and self._slot_blocks[slot]:
+            self._free_blocks(self._slot_blocks[slot])
             self._slot_blocks[slot] = []
             self._table[slot, :] = 0
+            self._pending_register.pop(slot, None)
+            self._write_from[slot] = 0
+            self._slot_hits[slot] = 0
             self._sync_table()
             self._emit_block_gauges()
+        if self.speculative is not None:
+            self._spec_catch[slot] = False
+        if self.draft is not None:
+            self.draft.release_slot(slot)
 
     def block_accounting(self) -> tuple:
         """``(free, used, total)`` pool blocks — the invariant every
@@ -634,42 +1005,303 @@ class ServingEngine:
         return jnp.zeros((self.num_slots, 1), jnp.int32)
 
     # ------------------------------------------------------------------ #
+    # copy-on-write + prefix registration (the sharing protocol)
+    # ------------------------------------------------------------------ #
+    def _copy_block(self, src: int, dst: int) -> None:
+        """Device-copy pool block ``src`` into ``dst`` across every
+        layer's k/v pools (the copy-on-write data move)."""
+        if self._copy_block_jit is None:
+            self._copy_block_jit = jax.jit(kv_cache.copy_pool_block,
+                                           donate_argnums=(0, 1))
+        k, v = self._copy_block_jit(self.cache.k, self.cache.v,
+                                    jnp.int32(src), jnp.int32(dst))
+        self.cache = kv_cache.PagedKVCache(
+            k=k, v=v, lengths=self.cache.lengths,
+            block_table=self.cache.block_table)
+
+    def _cow_protect(self, active, lengths, n: int) -> None:
+        """The copy-on-write gate: before a dispatch writes positions
+        ``[L, L + n)`` of each active slot, any table entry in that
+        span whose physical block is shared (refcount > 1) is copied
+        into the slot's pre-funded reserve and the writer's row
+        redirected — the sharer keeps the pristine block, and the ADT
+        rule that no write goes through a shared table entry holds by
+        construction.  Every span block (post-redirect) is noted as a
+        ``write`` trace event so ``lint_block_trace`` can replay the
+        protocol."""
+        if self._allocator is None:
+            return
+        bl = self.kv_block_len
+        max_blocks = self._table.shape[1]
+        changed = False
+        for slot in range(self.num_slots):
+            if not active[slot]:
+                continue
+            L = int(lengths[slot])
+            lo = L // bl
+            hi = min((L + n - 1) // bl, max_blocks - 1)
+            for j in range(lo, hi + 1):
+                b = int(self._table[slot, j])
+                if self._allocator.refcount(b) > 1:
+                    pool = self._cow_reserve.get(b)
+                    if not pool:
+                        raise RuntimeError(
+                            f"shared block {b} in slot {slot}'s write "
+                            "span has no copy-on-write reserve — "
+                            "admission must pre-fund every extra "
+                            "reference on a writable block")
+                    r = pool.pop()
+                    if not pool:
+                        del self._cow_reserve[b]
+                    self._copy_block(b, r)
+                    # Redirect EVERY row entry holding b (tail-fill
+                    # duplicates included) — the slot must never write
+                    # through the shared id again.
+                    row = self._table[slot]
+                    row[row == b] = r
+                    self._slot_blocks[slot] = [
+                        r if x == b else x
+                        for x in self._slot_blocks[slot]]
+                    self._allocator.note("cow", b, r)
+                    self._allocator.free_one(b)
+                    self._trim_reserves(b)
+                    changed = True
+                self._allocator.note("write", int(self._table[slot, j]))
+        if changed:
+            self._sync_table()
+            self._emit_block_gauges()
+
+    def _flush_registration(self, admit) -> None:
+        """Publish the prefix keys of blocks the just-landed prefill
+        actually wrote.  Registration waits until AFTER the dispatch so
+        a same-batch request can never hit a block whose content is
+        still pending; two same-batch requests with equal prefixes each
+        keep private blocks and the first to flush wins the index."""
+        if not self.prefix_caching:
+            return
+        for slot in range(self.num_slots):
+            pend = self._pending_register.get(slot)
+            if not pend or not admit[slot]:
+                continue
+            blocks = self._slot_blocks[slot]
+            for j, key in pend.items():
+                if j >= len(blocks) or key in self._prefix_index:
+                    continue
+                self._prefix_index[key] = blocks[j]
+                self._block_keys[blocks[j]] = key
+            self._pending_register.pop(slot, None)
+
+    # ------------------------------------------------------------------ #
     # host-side driver API (the batcher's contract)
     # ------------------------------------------------------------------ #
+    @property
+    def max_prompt_tokens(self) -> int:
+        """Longest admissible prompt: the prefill bucket single-shot;
+        the whole context minus one generated token once chunked
+        prefill makes long prompts first-class."""
+        return (self.max_len - 1 if self.prefill_chunk is not None
+                else self.prefill_len)
     def prefill(self, prompts, p_lens, admit, seeds=None):
         """Run one prefill over the slot batch; admitted slots adopt
         their prompt's cache/length and first generated token (greedy,
         or sampled at the engine's temperature under the slot's
-        ``seeds`` entry).  Returns the per-slot current token ``[B]``
-        (numpy)."""
-        prompts = jnp.asarray(prompts, jnp.int32)
-        p_lens = jnp.asarray(p_lens, jnp.int32)
-        admit = jnp.asarray(admit, bool)
+        ``seeds`` entry).  Single-shot engines dispatch the one
+        ``[B, prefill_len]`` program; chunked engines walk the prompt
+        in ``prefill_chunk`` windows through ONE compiled program
+        (``chunk_start`` is traced), skipping leading chunks every
+        admitted slot already has cached via prefix hits.  Returns the
+        per-slot current token ``[B]`` (numpy)."""
+        prompts_np = np.asarray(prompts)
+        p_lens_np = np.asarray(p_lens)
+        admit_np = np.asarray(admit, bool)
         if seeds is not None:
             self._sample_seeds = np.where(
-                np.asarray(admit), np.asarray(seeds, np.int32),
+                admit_np, np.asarray(seeds, np.int32),
                 self._sample_seeds).astype(np.int32)
-        c = self.cache
-        k, v, lengths, tok = self._prefill_jit(
-            self.params, c.k, c.v, c.lengths, self._tok,
-            self._table_arg(), jnp.asarray(self._sample_seeds), prompts,
-            p_lens, admit)
-        self.cache = self._rebuild_cache(k, v, lengths)
-        self._tok = tok
-        return np.asarray(jax.device_get(tok))
+        p_lens_j = jnp.asarray(p_lens_np, jnp.int32)
+        admit_j = jnp.asarray(admit_np)
+        rest = ((jnp.asarray(self._write_from),)
+                if self.prefix_caching else ())
+        if self.prefill_chunk is None:
+            c = self.cache
+            k, v, lengths, tok = self._prefill_jit(
+                self.params, c.k, c.v, c.lengths, self._tok,
+                self._table_arg(), jnp.asarray(self._sample_seeds),
+                jnp.asarray(prompts_np, jnp.int32), p_lens_j, admit_j,
+                *rest)
+            self.cache = self._rebuild_cache(k, v, lengths)
+            self._tok = tok
+            self.last_prefill_chunks = 1
+        else:
+            self._chunked_prefill(prompts_np, p_lens_np, admit_np,
+                                  p_lens_j, admit_j, rest)
+        self._flush_registration(admit_np)
+        if self.draft is not None:
+            # The draft mirrors the target's resident prompts so its
+            # proposals condition on the same context; its first-token
+            # emission is discarded (decode_window aligns _tok to the
+            # target's before every proposal run).
+            self.draft.prefill(prompts_np, p_lens_np, admit_np, seeds)
+        return np.asarray(jax.device_get(self._tok))
+
+    def _chunked_prefill(self, prompts_np, p_lens_np, admit_np,
+                         p_lens_j, admit_j, rest):
+        C = self.prefill_chunk
+        if not admit_np.any():
+            self.last_prefill_chunks = 0
+            return
+        hi_len = int(p_lens_np[admit_np].max())
+        n_chunks = kv_cache.blocks_for(hi_len, C)
+        padded = np.zeros((self.num_slots, n_chunks * C), np.int64)
+        width = min(prompts_np.shape[1], padded.shape[1])
+        padded[:, :width] = prompts_np[:, :width]
+        # Chunks fully covered by prefix hits for EVERY admitted slot
+        # carry no novel writes and no emission — skip them (their
+        # keys/values are already resident in the shared blocks the
+        # later chunks attend through).  The chunk holding a slot's
+        # final prompt token always runs: it produces the activation
+        # the first generated token samples from.
+        first = 0
+        if self.prefix_caching:
+            firsts = [min(int(self._write_from[i]) * self.kv_block_len,
+                          int(p_lens_np[i]) - 1)
+                      for i in range(self.num_slots) if admit_np[i]]
+            first = min(firsts) // C
+        dispatched = 0
+        for ci in range(first, n_chunks):
+            cs = ci * C
+            c = self.cache
+            k, v, lengths, tok = self._prefill_jit(
+                self.params, c.k, c.v, c.lengths, self._tok,
+                self._table_arg(), jnp.asarray(self._sample_seeds),
+                jnp.asarray(padded[:, cs:cs + C], jnp.int32),
+                jnp.int32(cs), p_lens_j, admit_j, *rest)
+            self.cache = self._rebuild_cache(k, v, lengths)
+            self._tok = tok
+            dispatched += 1
+        self.last_prefill_chunks = dispatched
 
     def decode(self, active):
         """One fused ``decode_steps``-token dispatch; inactive slots
         hold their state.  Returns the emitted tokens ``[K, B]``
         (numpy; inactive columns repeat the held token)."""
-        active = jnp.asarray(active, bool)
+        active_np = np.asarray(active, bool)
+        if self.kv_layout == "paged":
+            self._cow_protect(active_np, self.lengths, self.decode_steps)
         c = self.cache
         k, v, lengths, tok, toks = self._decode_jit(
             self.params, c.k, c.v, c.lengths, self._tok,
-            self._table_arg(), jnp.asarray(self._sample_seeds), active)
+            self._table_arg(), jnp.asarray(self._sample_seeds),
+            jnp.asarray(active_np))
         self.cache = self._rebuild_cache(k, v, lengths)
         self._tok = tok
         return np.asarray(jax.device_get(toks))
+
+    def decode_one(self, active):
+        """A single-token dispatch through a lazily-built K=1 program —
+        the speculative draft's catch-up path (feeding the one proposal
+        a fully-accepted window verified but the draft never wrote)."""
+        if self._decode1_jit is None:
+            self._decode1_jit = self._build_decode(steps=1)
+        active_np = np.asarray(active, bool)
+        if self.kv_layout == "paged":
+            self._cow_protect(active_np, self.lengths, 1)
+        c = self.cache
+        k, v, lengths, tok, toks = self._decode1_jit(
+            self.params, c.k, c.v, c.lengths, self._tok,
+            self._table_arg(), jnp.asarray(self._sample_seeds),
+            jnp.asarray(active_np))
+        self.cache = self._rebuild_cache(k, v, lengths)
+        self._tok = tok
+        return np.asarray(jax.device_get(toks))
+
+    def decode_window(self, active) -> DecodeWindow:
+        """The batcher's decode unit.  Vanilla engines emit a fixed
+        ``decode_steps`` tokens per active slot.  Speculative engines
+        run draft-propose → target-verify → host accept/reject: the
+        draft proposes ``k`` tokens autoregressively, ONE target
+        dispatch scores the ``k + 1`` window, and each slot keeps the
+        longest prefix the target agrees with plus the target's own
+        next token — token-for-token (greedy) and draw-for-draw
+        (sampled) what vanilla decode would have emitted, because both
+        sides sample through the same position-keyed draws.  Rejected
+        tokens roll back by resetting lengths through the block table's
+        masked reads — no data movement."""
+        active_np = np.asarray(active, bool)
+        B = self.num_slots
+        if self.speculative is None:
+            toks = self.decode(active_np)
+            counts = np.where(active_np, self.decode_steps,
+                              0).astype(np.int32)
+            z = np.zeros((B,), np.int32)
+            return DecodeWindow(tokens=toks, counts=counts,
+                                spec_proposed=z, spec_accepted=z.copy())
+        ks = self.speculative
+        # 1. Catch-up: a slot whose last window accepted every proposal
+        # verified token d_k but the draft never wrote it — feed it
+        # through the K=1 program so the draft's cache matches the
+        # target's length before proposing again.
+        need = self._spec_catch & active_np
+        if need.any():
+            draft_tok = np.asarray(jax.device_get(self.draft._tok))
+            self.draft._tok = jnp.asarray(
+                np.where(need, self._spec_catch_tok,
+                         draft_tok).astype(np.int32))
+            self.draft.decode_one(need)
+            self._spec_catch &= ~need
+        # 2. Align: the draft continues from the target's current token.
+        tgt_tok = np.asarray(jax.device_get(self._tok))
+        self.draft._tok = jnp.asarray(tgt_tok.astype(np.int32))
+        # 3. Propose: the draft's fused decode IS the k-token proposer.
+        proposals = self.draft.decode(active_np)           # [k, B]
+        # 4. Verify: one target dispatch over [tok, d_1..d_k].
+        lengths_np = self.lengths
+        if self.kv_layout == "paged":
+            self._cow_protect(active_np, lengths_np, ks + 1)
+        tokens_in = np.zeros((B, ks + 1), np.int64)
+        tokens_in[:, 0] = tgt_tok
+        tokens_in[:, 1:] = proposals.T
+        c = self.cache
+        k, v, lengths, tok, choices = self._spec_verify_jit(
+            self.params, c.k, c.v, c.lengths, self._tok,
+            self._table_arg(), jnp.asarray(self._sample_seeds),
+            jnp.asarray(tokens_in, jnp.int32), jnp.asarray(active_np))
+        self.cache = self._rebuild_cache(k, v, lengths)
+        choices_np = np.asarray(jax.device_get(choices))   # [B, k+1]
+        # 5. Accept/reject + rollback (host-side lengths are the only
+        # state that moves — stale verified rows hide behind them).
+        tok_np = tgt_tok.copy()
+        new_len = lengths_np.copy()
+        draft_len = np.asarray(
+            jax.device_get(self.draft.cache.lengths)).copy()
+        tokens = np.zeros((ks + 1, B), np.int32)
+        counts = np.zeros((B,), np.int32)
+        accepted = np.zeros((B,), np.int32)
+        proposed = np.zeros((B,), np.int32)
+        for i in range(B):
+            if not active_np[i]:
+                continue
+            j = 0
+            while j < ks and choices_np[i, j] == proposals[j, i]:
+                j += 1
+            m = j + 1
+            tokens[:m, i] = choices_np[i, :m]
+            counts[i] = m
+            accepted[i] = j
+            proposed[i] = ks
+            tok_np[i] = choices_np[i, j]
+            new_len[i] = lengths_np[i] + m
+            draft_len[i] = lengths_np[i] + min(m, ks)
+            if j == ks:
+                self._spec_catch[i] = True
+                self._spec_catch_tok[i] = proposals[ks - 1, i]
+        self._tok = jnp.asarray(tok_np.astype(np.int32))
+        self.cache = dataclasses.replace(
+            self.cache, lengths=jnp.asarray(new_len, jnp.int32))
+        self.draft.cache = dataclasses.replace(
+            self.draft.cache, lengths=jnp.asarray(draft_len, jnp.int32))
+        return DecodeWindow(tokens=tokens, counts=counts,
+                            spec_proposed=proposed, spec_accepted=accepted)
 
     def _rebuild_cache(self, k, v, lengths):
         if self.kv_layout == "paged":

@@ -44,7 +44,9 @@ block's previous owner — are never observable.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
+import hashlib
 from typing import Any
 
 import jax
@@ -184,15 +186,31 @@ class PoolExhaustedError(RuntimeError):
 
 
 class BlockAllocator:
-    """Host-side free-list over the pool's ``num_blocks`` block ids.
+    """Host-side refcounted free-list over the pool's ``num_blocks`` ids.
 
     Pure accounting — no device traffic.  Allocation pops from one flat
     free list, so there is no fragmentation by construction: any
     ``n <= free_blocks`` allocation succeeds, and
-    ``free_blocks + allocated == num_blocks`` is an invariant the unit
-    tests pin.  Double-frees and foreign ids are rejected loudly (a
-    bookkeeping bug must not silently double-map a block to two
-    slots)."""
+    ``free_blocks + used_blocks == num_blocks`` is an invariant the unit
+    tests pin (``used_blocks`` counts *physical* blocks with refcount
+    >= 1, not table references).  Prefix caching shares a physical block
+    between slots by bumping its refcount (:meth:`share`); :meth:`free`
+    decrements, and a block returns to the free list only when the last
+    reference drops — so ``free + used == total`` survives sharing with
+    no special cases.  Double-frees and foreign ids are rejected loudly
+    (a bookkeeping bug must not silently double-map a block to two
+    slots).
+
+    Every allocate/share/free transition is appended to :attr:`events`
+    — the block event trace the ADT116/ADT117 shared-block rules replay
+    (``lint_block_trace``).  The engine appends ``write``/``cow``
+    events through :meth:`note` for the writes it dispatches, so the
+    trace carries enough to prove no shared block is ever written
+    through a table entry without a copy first."""
+
+    #: bounded so a long-lived serving process cannot grow the trace
+    #: without bound; the lints run over fresh, short traces.
+    TRACE_LIMIT = 1 << 18
 
     def __init__(self, num_blocks: int):
         if num_blocks < 1:
@@ -202,7 +220,8 @@ class BlockAllocator:
         # handed to the next admission — the recycling edge the paged
         # parity goldens pin).
         self._free = list(range(self.num_blocks - 1, -1, -1))
-        self._held = set()
+        self._rc: dict = {}
+        self.events = collections.deque(maxlen=self.TRACE_LIMIT)
 
     @property
     def free_blocks(self) -> int:
@@ -210,7 +229,15 @@ class BlockAllocator:
 
     @property
     def used_blocks(self) -> int:
-        return len(self._held)
+        return len(self._rc)
+
+    def refcount(self, block: int) -> int:
+        return self._rc.get(block, 0)
+
+    def note(self, *event) -> None:
+        """Append an engine-observed event (``write``/``cow``) to the
+        trace.  The allocator records its own alloc/share/free."""
+        self.events.append(tuple(event))
 
     def alloc(self, n: int) -> list:
         if n < 0:
@@ -221,22 +248,76 @@ class BlockAllocator:
                 f"{len(self._free)} free of {self.num_blocks} — the "
                 "admission predicate must gate on free blocks")
         blocks = [self._free.pop() for _ in range(n)]
-        self._held.update(blocks)
+        for b in blocks:
+            self._rc[b] = 1
+            self.events.append(("alloc", b))
         return blocks
 
-    def free(self, blocks) -> None:
+    def share(self, block: int) -> int:
+        """Take one more reference on an allocated block (prefix hit)."""
+        if block not in self._rc:
+            raise ValueError(
+                f"block {block} is not allocated — cannot share a free "
+                "block")
+        self._rc[block] += 1
+        self.events.append(("share", block))
+        return block
+
+    def free(self, blocks) -> list:
+        """Drop one reference per listed block.  Returns the blocks
+        whose LAST reference dropped (now back on the free list) so the
+        caller can retire any prefix-index entries keyed on them."""
+        released = []
         for b in blocks:
-            if b not in self._held:
-                raise ValueError(
-                    f"block {b} is not allocated (double-free or "
-                    "foreign id)")
-            self._held.remove(b)
-            self._free.append(b)
+            if self.free_one(b):
+                released.append(b)
+        return released
+
+    def free_one(self, block: int) -> bool:
+        """Drop one reference; True iff the block was fully released."""
+        if block not in self._rc:
+            raise ValueError(
+                f"block {block} is not allocated (double-free or "
+                "foreign id)")
+        self.events.append(("free", block))
+        self._rc[block] -= 1
+        if self._rc[block] == 0:
+            del self._rc[block]
+            self._free.append(block)
+            return True
+        return False
 
 
 def blocks_for(tokens: int, block_len: int) -> int:
     """Pool blocks covering ``tokens`` logical positions."""
     return -(-max(int(tokens), 0) // int(block_len))
+
+
+def prefix_block_keys(prompt, block_len: int):
+    """Content keys for a prompt's blocks, chained so a key commits to
+    the WHOLE prefix through its block (two prompts agreeing on block
+    ``j``'s key agree on every token before it — the property that
+    makes a single dict lookup sufficient for prefix matching).
+
+    Returns ``(full_keys, partial_key)``: one key per *full* prompt
+    block, plus a key for the trailing partial block (``None`` when the
+    prompt length is a block multiple).  The partial key commits to the
+    exact tail run — a prompt extending past another's partial tail
+    does NOT match it (the shared block would be missing the extra
+    tokens' projections)."""
+    toks = np.asarray(prompt, dtype=np.int64)
+    bl = int(block_len)
+    n_full = len(toks) // bl
+    full_keys, h = [], hashlib.sha1(b"adt-prefix")
+    for j in range(n_full):
+        h.update(toks[j * bl:(j + 1) * bl].tobytes())
+        full_keys.append(("full", h.hexdigest()))
+    partial_key = None
+    tail = toks[n_full * bl:]
+    if len(tail):
+        h.update(tail.tobytes())
+        partial_key = ("partial", len(tail), h.hexdigest())
+    return full_keys, partial_key
 
 
 @dataclasses.dataclass
@@ -321,7 +402,7 @@ def paged_write_token(cache_arr, layer: int, kv, positions, block_table,
 
 
 def paged_write_prompt(cache_arr, layer: int, kv, admit, block_table,
-                       block_len: int, p_lens):
+                       block_len: int, p_lens, write_from=None):
     """The paged :func:`write_prompt`: slot ``i``'s prompt rows land
     block by block through the table when ``admit[i]``.  Unlike the
     dense path — which writes the whole zero-padded prompt bucket into
@@ -336,7 +417,13 @@ def paged_write_prompt(cache_arr, layer: int, kv, admit, block_table,
     block-granular write never splits below a block, so only the
     all-or-nothing ``lo < p_lens`` predicate decides).  Non-admitted
     slots' mapped blocks are kept bit-for-bit via the same
-    read-modify-write select the dense path uses."""
+    read-modify-write select the dense path uses.
+
+    ``write_from`` (``[B]`` int32, optional): logical blocks
+    ``j < write_from[i]`` are skipped — they are prefix-cache hits
+    whose physical blocks already hold the identical projections
+    (possibly shared with another slot, where an unsuppressed write
+    would be a write through a shared table entry — ADT116)."""
     B, S = kv.shape[0], kv.shape[1]
     n_blocks = blocks_for(S, block_len)
     for slot in range(B):
@@ -348,10 +435,103 @@ def paged_write_prompt(cache_arr, layer: int, kv, admit, block_table,
             blk = block_table[slot, j]
             cur = lax.dynamic_slice(cache_arr, (layer, blk, 0, 0, 0),
                                     new.shape)
-            sel = jnp.where(admit[slot] & (lo < p_lens[slot]), new, cur)
+            take = admit[slot] & (lo < p_lens[slot])
+            if write_from is not None:
+                take = take & (j >= write_from[slot])
+            sel = jnp.where(take, new, cur)
             cache_arr = lax.dynamic_update_slice(
                 cache_arr, sel, (layer, blk, 0, 0, 0))
     return cache_arr
+
+
+def paged_write_chunk(cache_arr, layer: int, kv, admit, block_table,
+                      block_len: int, chunk_start, p_lens,
+                      write_from=None):
+    """The chunked :func:`paged_write_prompt`: one prompt *chunk*'s
+    projections land block by block through the table at logical blocks
+    ``chunk_start // block_len + j``.  ``kv``: ``[B, C, heads, dh]``
+    with ``C % block_len == 0`` (the engine validates the chunk knob),
+    so every chunk covers whole logical blocks and the write stays
+    block-granular; ``chunk_start`` is a traced scalar — ONE compiled
+    program serves every chunk of every prompt length.  The same
+    ``lo < p_lens`` / ``write_from`` predicates as the single-shot
+    writer decide per block; a chunk wholly past a slot's prompt writes
+    nothing for it."""
+    B, C = kv.shape[0], kv.shape[1]
+    n_blocks = C // block_len
+    base = chunk_start // block_len
+    for slot in range(B):
+        rows = jnp.transpose(kv[slot], (1, 0, 2))    # [heads, C, dh]
+        for j in range(n_blocks):
+            lo = j * block_len
+            new = rows[:, lo:lo + block_len][None, None] \
+                .astype(cache_arr.dtype)
+            blk = block_table[slot, base + j]
+            cur = lax.dynamic_slice(cache_arr, (layer, blk, 0, 0, 0),
+                                    new.shape)
+            take = admit[slot] & (chunk_start + lo < p_lens[slot])
+            if write_from is not None:
+                take = take & (base + j >= write_from[slot])
+            sel = jnp.where(take, new, cur)
+            cache_arr = lax.dynamic_update_slice(
+                cache_arr, sel, (layer, blk, 0, 0, 0))
+    return cache_arr
+
+
+def chunk_attention(q, k_layer, v_layer, starts, *, dtype=jnp.float32):
+    """A token window's causal attention over contiguous cache lanes:
+    window row ``r`` of slot ``i`` is the query at absolute position
+    ``starts[i] + r`` and attends to every cached key at positions
+    ``<= starts[i] + r`` — earlier chunks AND this window's own rows,
+    which the caller writes into the cache FIRST (write-then-attend,
+    exactly the decode step's ordering).  ``q``: ``[B, C, heads,
+    head_dim]``; ``k_layer``/``v_layer``: ``[B, heads, T, head_dim]``.
+    Serves the chunked-prefill composed path (via
+    :func:`paged_chunk_attention`) and the dense speculative verify
+    pass, where every slot's window begins at its own length."""
+    depth = q.shape[-1]
+    C = q.shape[1]
+    q2 = jnp.transpose(q, (0, 2, 1, 3))              # [B, H, C, dh]
+    scores = lax.dot_general(
+        q2, k_layer.astype(q.dtype),
+        (((3,), (3,)), ((0, 1), (0, 1)))) / np.sqrt(depth)
+    scores = scores.astype(jnp.float32)              # [B, H, C, T]
+    T = k_layer.shape[2]
+    ok = jnp.arange(T)[None, None, None, :] <= \
+        (starts[:, None] + jnp.arange(C)[None, :])[:, None, :, None]
+    scores = jnp.where(ok, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    out = lax.dot_general(
+        probs, v_layer.astype(dtype),
+        (((3,), (2,)), ((0, 1), (0, 1))))            # [B, H, C, dh]
+    return jnp.transpose(out, (0, 2, 1, 3))          # [B, C, H, dh]
+
+
+def paged_chunk_attention(q, k_pool, v_pool, starts, block_table, *,
+                          block_len: int, dtype=jnp.float32):
+    """The paged :func:`chunk_attention`: gather the slot's blocks into
+    contiguous lanes, then the same masked math (``T`` becomes the
+    padded ``max_blocks * block_len`` extent).  The composed gather
+    fallback the paged flash-prefill kernel replaces, and its
+    interpreter-mode golden."""
+    del block_len  # implied by the pool's block extent
+    k_layer = gather_blocks(k_pool, block_table)     # [B, H, T, dh]
+    v_layer = gather_blocks(v_pool, block_table)
+    return chunk_attention(q, k_layer, v_layer, starts, dtype=dtype)
+
+
+def copy_pool_block(k_pool, v_pool, src, dst):
+    """Copy one physical block's K/V rows across every layer — the
+    copy-on-write device op: the writer redirects its table entry to
+    ``dst`` and writes there, while the other holders keep reading the
+    untouched ``src``.  ``src``/``dst`` are traced scalars so one
+    compiled copy serves every CoW; a dynamic slice along the block
+    axis only, so the model-axis head sharding passes through."""
+    kb = lax.dynamic_slice_in_dim(k_pool, src, 1, axis=1)
+    vb = lax.dynamic_slice_in_dim(v_pool, src, 1, axis=1)
+    k_pool = lax.dynamic_update_slice_in_dim(k_pool, kb, dst, axis=1)
+    v_pool = lax.dynamic_update_slice_in_dim(v_pool, vb, dst, axis=1)
+    return k_pool, v_pool
 
 
 def gather_blocks(pool, block_table):

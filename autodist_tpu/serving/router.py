@@ -55,6 +55,19 @@ from autodist_tpu.utils import logging
 DISPATCH_REASONS = ("route", "failover", "hedge", "drain")
 
 
+class PromptBudgetError(ValueError):
+    """The request cannot fit the fleet's failover contract: re-
+    prefilling ``prompt + emitted`` must fit every engine's admissible
+    prompt (the prefill bucket single-shot; the whole context under
+    chunked prefill).  Coded — like ``serve/overloaded`` — so a client
+    can tell this *permanent* sizing rejection (shrink the request or
+    turn on chunked prefill) from transient overload it should retry.
+    Subclasses ``ValueError`` so pre-existing callers' handlers keep
+    working."""
+
+    code = "serve/prompt_budget"
+
+
 @dataclasses.dataclass
 class FleetCompletion:
     """One finished fleet request: the emitted stream + how it got
@@ -135,20 +148,33 @@ class Router:
         """Queue one request with the fleet; returns its id.  The
         failover contract needs room to re-prefill *prompt + emitted*,
         so ``len(prompt) + max_new_tokens - 1`` must fit the engines'
-        prompt bucket (chunked prefill is the ROADMAP rung that lifts
-        this)."""
+        admissible prompt — the prefill bucket single-shot, the whole
+        context under chunked prefill (the rung that makes a long
+        re-prefill a first-class admission instead of a rejection).
+        A request that cannot fit even that is rejected with the coded
+        :class:`PromptBudgetError` — a permanent sizing fact the
+        caller must not retry, unlike transient overload."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
-        bucket = min(r.engine.prefill_len for r in self.fleet.replicas)
+        bucket = min(getattr(r.engine, "max_prompt_tokens",
+                             r.engine.prefill_len)
+                     for r in self.fleet.replicas)
         if len(prompt) + max_new_tokens - 1 > bucket:
-            raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens "
-                f"({max_new_tokens}) - 1 exceeds the fleet's prefill "
-                f"bucket ({bucket}); a failover could not re-prefill "
-                "the emitted stream")
+            chunked = all(
+                getattr(r.engine, "prefill_chunk", None) is not None
+                for r in self.fleet.replicas)
+            hint = ("the whole context is the bucket — the request "
+                    "exceeds the cache capacity itself" if chunked else
+                    "enable prefill_chunk to lift the bucket to the "
+                    "whole context")
+            raise PromptBudgetError(
+                f"[{PromptBudgetError.code}] prompt ({len(prompt)}) + "
+                f"max_new_tokens ({max_new_tokens}) - 1 exceeds the "
+                f"fleet's prompt bucket ({bucket}); a failover could "
+                f"not re-prefill the emitted stream — {hint}")
         if deadline_s is None:
             deadline_s = self.config.request_deadline_s
         if deadline_s is not None and deadline_s <= 0:

@@ -104,13 +104,21 @@ def default_candidates() -> list[StrategyBuilder]:
 
 
 def default_serving_candidates(num_devices: int,
-                               kv_layouts=("dense", "paged")) -> list[dict]:
+                               kv_layouts=("dense", "paged"),
+                               ladder: bool = False) -> list[dict]:
     """The serving-config zoo: every (tensor_parallel, vocab_parallel,
     kv_layout) shape the serving engine can lower on ``num_devices``
     devices.  Plain dicts rather than builders — the decode program has
     no pipe axis to build a full training strategy against, and the
     keys are exactly the Strategy-IR ``parallel`` knobs the engine
-    reads."""
+    reads.
+
+    ``ladder=True`` additionally enumerates the PR-16 throughput-ladder
+    rungs on every paged shape: ``prefix_caching=True``,
+    ``speculative=4``, and ``prefill_chunk`` at the calibrated
+    ``flash_prefill_crossover_chunk`` with the ``flash_prefill``
+    kernel elected.  Opt-in — the base zoo (and every config JSON it
+    ever produced) stays byte-identical with the flag off."""
     shapes = [{"tensor_parallel": 1, "vocab_parallel": False}]
     tp = 2
     while tp <= num_devices:
@@ -124,6 +132,14 @@ def default_serving_candidates(num_devices: int,
             if layout != "dense":
                 cand["kv_layout"] = layout
             candidates.append(cand)
+            if ladder and layout == "paged":
+                from autodist_tpu.simulator.cost_model import \
+                    KERNEL_PROFILE
+                chunk = int(KERNEL_PROFILE["flash_prefill_crossover_chunk"])
+                candidates.append(dict(cand, prefix_caching=True))
+                candidates.append(dict(cand, speculative=4))
+                candidates.append(dict(cand, prefill_chunk=chunk,
+                                       kernel=("flash_prefill",)))
     return candidates
 
 
@@ -155,7 +171,8 @@ def default_fleet_candidates(num_devices: int, num_slices: int = 1,
 def rank_serving(trainable, resource_spec, candidates=None, *,
                  batch_slots: int = 1, max_len: int = 2048,
                  mean_request_len=None, objective: str = "latency",
-                 **cost_model_kwargs):
+                 prefix_hit_rate: float = 0.0, spec_acceptance=None,
+                 ladder: bool = False, **cost_model_kwargs):
     """Rank serving configs by the cost model's serving objective —
     AutoStrategy's second objective (ROADMAP: "latency under load, not
     just training step time").
@@ -178,7 +195,18 @@ def rank_serving(trainable, resource_spec, candidates=None, *,
     traffic mix, with replicas priced across DCN and tp held within a
     slice's ICI.  Returns ``[(config, DecodeCost)]`` best-first
     (feasible configs before infeasible) — the same shape as
-    ``AutoStrategy.report``."""
+    ``AutoStrategy.report``.
+
+    The throughput-ladder inputs describe the TRAFFIC, not the config:
+    ``prefix_hit_rate`` (fraction of a typical request's blocks shared
+    with a resident prefix — measure it with ``bench.py serve
+    --prompt-mix shared-prefix``) prices ``prefix_caching`` candidates
+    both directions under the capacity objective;
+    ``spec_acceptance`` (draft acceptance rate α — measure it with
+    ``bench.py serve --speculative``) prices ``speculative``
+    candidates both directions under latency.  ``ladder=True`` widens
+    the default zoo with the rung candidates
+    (:func:`default_serving_candidates` ``ladder=``)."""
     if objective not in ("latency", "capacity", "fleet"):
         raise ValueError(
             f"unknown serving objective {objective!r}; expected "
@@ -191,13 +219,15 @@ def rank_serving(trainable, resource_spec, candidates=None, *,
                 max(int(getattr(resource_spec, "num_slices", 1) or 1), 1))
         else:
             candidates = default_serving_candidates(
-                resource_spec.num_devices())
+                resource_spec.num_devices(), ladder=ladder)
     scored = []
     for cand in candidates:
         try:
             cost = cm.decode_cost(trainable, cand,
                                   batch_slots=batch_slots, max_len=max_len,
-                                  mean_request_len=mean_request_len)
+                                  mean_request_len=mean_request_len,
+                                  prefix_hit_rate=prefix_hit_rate,
+                                  spec_acceptance=spec_acceptance)
         except (ValueError, SpecMeshMismatch) as e:
             logging.info("serving candidate %s skipped: %s", cand, e)
             continue

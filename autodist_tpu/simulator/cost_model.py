@@ -133,6 +133,35 @@ KERNEL_PROFILE: dict = {
     # the request-length distribution gives paged no capacity edge —
     # the both-ways election contract.
     "paged_attention_overhead": 1.05,
+    # Throughput-ladder constants (PR 16), calibratable like the rest:
+    #
+    # * ``flash_prefill_crossover_chunk`` / ``flash_prefill_speedup`` /
+    #   ``flash_prefill_short_penalty`` — the chunked-prefill
+    #   einsum-vs-flash crossover over CHUNK size (``tools/
+    #   flash_crossover.py --prefill`` measures it): wide chunks
+    #   amortize the kernel's scalar-prefetch setup, narrow ones lose
+    #   to the composed gather path.
+    # * ``prefix_caching_overhead`` — hash/admission bookkeeping plus
+    #   the occasional copy-on-write, as an attention-term multiplier.
+    #   Strictly > 1 so a traffic mix with NO shared prefixes elects
+    #   plain paged — the hit rate must pay for the knob both ways.
+    # * ``spec_draft_flops_frac`` — draft-model cost per proposed token
+    #   relative to a target decode step (a ~7x-smaller draft).
+    # * ``spec_marginal_token_cost`` — the verify window's marginal
+    #   cost per extra token relative to a full decode step: the k+1
+    #   tokens share one weights read and one dispatch, so each extra
+    #   token costs well under a step (the whole point of verifying a
+    #   window at once).
+    # * ``spec_acceptance_default`` — the acceptance rate assumed when
+    #   the caller has not measured one (``bench.py serve
+    #   --speculative`` measures; the recipe in ROADMAP.md records it).
+    "flash_prefill_crossover_chunk": 128,
+    "flash_prefill_speedup": 1.5,
+    "flash_prefill_short_penalty": 0.85,
+    "prefix_caching_overhead": 1.02,
+    "spec_draft_flops_frac": 0.15,
+    "spec_marginal_token_cost": 0.35,
+    "spec_acceptance_default": 0.7,
 }
 
 # The grad slot's realization: which EF compressor a bf16/int8 gradient
@@ -313,6 +342,16 @@ class DecodeCost:
     # rejected at pricing time).
     replicas: int = 1
     dispatch_time_s: float = 0.0
+    # The throughput ladder (PR 16): which rungs this config runs, and
+    # the traffic facts they were priced under.  ``spec_acceptance`` is
+    # the acceptance rate the speculative term used (0 when off);
+    # ``prefix_hit_rate`` the shared-prefix block fraction the capacity
+    # term used (0 when off).
+    prefill_chunk: Optional[int] = None
+    prefix_caching: bool = False
+    prefix_hit_rate: float = 0.0
+    speculative: Optional[int] = None
+    spec_acceptance: float = 0.0
 
     @property
     def score(self) -> float:
@@ -1285,7 +1324,9 @@ class CostModel:
                     *, batch_slots: int = 1, max_len: int = 2048,
                     kv_bytes_per_elem: float = _ACT_BYTES,
                     mean_request_len: Optional[float] = None,
-                    kv_block_len: int = 16) -> DecodeCost:
+                    kv_block_len: int = 16,
+                    prefix_hit_rate: float = 0.0,
+                    spec_acceptance: Optional[float] = None) -> DecodeCost:
         """Per-token decode latency for one serving config.
 
         ``config`` is either a training :class:`Strategy` (its Strategy-
@@ -1322,26 +1363,54 @@ class CostModel:
           (:attr:`DecodeCost.dispatch_time_s`) —
           :attr:`DecodeCost.fleet_score` then ranks aggregate
           throughput for the mix.
+        * **the throughput ladder (PR 16)** — ``prefix_caching``
+          divides the capacity term's per-request residency by the
+          traffic's ``prefix_hit_rate`` (the shared leading blocks cost
+          the pool nothing) and pays the calibratable
+          ``prefix_caching_overhead`` on attention, so the capacity
+          objective elects it exactly when the mix actually shares
+          prefixes; ``speculative=k`` prices the window — draft
+          proposes ``k`` at ``spec_draft_flops_frac``, one verify
+          dispatch scores ``k+1`` at ``spec_marginal_token_cost`` per
+          extra token — divided by the expected emissions
+          ``(1 - α^{k+1}) / (1 - α)`` under acceptance rate
+          ``spec_acceptance`` (default: the profile's
+          ``spec_acceptance_default``), so the latency objective elects
+          speculation exactly when α clears the draft+verify overhead
+          — both directions pinned.
         """
         from autodist_tpu.strategy.ir import (normalize_kernel,
-                                              normalize_kv_layout)
+                                              normalize_kv_layout,
+                                              normalize_prefill_chunk,
+                                              normalize_prefix_caching,
+                                              normalize_speculative)
 
         if isinstance(config, Strategy):
             par = config.graph_config.parallel or {}
-            tp = int(par.get("tensor_parallel", 1) or 1)
-            vocab_parallel = bool(par.get("vocab_parallel", False))
             kern = normalize_kernel(
                 getattr(config.graph_config, "kernel", None))
-            kv_layout = normalize_kv_layout(par.get("kv_layout"))
-            replicas = int(par.get("replicas", 1) or 1)
         else:
-            tp = int(config.get("tensor_parallel", 1) or 1)
-            vocab_parallel = bool(config.get("vocab_parallel", False))
+            par = config
             kern = normalize_kernel(config.get("kernel"))
-            kv_layout = normalize_kv_layout(config.get("kv_layout"))
-            replicas = int(config.get("replicas", 1) or 1)
+        tp = int(par.get("tensor_parallel", 1) or 1)
+        vocab_parallel = bool(par.get("vocab_parallel", False))
+        kv_layout = normalize_kv_layout(par.get("kv_layout"))
+        replicas = int(par.get("replicas", 1) or 1)
+        prefill_chunk = normalize_prefill_chunk(par.get("prefill_chunk"))
+        prefix_caching = normalize_prefix_caching(
+            par.get("prefix_caching", False))
+        spec_k = normalize_speculative(par.get("speculative"))
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if (prefill_chunk is not None or prefix_caching) \
+                and kv_layout != "paged":
+            raise ValueError(
+                "prefill_chunk/prefix_caching ride the block table — "
+                "they require kv_layout='paged'")
+        if not 0.0 <= float(prefix_hit_rate) <= 1.0:
+            raise ValueError(
+                f"prefix_hit_rate must be in [0, 1], got "
+                f"{prefix_hit_rate}")
         # The fleet placement contract (arxiv 2110.10548's hierarchy,
         # serving-side): tp's per-layer boundary all-reduces live on
         # every decoded token, so the tp group must stay within a
@@ -1423,6 +1492,15 @@ class CostModel:
             attn *= float(self.kernel_profile.get(
                 "paged_attention_overhead",
                 KERNEL_PROFILE["paged_attention_overhead"]))
+        if prefix_caching:
+            # CoW bookkeeping on the gather path: refcount checks plus
+            # the occasional copy-before-write.  Strictly > 1 so a mix
+            # with no sharing (hit rate 0) never elects the rung for
+            # free — the hit rate has to buy the overhead back through
+            # the capacity term (both directions pinned).
+            attn *= float(self.kernel_profile.get(
+                "prefix_caching_overhead",
+                KERNEL_PROFILE["prefix_caching_overhead"]))
         compute += attn
 
         bw_link = float(self.link_profile.get(
@@ -1436,6 +1514,45 @@ class CostModel:
             comm = ring_m * boundaries * batch_slots * hidden * _ACT_BYTES \
                 / bw_link + hop_alpha * (boundaries
                                          + (2 if vocab_parallel else 0))
+        # Speculative decoding reprices the whole window: one target
+        # step becomes draft-proposes-k (a draft forward costs
+        # spec_draft_flops_frac of the target's) plus one verify
+        # dispatch scoring k+1 positions (each extra position costs
+        # spec_marginal_token_cost of a full step — the matmuls batch,
+        # only attention and the epilogue grow).  The window emits
+        # E = (1 - α^{k+1}) / (1 - α) tokens in expectation under
+        # acceptance rate α, so every per-token term divides by E.
+        # α below the break-even leaves token_time_s WORSE than
+        # vanilla — the ladder rung loses the election, as it should.
+        spec_alpha = 0.0
+        if spec_acceptance is not None \
+                and not 0.0 <= float(spec_acceptance) <= 1.0:
+            raise ValueError(
+                f"spec_acceptance must be in [0, 1], got "
+                f"{spec_acceptance}")
+        if spec_k is not None:
+            kp = self.kernel_profile
+            alpha = float(kp.get(
+                "spec_acceptance_default",
+                KERNEL_PROFILE["spec_acceptance_default"])
+                if spec_acceptance is None else spec_acceptance)
+            spec_alpha = alpha
+            k = int(spec_k)
+            expected = (float(k + 1) if alpha >= 1.0
+                        else (1.0 - alpha ** (k + 1)) / (1.0 - alpha))
+            marginal = float(kp.get(
+                "spec_marginal_token_cost",
+                KERNEL_PROFILE["spec_marginal_token_cost"]))
+            draft_frac = float(kp.get(
+                "spec_draft_flops_frac",
+                KERNEL_PROFILE["spec_draft_flops_frac"]))
+            window_scale = (1.0 + k * marginal + k * draft_frac) \
+                / expected
+            compute *= window_scale
+            attn *= window_scale
+            # The verify dispatch is ONE program — its tp boundary
+            # all-reduces fire once per window, not once per token.
+            comm /= expected
         # Per-request cache residency: dense reserves the full max_len
         # lane whatever the request's length; paged reserves the mean
         # length rounded up to a block.
@@ -1448,9 +1565,27 @@ class CostModel:
             / max(tp, 1)
         kv = lane_bytes * resident * batch_slots
         mem = bytes_ + kv
+        if spec_k is not None:
+            # The draft rides along: its params + its full-capacity
+            # block pool cost spec_draft_flops_frac of the target's.
+            draft_frac = float(self.kernel_profile.get(
+                "spec_draft_flops_frac",
+                KERNEL_PROFILE["spec_draft_flops_frac"]))
+            mem += draft_frac * (bytes_ + kv)
         hbm = self.chip.hbm_gb * 1e9 * self.hbm_headroom
-        capacity = max(hbm - bytes_, 0.0) / max(lane_bytes * resident,
+        # Prefix caching: the shared leading run of a request's blocks
+        # is refcounted, not duplicated — at hit rate h each admission
+        # charges the pool only the novel (1 - h) suffix (floored at
+        # one block: the CoW-protected partial tail is always
+        # physically owned somewhere).
+        resident_eff = resident
+        if prefix_caching:
+            resident_eff = max(resident * (1.0 - float(prefix_hit_rate)),
+                               float(bl))
+        capacity = max(hbm - bytes_, 0.0) / max(lane_bytes * resident_eff,
                                                 1e-30)
+        if spec_k is not None:
+            capacity /= 1.0 + draft_frac
         # Router dispatch across DCN: a fleet too big for one slice
         # spreads replicas across slices, and a request routed to a
         # remote-slice replica ships its prompt over DCN once —
@@ -1472,7 +1607,13 @@ class CostModel:
                           attn_time_s=attn, kernel=tuple(sorted(kern)),
                           kv_layout=kv_layout,
                           request_capacity=capacity,
-                          replicas=replicas, dispatch_time_s=dispatch)
+                          replicas=replicas, dispatch_time_s=dispatch,
+                          prefill_chunk=prefill_chunk,
+                          prefix_caching=prefix_caching,
+                          prefix_hit_rate=(float(prefix_hit_rate)
+                                           if prefix_caching else 0.0),
+                          speculative=spec_k,
+                          spec_acceptance=spec_alpha)
 
     def strategy_cost(self, trainable: Trainable,
                       strategy: Strategy) -> StrategyCost:

@@ -124,6 +124,50 @@ def normalize_kv_layout(value) -> str:
     return str(value)
 
 
+# --------------------------------------------------------------------------- #
+# Serving throughput ladder (PR 16): three more ``parallel``-dict knobs,
+# each normalized here and seeded into the engine by
+# ``seed_engine_kwargs`` exactly like ``kv_layout``.  All three default
+# to OFF, which is what every pre-PR-16 strategy JSON deserializes to —
+# the absent-key form keeps earlier JSON byte-stable.
+# --------------------------------------------------------------------------- #
+def normalize_prefill_chunk(value):
+    """Canonicalize the chunked-prefill knob: ``None``/``0``/``False``
+    -> ``None`` (single-shot prefill, the pre-PR-16 behavior); a
+    positive int is the chunk length in tokens (the engine additionally
+    requires a ``kv_block_len`` multiple so chunk writes stay
+    block-granular).  Anything else raises ``ValueError``."""
+    if value in (None, 0, False, ""):
+        return None
+    if isinstance(value, bool) or not isinstance(value, int) or value < 1:
+        raise ValueError(
+            f"prefill_chunk must be None or a positive int (tokens per "
+            f"prefill chunk); got {value!r}")
+    return int(value)
+
+
+def normalize_prefix_caching(value) -> bool:
+    """Canonicalize the prefix-caching knob: truthy -> ``True`` (the
+    refcounted copy-on-write block allocator shares prompt-prefix
+    blocks), anything falsy -> ``False`` (pre-PR-16).  Requires the
+    paged layout — the engine validates, plan lint reports."""
+    return bool(value)
+
+
+def normalize_speculative(value):
+    """Canonicalize the speculative-decoding knob: ``None``/``0``/
+    ``False`` -> ``None`` (vanilla decode); a positive int is ``k``,
+    the number of draft tokens proposed per target verify step.
+    Anything else raises ``ValueError``."""
+    if value in (None, 0, False, ""):
+        return None
+    if isinstance(value, bool) or not isinstance(value, int) or value < 1:
+        raise ValueError(
+            f"speculative must be None or a positive int (draft tokens "
+            f"per verify step); got {value!r}")
+    return int(value)
+
+
 PRECISION_BOUNDARIES = (
     # dp gradient sync (all-reduce / reduce-scatter).  Realized through
     # the compressor machinery — the one boundary with persistent error-

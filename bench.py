@@ -550,6 +550,262 @@ def _replicas_arg() -> int:
     return 1
 
 
+def _prompt_mix_arg() -> str:
+    """`bench.py serve --prompt-mix {random,shared-prefix}` (same
+    argv-scan contract)."""
+    argv = sys.argv[1:]
+    mix = "random"
+    for i, a in enumerate(argv):
+        if a == "--prompt-mix" and i + 1 < len(argv):
+            mix = argv[i + 1]
+        elif a.startswith("--prompt-mix="):
+            mix = a.split("=", 1)[1]
+    if mix not in ("random", "shared-prefix"):
+        raise SystemExit(f"unknown --prompt-mix {mix!r}; expected "
+                         "'random' or 'shared-prefix'")
+    return mix
+
+
+def _speculative_arg() -> int:
+    """`bench.py serve --speculative [K]` (same argv-scan contract);
+    0 = off, bare flag defaults to K=4."""
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == "--speculative":
+            if i + 1 < len(argv) and argv[i + 1].isdigit():
+                return max(int(argv[i + 1]), 0)
+            return 4
+        if a.startswith("--speculative="):
+            return max(int(a.split("=", 1)[1]), 0)
+    return 0
+
+
+def _bench_serve_shared_prefix(dog):
+    """`bench.py serve --prompt-mix shared-prefix`: the prefix-caching
+    rung's capacity story, measured.  Every request in the mix opens
+    with the SAME system-prompt-style prefix; the mix runs twice at
+    EQUAL pool bytes — paged-alone, then paged + ``prefix_caching`` —
+    and the record carries both peak concurrently-admitted counts plus
+    the summed ``prefix_hit_blocks``.  The acceptance bar: the caching
+    run admits strictly more requests per pool byte."""
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import serving, telemetry
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+    from autodist_tpu.resource import ResourceSpec
+
+    on_accel = jax.default_backend() != "cpu"
+    rs = ResourceSpec({})
+    n = rs.num_devices()
+    if on_accel:
+        cfg = TransformerConfig(vocab_size=32768, hidden_size=1024,
+                                num_layers=8, num_heads=16, mlp_dim=4096,
+                                max_len=1024, dtype=jnp.bfloat16,
+                                dropout_rate=0.0,
+                                attention_dropout_rate=0.0)
+        dense_slots, K, prefill_len, max_new, requests = 8, 16, 512, 64, 24
+        bl, shared_len = 16, 256
+    else:  # CPU dev smoke: same code path, toy size
+        cfg = TransformerConfig(vocab_size=128, hidden_size=32,
+                                num_layers=2, num_heads=2, mlp_dim=64,
+                                max_len=64, dtype=jnp.float32,
+                                dropout_rate=0.0,
+                                attention_dropout_rate=0.0)
+        dense_slots, K, prefill_len, max_new, requests = 2, 4, 40, 8, 8
+        bl, shared_len = 8, 16
+    pool_blocks = dense_slots * (-(-cfg.max_len // bl))
+    slots = dense_slots * 4
+    lane = 2.0 * cfg.num_layers * cfg.hidden_size \
+        * jnp.dtype(cfg.dtype).itemsize
+    pool_bytes = int(pool_blocks * bl * lane)
+    telemetry.annotate(bench="serve_prefix_capacity_requests", devices=n,
+                       chip=rs.chip.name, prompt_mix="shared-prefix")
+    dog.stage = (f"serve shared-prefix bench (slots{slots}/"
+                 f"pool{pool_blocks}x{bl}: paged-alone vs prefix-cached)")
+
+    def run_mix(prefix_caching: bool):
+        trainable = make_pipeline_lm_trainable(
+            cfg, optax.adam(1e-3), jax.random.PRNGKey(0))
+        engine = serving.ServingEngine(
+            cfg, trainable.params, num_slots=slots, max_len=cfg.max_len,
+            prefill_len=prefill_len, decode_steps=K, kv_layout="paged",
+            kv_block_len=bl, kv_num_blocks=pool_blocks,
+            prefix_caching=prefix_caching)
+        batcher = serving.ContinuousBatcher(engine)
+        r = np.random.RandomState(0)
+        shared = r.randint(0, cfg.vocab_size, (shared_len,)).tolist()
+        t0 = time.perf_counter()
+        for _ in range(requests):
+            suffix_len = int(r.randint(1, prefill_len - shared_len + 1))
+            prompt = shared + r.randint(0, cfg.vocab_size,
+                                        (suffix_len,)).tolist()
+            # staggered decode budgets: completions interleave, so
+            # later admissions overlap resident holders of the shared
+            # prefix (a lockstep mix would release every reference
+            # between waves and no hit could ever occur)
+            batcher.submit(prompt,
+                           max_new_tokens=int(r.randint(2, max_new + 1)))
+        capacity = 0
+        before = set(batcher.completions)
+        while batcher._queue or batcher.active_slots:
+            batcher.step()
+            capacity = max(capacity, batcher.active_slots)
+        done = {rid: c for rid, c in batcher.completions.items()
+                if rid not in before}
+        wall = time.perf_counter() - t0
+        tokens = sum(len(c.tokens) for c in done.values())
+        hits = sum(c.prefix_hit_blocks for c in done.values())
+        return (capacity, hits,
+                tokens / wall if wall > 0 else 0.0)
+
+    try:
+        cap_alone, _, rate_alone = run_mix(prefix_caching=False)
+        cap_cached, hit_blocks, rate_cached = run_mix(prefix_caching=True)
+    except Exception as e:
+        dog.disarm()
+        if "UNAVAILABLE" in str(e) or "Connection" in str(e):
+            _unavailable_exit(f"transport: {e}")
+        print(json.dumps({
+            "metric": "serve_prefix_capacity_requests", "value": 0.0,
+            "unit": "requests", "vs_baseline": 0.0,
+            "prompt_mix": "shared-prefix",
+            "error": f"shared-prefix bench failed: {e}",
+            "provenance": _provenance()}))
+        sys.exit(4)
+    record = {
+        "metric": "serve_prefix_capacity_requests",
+        "value": float(cap_cached), "unit": "requests",
+        "vs_baseline": float(cap_alone),
+        "devices": n, "chip": rs.chip.name, "prompt_mix": "shared-prefix",
+        "kv_layout": "paged", "prefix_caching": True,
+        "slots": slots, "pool_blocks": pool_blocks,
+        "kv_block_len": bl, "pool_bytes": pool_bytes,
+        "shared_prefix_len": shared_len, "requests": requests,
+        "prefix_hit_blocks": hit_blocks,
+        "capacity_paged_alone": cap_alone,
+        "capacity_prefix_cached": cap_cached,
+        "requests_per_pool_gb": round(cap_cached / (pool_bytes / 1e9), 2),
+        "requests_per_pool_gb_paged_alone":
+            round(cap_alone / (pool_bytes / 1e9), 2),
+        "ladder": {"paged": round(rate_alone, 2),
+                   "paged+prefix_caching": round(rate_cached, 2)},
+        "scored": True, "provenance": _provenance(),
+    }
+    dog.disarm()
+    print(json.dumps(record), flush=True)
+    telemetry.gauge("serve/bench_prefix_capacity").set(float(cap_cached))
+    telemetry.flush()
+
+
+def _bench_serve_speculative(dog, spec_k: int):
+    """`bench.py serve --speculative [K]`: the speculative rung,
+    measured — the same mix through a vanilla engine and through a
+    target + 1-layer-draft speculative engine, recording the ladder's
+    tokens/sec pair and the MEASURED acceptance rate (the
+    ``spec_acceptance`` number ``rank_serving`` prices candidates
+    with; the ROADMAP recipe feeds it back via
+    ``calibration.json``)."""
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import serving, telemetry
+    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+    from autodist_tpu.models.transformer import TransformerConfig
+    from autodist_tpu.resource import ResourceSpec
+
+    on_accel = jax.default_backend() != "cpu"
+    rs = ResourceSpec({})
+    n = rs.num_devices()
+    if on_accel:
+        cfg = TransformerConfig(vocab_size=32768, hidden_size=1024,
+                                num_layers=8, num_heads=16, mlp_dim=4096,
+                                max_len=1024, dtype=jnp.bfloat16,
+                                dropout_rate=0.0,
+                                attention_dropout_rate=0.0)
+        slots, K, prefill_len, max_new, requests = 8, 16, 64, 128, 16
+    else:  # CPU dev smoke: same code path, toy size
+        cfg = TransformerConfig(vocab_size=128, hidden_size=32,
+                                num_layers=2, num_heads=2, mlp_dim=64,
+                                max_len=64, dtype=jnp.float32,
+                                dropout_rate=0.0,
+                                attention_dropout_rate=0.0)
+        slots, K, prefill_len, max_new, requests = 2, 4, 8, 8, 4
+    import dataclasses as _dc
+
+    draft_cfg = _dc.replace(cfg, num_layers=1)
+    telemetry.annotate(bench="serve_spec_tokens_per_sec", devices=n,
+                       chip=rs.chip.name, speculative=spec_k)
+    dog.stage = (f"serve speculative bench (k={spec_k}/slots{slots}: "
+                 "vanilla vs draft-verify)")
+
+    def run_mix(engine_kwargs):
+        trainable = make_pipeline_lm_trainable(
+            cfg, optax.adam(1e-3), jax.random.PRNGKey(0))
+        if "speculative" in engine_kwargs:
+            draft = make_pipeline_lm_trainable(
+                draft_cfg, optax.adam(1e-3), jax.random.PRNGKey(1))
+            engine_kwargs = dict(engine_kwargs, draft_cfg=draft_cfg,
+                                 draft_params=draft.params)
+        engine = serving.ServingEngine(
+            cfg, trainable.params, num_slots=slots, max_len=cfg.max_len,
+            prefill_len=prefill_len, decode_steps=K, kv_layout="paged",
+            kv_block_len=16, **engine_kwargs)
+        batcher = serving.ContinuousBatcher(engine)
+        r = np.random.RandomState(0)
+        batcher.submit(
+            r.randint(0, cfg.vocab_size, (4,)).tolist(), max_new_tokens=K)
+        batcher.run()
+        t0 = time.perf_counter()
+        for _ in range(requests):
+            plen = int(r.randint(1, prefill_len + 1))
+            batcher.submit(r.randint(0, cfg.vocab_size, (plen,)).tolist(),
+                           max_new_tokens=max_new)
+        before = set(batcher.completions)
+        while batcher._queue or batcher.active_slots:
+            batcher.step()
+        done = {rid: c for rid, c in batcher.completions.items()
+                if rid not in before}
+        wall = time.perf_counter() - t0
+        tokens = sum(len(c.tokens) for c in done.values())
+        proposed = sum(c.spec_proposed for c in done.values())
+        accepted = sum(c.spec_accepted for c in done.values())
+        return tokens / wall if wall > 0 else 0.0, proposed, accepted
+
+    try:
+        rate_vanilla, _, _ = run_mix({})
+        rate_spec, proposed, accepted = run_mix({"speculative": spec_k})
+    except Exception as e:
+        dog.disarm()
+        if "UNAVAILABLE" in str(e) or "Connection" in str(e):
+            _unavailable_exit(f"transport: {e}")
+        print(json.dumps({
+            "metric": "serve_spec_tokens_per_sec", "value": 0.0,
+            "unit": "tokens_per_sec", "vs_baseline": 0.0,
+            "speculative": spec_k,
+            "error": f"speculative bench failed: {e}",
+            "provenance": _provenance()}))
+        sys.exit(4)
+    acceptance = accepted / proposed if proposed else 0.0
+    record = {
+        "metric": "serve_spec_tokens_per_sec",
+        "value": round(rate_spec, 2), "unit": "tokens_per_sec",
+        "vs_baseline": round(rate_vanilla, 2),
+        "devices": n, "chip": rs.chip.name, "kv_layout": "paged",
+        "speculative": spec_k, "requests": requests,
+        "spec_proposed": proposed, "spec_accepted": accepted,
+        "spec_acceptance": round(acceptance, 4),
+        "ladder": {"paged": round(rate_vanilla, 2),
+                   f"paged+speculative_k{spec_k}": round(rate_spec, 2)},
+        "scored": True, "provenance": _provenance(),
+    }
+    dog.disarm()
+    print(json.dumps(record), flush=True)
+    telemetry.gauge("serve/bench_spec_acceptance").set(acceptance)
+    telemetry.flush()
+
+
 def _bench_serve_fleet(dog, replicas: int):
     """`bench.py serve --replicas N`: the fleet record — aggregate
     tokens/sec through the router over N replicas, and the robustness
@@ -674,10 +930,19 @@ def _bench_serve(dog):
     ``--replicas N`` (N > 1) switches to the fleet bench
     (:func:`_bench_serve_fleet`): the same mix through a
     ``ServingFleet`` + ``Router``, recorded with and without one
-    injected replica kill mid-run."""
+    injected replica kill mid-run.
+
+    ``--prompt-mix shared-prefix`` switches to the prefix-caching rung
+    (:func:`_bench_serve_shared_prefix`); ``--speculative [K]`` to the
+    speculative rung (:func:`_bench_serve_speculative`)."""
     replicas = _replicas_arg()
     if replicas > 1:
         return _bench_serve_fleet(dog, replicas)
+    if _prompt_mix_arg() == "shared-prefix":
+        return _bench_serve_shared_prefix(dog)
+    spec_k = _speculative_arg()
+    if spec_k:
+        return _bench_serve_speculative(dog, spec_k)
     import jax.numpy as jnp
     import optax
 

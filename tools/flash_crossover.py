@@ -92,6 +92,17 @@ def main():
                          "single-query flash-decode vs the composed "
                          "einsum cache attention over --seqs cache "
                          "lengths")
+    ap.add_argument("--prefill", action="store_true",
+                    help="measure the chunked-prefill crossover: the "
+                         "paged flash-prefill kernel vs the composed "
+                         "gather path over --chunks chunk sizes at "
+                         "each --seqs cache length; "
+                         "--write-calibration merges "
+                         "flash_prefill_crossover_chunk / "
+                         "flash_prefill_speedup into the 'kernel' "
+                         "section")
+    ap.add_argument("--chunks", default="64,128,256,512",
+                    help="--prefill: prefill chunk sizes to sweep")
     ap.add_argument("--slots", type=int, default=8,
                     help="--decode: batch slots per step")
     ap.add_argument("--fill", default="1.0,0.5",
@@ -107,6 +118,8 @@ def main():
     args = ap.parse_args()
     if args.decode:
         return _main_decode(args)
+    if args.prefill:
+        return _main_prefill(args)
 
     H, D = args.heads, args.head_dim
     causal = bool(args.causal)
@@ -260,6 +273,116 @@ def _main_decode(args):
         meta = dict(table.get("meta", {}))
         meta["kernel_source"] = (
             f"tools/flash_crossover.py --decode on "
+            f"{jax.devices()[0].device_kind} "
+            f"({provenance().get('git_sha', '')[:12]})")
+        table["meta"] = meta
+        tmp = args.write_calibration + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(table, f, indent=1)
+        os.replace(tmp, args.write_calibration)
+        print(f"# wrote kernel section to {args.write_calibration}",
+              file=sys.stderr)
+
+
+def _main_prefill(args):
+    """The ``--prefill`` mode: one record per (cache length, chunk
+    size) point — the paged flash-prefill kernel against its composed
+    gather golden on identical block tables — and the summary derives
+    the chunk-size crossover.  ``--write-calibration`` merges
+    ``flash_prefill_crossover_chunk`` / ``flash_prefill_speedup`` into
+    the ``"kernel"`` section ``CostModel`` loads, closing the loop:
+    ``default_serving_candidates(ladder=True)`` seeds its chunked
+    candidate at exactly this measured chunk."""
+    from autodist_tpu.kernel.pallas.flash_prefill import \
+        flash_prefill_attention_paged
+    from autodist_tpu.serving.kv_cache import paged_chunk_attention
+    from autodist_tpu.telemetry.records import provenance
+
+    H, D, B = args.heads, args.head_dim, args.slots
+    records = []
+    chunks = [int(c) for c in args.chunks.split(",")]
+    for T in [int(s) for s in args.seqs.split(",")]:
+        bl = 16
+        max_blocks = -(-T // bl)
+        r = np.random.RandomState(0)
+        k_pool = jnp.asarray(
+            r.randn(B * max_blocks, H, bl, D), jnp.bfloat16)
+        v_pool = jnp.asarray(
+            r.randn(B * max_blocks, H, bl, D), jnp.bfloat16)
+        table = jnp.asarray(
+            r.permutation(B * max_blocks).reshape(B, max_blocks),
+            jnp.int32)
+        for C in chunks:
+            if C > T:
+                continue
+            q = jnp.asarray(r.randn(B, C, H, D), jnp.bfloat16)
+            # every slot's chunk starts mid-prompt: rows attend through
+            # earlier blocks via the table, the shape the chunked
+            # prefill loop dispatches
+            starts = jnp.full((B,), T - C, jnp.int32)
+            t_gather = timed(jax.jit(
+                lambda q, s, t: paged_chunk_attention(
+                    q, k_pool, v_pool, s, t, block_len=bl,
+                    dtype=jnp.bfloat16)),
+                (q, starts, table), args.steps)
+            try:
+                t_flash = timed(jax.jit(
+                    lambda q, s, t: flash_prefill_attention_paged(
+                        q, k_pool, v_pool, s, t, block_len=bl,
+                        dtype=jnp.bfloat16)),
+                    (q, starts, table), args.steps)
+            except Exception as e:
+                print(f"# flash prefill T={T} chunk={C} failed: {e}",
+                      file=sys.stderr)
+                continue
+            rec = {
+                "metric": "flash_prefill_crossover",
+                "kv_len": T, "chunk": C, "slots": B, "heads": H,
+                "head_dim": D, "block_len": bl,
+                "gather_ms": round(t_gather * 1e3, 4),
+                "flash_ms": round(t_flash * 1e3, 4),
+                "value": round(t_gather / t_flash, 4),
+                "unit": "ratio", "scored": True,
+                "provenance": provenance(),
+            }
+            records.append(rec)
+            print(json.dumps(rec), flush=True)
+    wins = sorted({r["chunk"] for r in records if r["value"] > 1.0})
+    crossover = wins[0] if wins else None
+    speedups = [r["value"] for r in records
+                if crossover is not None and r["chunk"] >= crossover]
+    print(json.dumps({
+        "summary": (f"flash prefill wins from chunk {crossover}"
+                    if crossover is not None
+                    else "the composed gather wins at every measured "
+                         "chunk size"),
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+    }))
+    if args.write_calibration and records:
+        if jax.default_backend() == "cpu":
+            print("# refusing to write CPU-measured kernel constants "
+                  f"into {args.write_calibration}", file=sys.stderr)
+            return
+        table = {}
+        if os.path.exists(args.write_calibration):
+            try:
+                with open(args.write_calibration) as f:
+                    table = json.load(f)
+            except (OSError, ValueError):
+                table = {}
+        kern = dict(table.get("kernel", {}))
+        if crossover is not None:
+            kern["flash_prefill_crossover_chunk"] = crossover
+            kern["flash_prefill_speedup"] = round(
+                sum(speedups) / len(speedups), 3)
+        else:
+            kern["flash_prefill_crossover_chunk"] = 2 * max(
+                r["chunk"] for r in records)
+        table["kernel"] = kern
+        meta = dict(table.get("meta", {}))
+        meta["kernel_prefill_source"] = (
+            f"tools/flash_crossover.py --prefill on "
             f"{jax.devices()[0].device_kind} "
             f"({provenance().get('git_sha', '')[:12]})")
         table["meta"] = meta

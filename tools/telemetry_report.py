@@ -32,11 +32,17 @@ _PRECISION_BITS = {"fp32": 32, "bf16": 16, "int8": 8}
 # (the pipeline lowering for the training kernels, the serving engine
 # for flash_decode); a manifest run.kernel annotation without its gauge
 # means the election was silently dropped — --check fails it.
-_KERNEL_CHOICES = ("flash_decode", "quant_ring", "collective_matmul")
+_KERNEL_CHOICES = ("flash_decode", "flash_prefill", "quant_ring",
+                   "collective_matmul")
 # Per-request serving records (autodist_tpu/serving/batcher.py): the
-# latency facts the serving section aggregates.
+# latency facts the serving section aggregates.  The PR-16 throughput-
+# ladder fields are REQUIRED: every completion reports its prefix hit
+# blocks, speculative proposal/acceptance tallies, and how many chunked
+# prefill dispatches admitted it (1 = single-shot) — a serve record
+# without them means the batcher dropped the rung accounting.
 _SERVE_KEYS = {"kind", "request", "tokens", "ttft_ms", "tokens_per_sec",
-               "kv_layout"}
+               "kv_layout", "prefix_hit_blocks", "spec_proposed",
+               "spec_accepted", "prefill_chunks"}
 # Paged-KV pool gauges (autodist_tpu/serving/engine.py): a paged
 # engine emits serve/kv_blocks_free + serve/kv_blocks_used on every
 # block reservation/release.  A run whose serve records declare
@@ -118,6 +124,18 @@ def check_schema(run_dir: str) -> list[str]:
                 problems.append(
                     f"metrics.jsonl:{i + 1}: serve record missing "
                     f"{sorted(missing)}")
+            else:
+                if rec["spec_accepted"] > rec["spec_proposed"]:
+                    problems.append(
+                        f"metrics.jsonl:{i + 1}: spec_accepted="
+                        f"{rec['spec_accepted']} exceeds spec_proposed="
+                        f"{rec['spec_proposed']} — the verify pass "
+                        "accepted tokens the draft never proposed")
+                if rec["prefill_chunks"] < 1:
+                    problems.append(
+                        f"metrics.jsonl:{i + 1}: prefill_chunks="
+                        f"{rec['prefill_chunks']!r} — an admitted "
+                        "request spans at least one prefill dispatch")
         elif kind == "reshard":
             missing = _RESHARD_KEYS - set(rec)
             if missing:
@@ -433,6 +451,27 @@ def render(run_dir: str) -> str:
                          if g["name"] == "serve/kv_blocks_used"), None)
             lines += [f"- kv block pool (final): {_fmt(used)} used / "
                       f"{_fmt(free)} free", ""]
+        # The throughput ladder (chunked prefill / prefix caching /
+        # speculative decoding): rendered whenever any request rode a
+        # rung — the per-request fields are always recorded, so an
+        # all-zero ladder simply stays silent.
+        hit_blocks = sum(int(r.get("prefix_hit_blocks", 0))
+                         for r in serves)
+        proposed = sum(int(r.get("spec_proposed", 0)) for r in serves)
+        accepted = sum(int(r.get("spec_accepted", 0)) for r in serves)
+        chunked = [int(r.get("prefill_chunks", 1)) for r in serves
+                   if int(r.get("prefill_chunks", 1)) > 1]
+        if hit_blocks or proposed or chunked:
+            acceptance = accepted / proposed if proposed else None
+            lines += ["### throughput ladder", "",
+                      "| prefix hit blocks | chunked prefills | "
+                      "chunks p50 | spec proposed | spec accepted | "
+                      "acceptance rate |",
+                      "|---|---|---|---|---|---|",
+                      f"| {hit_blocks} | {len(chunked)} "
+                      f"| {_fmt(float(np.percentile(chunked, 50)) if chunked else None)} "
+                      f"| {proposed} | {accepted} "
+                      f"| {_fmt(acceptance)} |", ""]
 
     if dispatches:
         # The fleet section: routing decisions by reason, the hedge
