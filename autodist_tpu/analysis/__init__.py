@@ -25,7 +25,8 @@ from autodist_tpu.analysis.diagnostics import (CODES, ERROR, INFO,  # noqa: F401
 from autodist_tpu.analysis.facts import ProgramFacts  # noqa: F401
 from autodist_tpu.analysis.plan_rules import (PLAN_RULES,  # noqa: F401
                                               degraded_diagnostics,
-                                              lint_fleet, lint_plan,
+                                              lint_disagg, lint_fleet,
+                                              lint_handoff, lint_plan,
                                               lint_reshard,
                                               lint_supervision)
 from autodist_tpu.analysis.program_rules import (Rule,  # noqa: F401
@@ -38,7 +39,8 @@ from autodist_tpu.analysis.program_rules import (Rule,  # noqa: F401
 
 __all__ = [
     "CODES", "ERROR", "WARNING", "INFO", "Diagnostic", "LintReport",
-    "ProgramFacts", "PLAN_RULES", "degraded_diagnostics", "lint_fleet",
+    "ProgramFacts", "PLAN_RULES", "degraded_diagnostics", "lint_disagg",
+    "lint_fleet", "lint_handoff",
     "lint_plan", "lint_reshard", "lint_supervision", "Rule",
     "check_program",
     "lint_block_trace", "lint_program",

@@ -72,6 +72,9 @@ CODES: dict[str, tuple[str, str]] = {
     "ADT071": (WARNING, "compressor error-feedback state not "
                         "transferable across this reshard "
                         "(reinitialized on the target)"),
+    "ADT072": (ERROR, "KV handoff plan's per-device gather exceeds the "
+                      "shard budget (a full-pool staging wearing a "
+                      "prefix handoff's name)"),
     "ADT090": (ERROR, "fused kernel elected without its enabling knob "
                       "(the kernel slot would be a silent no-op or a "
                       "contradiction)"),
@@ -94,6 +97,9 @@ CODES: dict[str, tuple[str, str]] = {
     "ADT088": (ERROR, "fleet tensor_parallel spans the cross-slice DCN "
                       "boundary (tp stays within a slice's ICI; only "
                       "replica dispatch rides DCN)"),
+    "ADT089": (ERROR, "disaggregated pool split exceeds the device "
+                      "budget, or the decode pool's tensor_parallel "
+                      "spans the cross-slice DCN boundary"),
     # --- program lint (optimized HLO) -------------------------------- #
     "ADT101": (ERROR, "step program contains a host transfer"),
     "ADT102": (ERROR, "multi-step window lowered without a fused loop"),

@@ -344,6 +344,105 @@ def _fleet_mutations() -> list[FleetMutation]:
     ]
 
 
+def _disagg_fixture():
+    """A CLEAN disaggregated pool split on a two-slice 8-device
+    topology (1 prefill + 2 decode replicas of a tp=2 group: 6 of 8
+    devices, tp well within a slice's 4-device ICI) — the base every
+    ADT089 mutation doctors."""
+    from autodist_tpu.resource import ResourceSpec
+
+    spec = ResourceSpec({"topology": {"num_devices": 8, "num_slices": 2}})
+    config = {"prefill_replicas": 1, "decode_replicas": 2,
+              "tensor_parallel": 2, "kv_layout": "paged"}
+    return config, spec
+
+
+@dataclasses.dataclass
+class DisaggMutation:
+    """Doctor a clean disaggregated pool split; the disagg lint must
+    fire ``code`` on the doctored shape and stay silent on the honest
+    one."""
+
+    name: str
+    code: str
+    description: str
+    mutate: Callable  # (dict) -> dict
+    kind: str = "disagg"
+
+    def run(self) -> dict:
+        from autodist_tpu.analysis.plan_rules import lint_disagg
+
+        config, spec = _disagg_fixture()
+        clean = lint_disagg(config, resource_spec=spec)
+        mutated = lint_disagg(self.mutate(dict(config)),
+                              resource_spec=spec)
+        return {"name": self.name, "kind": self.kind, "code": self.code,
+                "clean_ok": self.code not in clean.codes(),
+                "fired": self.code in mutated.codes(),
+                "description": self.description}
+
+
+def _disagg_mutations() -> list[DisaggMutation]:
+    return [
+        DisaggMutation(
+            "disagg_pools_overflow_topology", "ADT089",
+            "decode pool grown until (prefill + decode) x tp exceeds "
+            "the device budget — the elected split cannot be placed",
+            lambda c: dict(c, decode_replicas=4)),
+        DisaggMutation(
+            "disagg_decode_tp_across_dcn", "ADT089",
+            "decode-pool tp degree raised past a slice's ICI degree — "
+            "decode's per-token boundary all-reduces would ride DCN",
+            lambda c: dict(c, prefill_replicas=1, decode_replicas=1,
+                           tensor_parallel=8)),
+    ]
+
+
+def _handoff_fixture() -> dict:
+    """An HONEST prefill→decode handoff plan: 4 prefix blocks routed
+    through the compiled per-block gathers, each participant staging
+    4 blocks' worth of one pool shard — an order of magnitude under
+    the shard budget (one full per-device pool shard)."""
+    return {"prefill_replica": "prefill-0", "decode_replica": "decode-0",
+            "blocks": 4, "per_device_gather_elems": 4 * 640,
+            "budget_elems": 64 * 640}
+
+
+@dataclasses.dataclass
+class HandoffMutation:
+    """Doctor an honest KV handoff plan; the handoff lint must fire
+    ``code`` on the doctored plan and stay silent on the honest one."""
+
+    name: str
+    code: str
+    description: str
+    mutate: Callable  # (dict) -> dict
+    kind: str = "handoff"
+
+    def run(self) -> dict:
+        from autodist_tpu.analysis.plan_rules import lint_handoff
+
+        plan = _handoff_fixture()
+        clean = lint_handoff(plan)
+        mutated = lint_handoff(self.mutate(dict(plan)))
+        return {"name": self.name, "kind": self.kind, "code": self.code,
+                "clean_ok": self.code not in clean.codes(),
+                "fired": self.code in mutated.codes(),
+                "description": self.description}
+
+
+def _handoff_mutations() -> list[HandoffMutation]:
+    return [
+        HandoffMutation(
+            "handoff_gathers_full_pool", "ADT072",
+            "the per-block route is replaced by a full-pool staging — "
+            "every participant materializes the whole pool instead of "
+            "the request's prefix blocks",
+            lambda p: dict(p, blocks=64,
+                           per_device_gather_elems=4 * 64 * 640)),
+    ]
+
+
 def _block_trace_fixture() -> list:
     """An HONEST allocator event trace: the exact sequence the serving
     engine's prefix-caching path produces for two requests sharing a
@@ -896,7 +995,8 @@ def _program_mutations() -> list[ProgramMutation]:
 def all_mutations() -> list:
     return (_plan_mutations() + _program_mutations()
             + _reshard_mutations() + _supervision_mutations()
-            + _fleet_mutations() + _block_trace_mutations())
+            + _fleet_mutations() + _disagg_mutations()
+            + _handoff_mutations() + _block_trace_mutations())
 
 
 def run_mutations(names=None, kinds=None) -> list[dict]:

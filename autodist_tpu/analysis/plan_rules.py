@@ -859,6 +859,102 @@ def lint_fleet(config, resource_spec=None) -> LintReport:
 
 
 # --------------------------------------------------------------------------- #
+# Disaggregated-serving lint (prefill/decode pools, ADT089 + ADT072)
+# --------------------------------------------------------------------------- #
+def lint_disagg(config, resource_spec=None) -> LintReport:
+    """Check a disaggregated pool split (a
+    :class:`~autodist_tpu.serving.disagg.DisaggConfig`, a
+    ``DisaggServer.describe()`` dict, or a hand-written dict with the
+    same keys) BEFORE any pool is built — the plan-level gate for the
+    splits the topology cannot actually place.
+
+    * **ADT089** (error): ``(prefill_replicas + decode_replicas) ×
+      tensor_parallel`` exceeds the topology's device count — the
+      elected split does not fit the budget the election promised it
+      would.
+    * **ADT089** (error): the decode pool's ``tensor_parallel`` exceeds
+      a slice's ICI degree — decode's per-token boundary all-reduces
+      would ride DCN (the disaggregated analog of ADT088; only the
+      prefill→decode handoff and router dispatch may cross slices).
+    """
+    d = config.to_dict() if hasattr(config, "to_dict") else dict(config)
+    report = LintReport()
+    prefill = int(d.get("prefill_replicas", 1) or 1)
+    decode = int(d.get("decode_replicas", 1) or 1)
+    tp = int(d.get("tensor_parallel", 1) or 1)
+    if resource_spec is not None:
+        try:
+            num_devices = resource_spec.num_devices()
+        except (ValueError, RuntimeError):
+            num_devices = None
+        if num_devices is not None \
+                and (prefill + decode) * tp > num_devices:
+            report.extend([Diagnostic(
+                "ADT089",
+                f"pool split prefill={prefill} + decode={decode} at "
+                f"tensor_parallel={tp} needs "
+                f"{(prefill + decode) * tp} devices; the topology has "
+                f"{num_devices}",
+                where="disagg.pool_split",
+                fix="shrink a pool (or the tp degree) until "
+                    "(prefill + decode) x tp fits the device count — "
+                    "rank_serving(objective='disagg') only elects "
+                    "splits that fit")])
+        num_slices = max(int(getattr(resource_spec, "num_slices", 1)
+                             or 1), 1)
+        if num_devices is not None and num_slices > 1 \
+                and tp > num_devices // num_slices:
+            report.extend([Diagnostic(
+                "ADT089",
+                f"decode-pool tensor_parallel={tp} exceeds the "
+                f"{num_devices // num_slices} devices a slice's ICI "
+                f"connects ({num_slices} slices): decode's per-token "
+                "boundary all-reduces would ride DCN",
+                where="disagg.tensor_parallel",
+                fix="keep tp within a slice; spread pool replicas "
+                    "across slices instead (only the KV handoff and "
+                    "router dispatch may cross the DCN boundary)")])
+    return report.sorted()
+
+
+def lint_handoff(plan, budget_elems=None) -> LintReport:
+    """Check a prefill→decode KV handoff plan (a
+    :class:`~autodist_tpu.serving.disagg.HandoffPlan`, its ``to_dict``
+    form, or a hand-written dict) against the ADT110 shard-granularity
+    contract BEFORE the transfer compiles.
+
+    * **ADT072** (error): the plan's per-device gather
+      (``per_device_gather_elems`` — the largest materialization any
+      participant stages while moving the prefix blocks) exceeds the
+      shard budget (``budget_elems`` here, or the plan's own
+      ``budget_elems`` — computed like
+      :func:`autodist_tpu.elastic.reshard.shard_budget`: the largest
+      per-device stored pool shard).  A handoff moving a request's
+      prefix blocks stays well under one pool shard; exceeding it
+      means the route regressed to a full-pool staging.
+    """
+    d = plan.to_dict() if hasattr(plan, "to_dict") else dict(plan)
+    report = LintReport()
+    gather = int(d.get("per_device_gather_elems", 0) or 0)
+    budget = int(budget_elems if budget_elems is not None
+                 else d.get("budget_elems", 0) or 0)
+    if budget > 0 and gather > budget:
+        report.extend([Diagnostic(
+            "ADT072",
+            f"per-device gather of {gather} elements exceeds the "
+            f"shard budget of {budget} "
+            f"({d.get('blocks', '?')} block(s) routed "
+            f"{d.get('prefill_replica', '?')} -> "
+            f"{d.get('decode_replica', '?')}): the handoff would "
+            "materialize more than one pool shard per participant",
+            where="handoff.per_device_gather_elems",
+            fix="hand off only the request's prefix blocks through the "
+                "compiled per-block route (copy_pool_block gathers); "
+                "never stage the full pool")])
+    return report.sorted()
+
+
+# --------------------------------------------------------------------------- #
 # Entry point
 # --------------------------------------------------------------------------- #
 def lint_plan(strategy: Strategy, resource_spec=None, trainable=None,

@@ -605,10 +605,19 @@ class Cluster:
         """Start the native coordination server (chief only); returns its
         advertised host:port and exports it to this process's env so the
         chief's own :func:`~autodist_tpu.runtime.coordination.service_client`
-        finds it."""
+        finds it.
+
+        The port is elected by a HELD-socket reservation
+        (:func:`~autodist_tpu.runtime.coordination.reserve_coord_port`):
+        the exclusively-bound socket is handed straight to the native
+        server, so concurrent spawns (two replica-host clusters
+        starting at once) can never elect the same ephemeral port — the
+        old bind-then-release probe raced in exactly that window."""
         if self._coord_server is None:
-            from autodist_tpu.runtime.coordination import CoordServer
-            self._coord_server = CoordServer()
+            from autodist_tpu.runtime.coordination import (
+                CoordServer, reserve_coord_port)
+            self._coord_server = CoordServer(
+                listen_sock=reserve_coord_port())
             addr = f"{self._coord_host}:{self._coord_server.port}"
             os.environ["AUTODIST_TPU_COORD_SERVICE"] = addr
             logging.info("coordination service at %s", addr)
@@ -701,7 +710,18 @@ class Cluster:
         port = self._coord_server.port
         self._coord_server.stop()
         time.sleep(down_s)
-        self._coord_server = CoordServer(port=port)
+        # Lingering FIN-WAIT-2 sockets from clients that have not yet
+        # noticed the drop can hold the port briefly; retry the rebind
+        # rather than failing the whole scenario.
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                self._coord_server = CoordServer(port=port)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.1)
         return f"{self._coord_host}:{port}"
 
     def join(self, timeout: Optional[float] = None):

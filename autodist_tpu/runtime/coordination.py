@@ -59,6 +59,8 @@ def _load():
     lib.coord_server_start.restype = ctypes.c_void_p
     lib.coord_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                        ctypes.c_char_p]
+    lib.coord_server_adopt.restype = ctypes.c_void_p
+    lib.coord_server_adopt.argtypes = [ctypes.c_int, ctypes.c_char_p]
     lib.coord_server_port.restype = ctypes.c_int
     lib.coord_server_port.argtypes = [ctypes.c_void_p]
     lib.coord_server_stop.argtypes = [ctypes.c_void_p]
@@ -103,6 +105,37 @@ def _load():
     return lib
 
 
+def reserve_coord_port(bind_host: Optional[str] = None):
+    """Reserve an ephemeral coordination port by HOLDING it: bind a
+    listening socket on port 0 and return it still bound.  The kernel's
+    ephemeral allocator never hands a bound port to anyone else, so two
+    concurrent spawns can never elect the same port — hand the held
+    socket to ``CoordServer(listen_sock=...)``, which adopts the fd
+    directly (the port is never released between election and serve; the
+    old bind-then-release probe raced exactly in that gap).
+
+    ``SO_REUSEADDR`` is set NOT to share the port — it never permits a
+    second live listener, so the reservation stays exclusive — but so
+    accepted connections inherit it: after a chief bounce
+    (``coord_drop``), server-side sockets linger in FIN-WAIT-2 until
+    slow clients notice, and without the flag on BOTH old and new
+    sockets the kernel refuses to rebind the same port.
+    """
+    import socket
+
+    if bind_host is None:
+        bind_host = const.ENV.AUTODIST_TPU_COORD_BIND.val
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((bind_host or "0.0.0.0", 0))
+        sock.listen(128)
+    except OSError:
+        sock.close()
+        raise
+    return sock
+
+
 class CoordServer:
     """In-process native coordination server (run by the chief).
 
@@ -115,10 +148,16 @@ class CoordServer:
     clients and launched workers inherit it.  ``bind_host`` restricts the
     listening interface (``AUTODIST_TPU_COORD_BIND``; default all
     interfaces, as remote workers must reach the chief).
+
+    ``listen_sock`` (a held socket from :func:`reserve_coord_port`)
+    hands an already-bound listening fd straight to the native server —
+    the race-free path for concurrent spawns that must each advertise a
+    distinct port before their server exists.  The server takes
+    ownership of the fd.
     """
 
     def __init__(self, port: int = 0, bind_host: Optional[str] = None,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None, listen_sock=None):
         self._lib = _load()
         if bind_host is None:
             bind_host = const.ENV.AUTODIST_TPU_COORD_BIND.val
@@ -129,8 +168,18 @@ class CoordServer:
                 token = secrets.token_hex(16)
                 os.environ["AUTODIST_TPU_COORD_TOKEN"] = token
         self.token = token
-        self._handle = self._lib.coord_server_start(
-            (bind_host or "").encode(), port, token.encode())
+        if listen_sock is not None:
+            fd = listen_sock.detach()   # native side owns it now
+            os.set_inheritable(fd, False)
+            self._handle = self._lib.coord_server_adopt(
+                fd, token.encode())
+            if not self._handle:
+                os.close(fd)
+                raise OSError(
+                    "could not adopt the reserved coordination socket")
+        else:
+            self._handle = self._lib.coord_server_start(
+                (bind_host or "").encode(), port, token.encode())
         if not self._handle:
             raise OSError(f"could not start coordination server on port {port}")
         self.port = self._lib.coord_server_port(self._handle)

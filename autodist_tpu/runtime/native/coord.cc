@@ -382,6 +382,26 @@ void* coord_server_start(const char* bind_host, int port, const char* token) {
   return srv;
 }
 
+// Adopts an already-bound, already-listening socket fd (the held-socket
+// port reservation handoff: the caller binds an exclusive ephemeral
+// port, keeps the socket held so no concurrent spawn can elect the same
+// port, and hands the fd straight to the server — the port is never
+// released between election and serve).  Takes ownership of `fd`.
+void* coord_server_adopt(int fd, const char* token) {
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) < 0) {
+    return nullptr;
+  }
+  auto* srv = new Server();
+  srv->listen_fd = fd;
+  srv->port = ntohs(addr.sin_port);
+  if (token != nullptr) srv->token = token;
+  srv->accept_thread = std::thread([srv] { srv->Serve(); });
+  return srv;
+}
+
 int coord_server_port(void* handle) {
   return handle ? static_cast<Server*>(handle)->port : -1;
 }

@@ -15,7 +15,16 @@ the steps-per-loop fused dispatch; this package adds the decode loop:
   :mod:`~autodist_tpu.serving.router` — the fault-tolerant multi-
   replica tier: N engine+batcher replica groups behind a queue-depth-
   aware router with health-checked lifecycle, failover re-dispatch
-  (at-most-once token emission), hedging, and drain/replacement.
+  (at-most-once token emission), hedging, and drain/replacement;
+* :mod:`~autodist_tpu.serving.remote` — the same fleet across real
+  OS processes: one engine-loop worker per replica over the
+  coordination service, with the Router unchanged
+  (:class:`ProcessFleet` swaps only the spawn/kill/beat edges);
+* :mod:`~autodist_tpu.serving.disagg` — prefill/decode pool
+  disaggregation with a compiled, ADT110-linted KV-prefix handoff and
+  a cost-model-elected pool split;
+* :mod:`~autodist_tpu.serving.autoscale` — queue-depth / TTFT-p99
+  triggered fleet scaling driven by :mod:`tools.loadgen` traces.
 
 Typical use (see ``docs/usage/serving.md`` / ``examples/serve.py``)::
 
@@ -41,6 +50,12 @@ from autodist_tpu.serving.kv_cache import (BlockAllocator, KVCache,
                                            init_paged_cache)
 from autodist_tpu.serving.router import (DISPATCH_REASONS, FleetCompletion,
                                          PromptBudgetError, Router)
+from autodist_tpu.serving.autoscale import Autoscaler, AutoscaleConfig
+from autodist_tpu.serving.disagg import (DisaggConfig, DisaggServer,
+                                         HandoffError, HandoffPlan,
+                                         elect_pool_split)
+from autodist_tpu.serving.remote import (ProcessFleet, RemoteReplica,
+                                         tiny_engine_factory)
 
 __all__ = [
     "ServingEngine", "ContinuousBatcher", "Request", "Completion",
@@ -51,6 +66,9 @@ __all__ = [
     "ServingFleet", "FleetConfig", "Replica", "Router",
     "FleetCompletion", "DISPATCH_REASONS", "ReplicaCrashedError",
     "FleetDrainedError",
+    "ProcessFleet", "RemoteReplica", "tiny_engine_factory",
+    "DisaggServer", "DisaggConfig", "HandoffPlan", "HandoffError",
+    "elect_pool_split", "Autoscaler", "AutoscaleConfig",
 ]
 
 

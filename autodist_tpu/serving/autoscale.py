@@ -1,0 +1,197 @@
+"""Trace-driven fleet autoscaling: queue-depth and TTFT-p99 triggers.
+
+A fixed-size fleet sized for the diurnal peak idles most of the day;
+sized for the mean, it melts at the peak.  The :class:`Autoscaler`
+closes the loop between the traffic and the fleet's replica count:
+
+* **grow** when demand outruns capacity — the per-replica backlog
+  (queued + active work per admitting replica) crosses
+  ``grow_queue_depth``, or the p99 time-to-first-token over the recent
+  completion window crosses ``grow_ttft_p99_ms`` (the latency trigger
+  catches pressure the backlog gauge misses: long prompts make TTFT
+  crawl before queues visibly build).  Growing spawns one fresh
+  replica through :meth:`ServingFleet.grow` — the router's next pick
+  sees it via ``fleet.admitting``.
+* **shrink** when capacity outruns demand — backlog below
+  ``shrink_queue_depth`` with the latency trigger quiet.  Shrinking
+  drains the least-loaded replica through the ROUTER
+  (``drain_replica``): queued dispatches re-home immediately, in-flight
+  ones finish where they run, and the fleet retires the empty replica
+  — never a kill, so scale-in loses no tokens.
+
+Every transition emits one ``kind="scale"`` telemetry record
+(direction, the trigger that fired, its measured value and threshold,
+replica counts before/after, the replica spawned or drained), and the
+trigger gauges ``autoscale/queue_depth`` / ``autoscale/ttft_p99_ms``
+are refreshed every step — ``tools/telemetry_report.py --check``
+schema-gates the records and requires the gauges alongside them.
+Hysteresis comes from the gap between the grow and shrink thresholds
+plus a ``cooldown_s`` dead time after every transition (one scale
+event must be observed under the NEW capacity before the next fires —
+the classic anti-flap guard).
+
+Replay a :mod:`tools.loadgen` trace against a routed fleet with
+:func:`run_trace` — the loop the autoscaler unit tests (grow AND
+shrink, each schema-gated) drive.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from autodist_tpu import telemetry
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """The policy knobs.  Thresholds are PER-REPLICA backlog (queued +
+    active dispatches per admitting replica), so the policy is
+    independent of the current fleet size; ``grow_queue_depth`` must
+    clear ``shrink_queue_depth`` by enough that the post-grow backlog
+    (~grow × n/(n+1)) does not immediately read as shrinkable."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    grow_queue_depth: float = 4.0
+    shrink_queue_depth: float = 0.5
+    grow_ttft_p99_ms: float = float("inf")
+    ttft_window: int = 64          # completions the p99 is taken over
+    cooldown_s: float = 0.0
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if self.shrink_queue_depth >= self.grow_queue_depth:
+            raise ValueError(
+                "shrink_queue_depth must sit BELOW grow_queue_depth — "
+                "the gap is the hysteresis band that stops flapping")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Autoscaler:
+    """The scaling loop over a routed fleet.  Call :meth:`step` once
+    per scheduler round (after ``router.step()``); it observes, updates
+    the trigger gauges, and fires at most one transition per call."""
+
+    def __init__(self, router, *, config: Optional[AutoscaleConfig] = None,
+                 clock=time.perf_counter):
+        self.router = router
+        self.fleet = router.fleet
+        self.config = config or AutoscaleConfig()
+        self._clock = clock
+        self._ttfts: deque = deque(maxlen=self.config.ttft_window)
+        self._seen_completions: set = set()
+        self._last_scale_s: Optional[float] = None
+        self.events: list = []     # every transition, for callers/tests
+
+    # ---- observation ------------------------------------------------- #
+    def backlog_per_replica(self) -> float:
+        """Queued + active dispatches per admitting replica, counting
+        router-side pending requests (submitted but not dispatched —
+        exactly the work a new replica would absorb)."""
+        admitting = self.fleet.admitting
+        pending = sum(1 for r in self.router._open.values()
+                      if not r.dispatches)
+        load = sum(r.load for r in admitting) + pending
+        return load / max(len(admitting), 1)
+
+    def ttft_p99_ms(self) -> float:
+        """p99 TTFT over the recent completion window (0 until the
+        first completion lands — an empty fleet is not slow)."""
+        for rid, comp in self.router.completions.items():
+            if rid not in self._seen_completions:
+                self._seen_completions.add(rid)
+                self._ttfts.append(comp.ttft_s * 1e3)
+        if not self._ttfts:
+            return 0.0
+        return float(np.percentile(np.asarray(self._ttfts), 99))
+
+    # ---- the control step -------------------------------------------- #
+    def step(self, now: Optional[float] = None) -> Optional[dict]:
+        """One observe→decide→act round; returns the scale event fired
+        this call (also appended to :attr:`events`), or None."""
+        now = self._clock() if now is None else now
+        cfg = self.config
+        backlog = self.backlog_per_replica()
+        p99 = self.ttft_p99_ms()
+        telemetry.gauge("autoscale/queue_depth").set(backlog)
+        telemetry.gauge("autoscale/ttft_p99_ms").set(p99)
+        if self._last_scale_s is not None \
+                and now - self._last_scale_s < cfg.cooldown_s:
+            return None
+        n = len(self.fleet.admitting)
+        trigger = None
+        if n < cfg.max_replicas:
+            if backlog > cfg.grow_queue_depth:
+                trigger = ("queue_depth", backlog, cfg.grow_queue_depth)
+            elif p99 > cfg.grow_ttft_p99_ms:
+                trigger = ("ttft_p99", p99, cfg.grow_ttft_p99_ms)
+        if trigger is not None:
+            replica = self.fleet.grow()
+            return self._fire("grow", trigger, n, n + 1,
+                              replica.name, now)
+        if n > cfg.min_replicas and backlog < cfg.shrink_queue_depth \
+                and p99 <= cfg.grow_ttft_p99_ms:
+            victim = min(self.fleet.admitting,
+                         key=lambda r: (r.load, r.name))
+            self.router.drain_replica(victim.name)
+            return self._fire(
+                "shrink",
+                ("queue_depth", backlog, cfg.shrink_queue_depth),
+                n, n - 1, victim.name, now)
+        return None
+
+    def _fire(self, direction: str, trigger, before: int, after: int,
+              replica: str, now: float) -> dict:
+        kind, value, threshold = trigger
+        self._last_scale_s = now
+        event = dict(direction=direction, trigger=kind,
+                     value=float(value), threshold=float(threshold),
+                     replicas_before=before, replicas_after=after,
+                     replica=replica)
+        telemetry.counter(f"autoscale/{direction}").inc()
+        telemetry.record_event("scale", **event)
+        self.events.append(event)
+        return event
+
+
+def run_trace(router, autoscaler: Autoscaler, trace, *,
+              max_rounds: int = 100_000, speed: float = 1.0,
+              seed_base: int = 0) -> dict:
+    """Replay a :mod:`tools.loadgen` trace against the routed fleet
+    with the autoscaler in the loop: submit due arrivals, run one
+    router round, run one autoscaler round; loop until the trace is
+    spent and every request completed.  Returns the router completions
+    (the autoscaler's transitions are in ``autoscaler.events``).
+    ``trace`` is any iterable of arrival rows carrying ``t_s`` /
+    ``prompt`` / ``max_new_tokens`` — :mod:`tools.loadgen`'s
+    ``Arrival`` shape, consumed here without importing the tool (the
+    ``tools/`` scripts are not a package)."""
+    queue = sorted(trace, key=lambda a: a.t_s)
+    i = 0
+    t0 = time.perf_counter()
+    rounds = 0
+    while i < len(queue) or router._open:
+        if rounds >= max_rounds:
+            raise RuntimeError(
+                f"trace replay did not drain in {max_rounds} rounds "
+                f"({len(queue) - i} arrivals left, "
+                f"{len(router._open)} open)")
+        now = (time.perf_counter() - t0) * speed
+        while i < len(queue) and queue[i].t_s <= now:
+            router.submit(list(queue[i].prompt),
+                          max_new_tokens=queue[i].max_new_tokens,
+                          seed=seed_base + i)
+            i += 1
+        router.step()
+        autoscaler.step()
+        rounds += 1
+    return router.completions

@@ -422,6 +422,21 @@ class ServingFleet:
         return fresh
 
     # ------------------------------------------------------------------ #
+    def grow(self, name: Optional[str] = None) -> Replica:
+        """Spawn one ADDITIONAL admitting replica — the autoscaler's
+        scale-out edge.  Not a replacement: no charge against the
+        failure budget, no fault record (the scale event itself is the
+        autoscaler's ``kind="scale"`` record).  The router's next
+        ``_pick`` sees the newcomer through ``fleet.admitting``."""
+        if name is None:
+            i = len(self.replicas)
+            while f"replica-{i}" in self._by_name:
+                i += 1
+            name = f"replica-{i}"
+        elif name in self._by_name:
+            raise ValueError(f"replica {name!r} already exists")
+        return self._spawn(name)
+
     def drain(self, name: str, replace: bool = False):
         """Start draining a replica (rolling restart / re-election /
         preemption notice): it stops admitting, finishes its in-flight

@@ -2,6 +2,7 @@
 of the reference's AutoSync stub, ``autodist/simulator/``)."""
 from autodist_tpu.simulator.auto_strategy import (AutoStrategy,
                                                   default_candidates,
+                                                  default_disagg_candidates,
                                                   default_fleet_candidates,
                                                   default_serving_candidates,
                                                   rank_serving)
@@ -12,5 +13,6 @@ from autodist_tpu.simulator.search import (KnobConfig, SearchResult,
 
 __all__ = ["AutoStrategy", "CostModel", "StrategyCost", "DecodeCost",
            "default_candidates", "default_serving_candidates",
+           "default_disagg_candidates",
            "default_fleet_candidates", "rank_serving", "KnobConfig",
            "SearchResult", "SearchSpace", "search_strategies"]

@@ -168,9 +168,36 @@ def default_fleet_candidates(num_devices: int, num_slices: int = 1,
     return candidates
 
 
+def default_disagg_candidates(num_devices: int, num_slices: int = 1,
+                              kv_layouts=("paged",)) -> list[dict]:
+    """The pool-split zoo: every ``(prefill_replicas × decode_replicas
+    × tensor_parallel)`` split the topology admits — tp bounded by a
+    slice's ICI degree (decode's per-token all-reduces never cross DCN
+    — the ADT089 bound), total replicas bounded by ``num_devices //
+    tp``.  Handoff rides the block table, so only paged layouts
+    qualify."""
+    per_slice = max(num_devices // max(num_slices, 1), 1)
+    candidates = []
+    tp = 1
+    while tp <= per_slice:
+        total = num_devices // tp
+        for prefill in range(1, total):
+            for layout in kv_layouts:
+                candidates.append({
+                    "prefill_replicas": prefill,
+                    "decode_replicas": total - prefill,
+                    "tensor_parallel": tp,
+                    "vocab_parallel": tp > 1,
+                    "kv_layout": layout,
+                })
+        tp *= 2
+    return candidates
+
+
 def rank_serving(trainable, resource_spec, candidates=None, *,
                  batch_slots: int = 1, max_len: int = 2048,
-                 mean_request_len=None, objective: str = "latency",
+                 mean_request_len=None, mean_prompt_len=None,
+                 objective: str = "latency",
                  prefix_hit_rate: float = 0.0, spec_acceptance=None,
                  ladder: bool = False, **cost_model_kwargs):
     """Rank serving configs by the cost model's serving objective —
@@ -193,7 +220,14 @@ def rank_serving(trainable, resource_spec, candidates=None, *,
     over the ``(replicas × tp × kv_layout)`` shapes
     (:func:`default_fleet_candidates`) — aggregate throughput for the
     traffic mix, with replicas priced across DCN and tp held within a
-    slice's ICI.  Returns ``[(config, DecodeCost)]`` best-first
+    slice's ICI; ``"disagg"`` ranks by
+    :attr:`~autodist_tpu.simulator.cost_model.DecodeCost.disagg_score`
+    over the ``(prefill_replicas × decode_replicas × tp)`` pool splits
+    (:func:`default_disagg_candidates`) — the request pipeline's
+    bottleneck stage under the mix's ``mean_prompt_len`` /
+    ``mean_request_len``, so prefill-bound and decode-bound mixes
+    elect different splits (pinned both ways on the KV handoff term).
+    Returns ``[(config, DecodeCost)]`` best-first
     (feasible configs before infeasible) — the same shape as
     ``AutoStrategy.report``.
 
@@ -207,16 +241,20 @@ def rank_serving(trainable, resource_spec, candidates=None, *,
     candidates both directions under latency.  ``ladder=True`` widens
     the default zoo with the rung candidates
     (:func:`default_serving_candidates` ``ladder=``)."""
-    if objective not in ("latency", "capacity", "fleet"):
+    if objective not in ("latency", "capacity", "fleet", "disagg"):
         raise ValueError(
             f"unknown serving objective {objective!r}; expected "
-            "'latency', 'capacity', or 'fleet'")
+            "'latency', 'capacity', 'fleet', or 'disagg'")
     cm = CostModel(resource_spec, **cost_model_kwargs)
     if candidates is None:
+        num_slices = max(
+            int(getattr(resource_spec, "num_slices", 1) or 1), 1)
         if objective == "fleet":
             candidates = default_fleet_candidates(
-                resource_spec.num_devices(),
-                max(int(getattr(resource_spec, "num_slices", 1) or 1), 1))
+                resource_spec.num_devices(), num_slices)
+        elif objective == "disagg":
+            candidates = default_disagg_candidates(
+                resource_spec.num_devices(), num_slices)
         else:
             candidates = default_serving_candidates(
                 resource_spec.num_devices(), ladder=ladder)
@@ -226,6 +264,7 @@ def rank_serving(trainable, resource_spec, candidates=None, *,
             cost = cm.decode_cost(trainable, cand,
                                   batch_slots=batch_slots, max_len=max_len,
                                   mean_request_len=mean_request_len,
+                                  mean_prompt_len=mean_prompt_len,
                                   prefix_hit_rate=prefix_hit_rate,
                                   spec_acceptance=spec_acceptance)
         except (ValueError, SpecMeshMismatch) as e:
@@ -234,6 +273,7 @@ def rank_serving(trainable, resource_spec, candidates=None, *,
         scored.append((cand, cost))
     key = {"capacity": lambda it: it[1].serve_score,
            "fleet": lambda it: it[1].fleet_score,
+           "disagg": lambda it: it[1].disagg_score,
            "latency": lambda it: it[1].score}[objective]
     scored.sort(key=key)
     return scored

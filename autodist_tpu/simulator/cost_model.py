@@ -352,6 +352,18 @@ class DecodeCost:
     prefix_hit_rate: float = 0.0
     speculative: Optional[int] = None
     spec_acceptance: float = 0.0
+    # Disaggregated serving (PR 17): the prefill/decode pool split and
+    # its per-request stage times.  ``prefill_time_s`` is one request's
+    # prompt pass on one prefill replica; ``decode_time_s`` its decode
+    # tail on one decode replica; ``handoff_time_s`` the KV prefix
+    # transfer between them (ICI when the pools share a slice, DCN when
+    # the split spans slices) — the term that makes a split with too
+    # little decode capacity pay for every handoff it absorbs.
+    prefill_replicas: int = 0
+    decode_replicas: int = 0
+    prefill_time_s: float = 0.0
+    decode_time_s: float = 0.0
+    handoff_time_s: float = 0.0
 
     @property
     def score(self) -> float:
@@ -386,6 +398,25 @@ class DecodeCost:
             return math.inf
         return (self.token_time_s + self.dispatch_time_s) \
             / (max(self.replicas, 1) * self.request_capacity)
+
+    @property
+    def disagg_score(self) -> float:
+        """The disaggregation objective: a request pipeline's
+        bottleneck stage time — prefill work spread over the prefill
+        pool vs (handoff + decode) work spread over the decode pool.
+        Lower is better (~1/aggregate request throughput at the
+        bottleneck).  A prefill-bound mix (long prompts, short decode
+        tails) elects a split with more prefill replicas; a
+        decode-bound mix the reverse — and every handoff the decode
+        pool absorbs is charged to ITS stage, so starving decode never
+        looks free (both directions pinned)."""
+        if not self.feasible or self.prefill_replicas < 1 \
+                or self.decode_replicas < 1:
+            return math.inf
+        prefill = self.prefill_time_s / self.prefill_replicas
+        decode = (self.decode_time_s + self.handoff_time_s) \
+            / self.decode_replicas
+        return max(prefill, decode)
 
 
 class CostModel:
@@ -1324,6 +1355,7 @@ class CostModel:
                     *, batch_slots: int = 1, max_len: int = 2048,
                     kv_bytes_per_elem: float = _ACT_BYTES,
                     mean_request_len: Optional[float] = None,
+                    mean_prompt_len: Optional[float] = None,
                     kv_block_len: int = 16,
                     prefix_hit_rate: float = 0.0,
                     spec_acceptance: Optional[float] = None) -> DecodeCost:
@@ -1378,6 +1410,17 @@ class CostModel:
           ``spec_acceptance_default``), so the latency objective elects
           speculation exactly when α clears the draft+verify overhead
           — both directions pinned.
+        * **disaggregation (PR 17)** — ``prefill_replicas`` +
+          ``decode_replicas`` keys price a prefill/decode pool split:
+          a request's prompt pass runs on the prefill pool, its KV
+          prefix is handed to the decode pool (ICI within a slice, DCN
+          when the split spans slices — the handoff term), and its
+          decode tail runs there.  ``mean_prompt_len`` splits the
+          traffic's ``mean_request_len`` into prompt vs decoded tokens
+          (default: half) — :attr:`DecodeCost.disagg_score` then ranks
+          splits by the bottleneck stage, so prefill-bound and
+          decode-bound mixes elect different splits (both directions
+          pinned on the handoff term).
         """
         from autodist_tpu.strategy.ir import (normalize_kernel,
                                               normalize_kv_layout,
@@ -1400,8 +1443,23 @@ class CostModel:
         prefix_caching = normalize_prefix_caching(
             par.get("prefix_caching", False))
         spec_k = normalize_speculative(par.get("speculative"))
+        prefill_r = int(par.get("prefill_replicas", 0) or 0)
+        decode_r = int(par.get("decode_replicas", 0) or 0)
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if bool(prefill_r) != bool(decode_r):
+            raise ValueError(
+                "a disaggregated split names BOTH pools: got "
+                f"prefill_replicas={prefill_r}, "
+                f"decode_replicas={decode_r}")
+        if prefill_r and replicas > 1:
+            raise ValueError(
+                "replicas and a prefill/decode pool split are exclusive "
+                "shapes — the pool split IS the fleet shape")
+        if prefill_r and kv_layout != "paged":
+            raise ValueError(
+                "the prefill->decode KV handoff moves block-table "
+                "prefixes — a pool split requires kv_layout='paged'")
         if (prefill_chunk is not None or prefix_caching) \
                 and kv_layout != "paged":
             raise ValueError(
@@ -1430,6 +1488,12 @@ class CostModel:
                 f"replicas={replicas} x tensor_parallel={tp} needs "
                 f"{replicas * tp} devices; the topology has "
                 f"{num_devices}")
+        if prefill_r and (prefill_r + decode_r) * tp > num_devices:
+            raise ValueError(
+                f"pool split prefill={prefill_r} + decode={decode_r} "
+                f"at tensor_parallel={tp} needs "
+                f"{(prefill_r + decode_r) * tp} devices; the topology "
+                f"has {num_devices} (the ADT089 bound)")
         flash = "flash_decode" in kern
         from autodist_tpu.strategy.parallel_builders import (
             PIPELINE_TP_RULES, PIPELINE_VOCAB_RULES)
@@ -1600,6 +1664,29 @@ class CostModel:
             dispatch = remote_frac * (dcn_alpha
                                       + prompt_bytes / bw_dcn) \
                 / max(mean_len, 1.0)
+        # Disaggregation: split the mix's mean request into its prompt
+        # (prefill-pool work) and decoded tail (decode-pool work), and
+        # price the per-request KV prefix handoff between the pools —
+        # ICI when the whole split fits one slice, DCN when it spans
+        # slices.  The handoff lands on the DECODE stage (its pool
+        # absorbs the ingest), so a split that starves decode pays for
+        # every handoff it forces — the term disagg_score pins on.
+        prefill_t = decode_t = handoff = 0.0
+        if prefill_r >= 1 and decode_r >= 1:
+            prompt_len = float(mean_len / 2.0 if mean_prompt_len is None
+                               else min(mean_prompt_len, mean_len))
+            if prompt_len < 0:
+                raise ValueError(
+                    f"mean_prompt_len must be >= 0, got {prompt_len}")
+            decode_tokens = max(mean_len - prompt_len, 1.0)
+            prefill_t = 2.0 * elems * prompt_len / flops_rate
+            decode_t = (compute + comm) * decode_tokens
+            hand_bytes = lane_bytes * prompt_len
+            if (prefill_r + decode_r) * tp > per_slice:
+                bw_dcn, dcn_alpha = self._dcn_link()
+                handoff = dcn_alpha + hand_bytes / bw_dcn
+            else:
+                handoff = hop_alpha + hand_bytes / bw_link
         return DecodeCost(token_time_s=compute + comm, comm_time_s=comm,
                           compute_time_s=compute, kv_bytes_per_device=kv,
                           mem_bytes_per_device=mem, feasible=mem <= hbm,
@@ -1613,7 +1700,12 @@ class CostModel:
                           prefix_hit_rate=(float(prefix_hit_rate)
                                            if prefix_caching else 0.0),
                           speculative=spec_k,
-                          spec_acceptance=spec_alpha)
+                          spec_acceptance=spec_alpha,
+                          prefill_replicas=prefill_r,
+                          decode_replicas=decode_r,
+                          prefill_time_s=prefill_t,
+                          decode_time_s=decode_t,
+                          handoff_time_s=handoff)
 
     def strategy_cost(self, trainable: Trainable,
                       strategy: Strategy) -> StrategyCost:

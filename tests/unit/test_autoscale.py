@@ -1,0 +1,124 @@
+"""Trace-driven autoscaling goldens (ISSUE 17).
+
+The bar: a bursty :mod:`tools.loadgen` trace replayed against a routed
+in-process fleet makes the autoscaler grow under the burst backlog AND
+shrink once it drains — with every transition a schema-gated
+``kind="scale"`` record moving the replica count by exactly one, the
+trigger gauges present in the same run, every request completed, and
+the trace generators deterministic under a seed and loud about
+nonsense shapes.
+"""
+import json
+import os
+import sys
+
+import pytest
+
+from autodist_tpu import telemetry
+from autodist_tpu.serving import (AutoscaleConfig, Autoscaler,
+                                  FleetConfig, Router, ServingFleet,
+                                  tiny_engine_factory)
+from autodist_tpu.serving.autoscale import run_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import loadgen  # noqa: E402  (tools/ is scripts, not a package)
+
+
+# --------------------------------------------------------------------- #
+# the generators: deterministic, and loud about nonsense shapes
+# --------------------------------------------------------------------- #
+def test_traces_are_deterministic_under_a_seed():
+    kw = dict(duration_s=5.0, idle_rps=1.0, burst_rps=20.0,
+              burst_s=1.0, gap_s=1.0)
+    a = loadgen.bursty_trace(seed=3, **kw)
+    b = loadgen.bursty_trace(seed=3, **kw)
+    assert [(x.t_s, x.prompt, x.max_new_tokens) for x in a] \
+        == [(x.t_s, x.prompt, x.max_new_tokens) for x in b]
+    c = loadgen.bursty_trace(seed=4, **kw)
+    assert [x.t_s for x in a] != [x.t_s for x in c]
+    assert all(0.0 <= x.t_s <= 5.0 for x in a)
+    assert all(x.prompt and x.max_new_tokens >= 1 for x in a)
+
+
+def test_trace_shape_validation():
+    with pytest.raises(ValueError, match="burst_rps"):
+        loadgen.bursty_trace(duration_s=1.0, idle_rps=5.0,
+                             burst_rps=1.0, burst_s=0.5, gap_s=0.5)
+    with pytest.raises(ValueError, match="peak_rps"):
+        loadgen.diurnal_trace(duration_s=1.0, base_rps=5.0,
+                              peak_rps=1.0)
+    with pytest.raises(ValueError, match="alpha"):
+        loadgen.heavy_tail_trace(duration_s=1.0, rps=5.0, alpha=1.0)
+
+
+def test_autoscale_config_validation():
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutoscaleConfig(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        AutoscaleConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="hysteresis"):
+        AutoscaleConfig(grow_queue_depth=2.0, shrink_queue_depth=2.0)
+
+
+# --------------------------------------------------------------------- #
+# the loop: grow under the burst, shrink after the drain — schema-gated
+# --------------------------------------------------------------------- #
+@pytest.mark.slow
+def test_burst_grows_then_drain_shrinks_schema_gated(tmp_path):
+    import telemetry_report as tr
+
+    telemetry.configure(out_dir=str(tmp_path))
+    trace = loadgen.bursty_trace(duration_s=3.0, idle_rps=1.0,
+                                 burst_rps=40.0, burst_s=1.0,
+                                 gap_s=0.8, seed=7)
+    fleet = ServingFleet(
+        tiny_engine_factory,
+        config=FleetConfig(replicas=1, heartbeat_interval_s=0.05,
+                           heartbeat_timeout_s=5.0,
+                           heartbeat_startup_grace_s=30.0))
+    router = Router(fleet)
+    asc = Autoscaler(router, config=AutoscaleConfig(
+        min_replicas=1, max_replicas=3, grow_queue_depth=3.0,
+        shrink_queue_depth=0.5, cooldown_s=0.05))
+    done = run_trace(router, asc, trace, speed=50.0)
+    assert len(done) == len(trace)   # nothing dropped while scaling
+    directions = [e["direction"] for e in asc.events]
+    assert "grow" in directions, directions
+    assert "shrink" in directions, directions
+    # every transition moved the count by exactly one, within bounds
+    for e in asc.events:
+        assert abs(e["replicas_after"] - e["replicas_before"]) == 1
+        assert 1 <= e["replicas_after"] <= 3
+        assert e["trigger"] == "queue_depth"
+    # the shrink never undercut the floor
+    assert len(fleet.admitting) >= 1
+    telemetry.flush()
+    assert tr.check_schema(str(tmp_path)) == []
+    with open(tmp_path / "metrics.jsonl") as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    scales = [r for r in recs if r.get("kind") == "scale"]
+    assert [s["direction"] for s in scales] == directions
+    gauges = {r["name"] for r in recs if r.get("kind") == "gauge"}
+    assert "autoscale/queue_depth" in gauges
+    rendered = tr.render(str(tmp_path))
+    assert "## autoscaling" in rendered
+
+
+@pytest.mark.slow
+def test_cooldown_spaces_transitions():
+    fleet = ServingFleet(tiny_engine_factory,
+                         config=FleetConfig(replicas=1))
+    router = Router(fleet)
+    asc = Autoscaler(router, config=AutoscaleConfig(
+        min_replicas=1, max_replicas=4, grow_queue_depth=0.5,
+        shrink_queue_depth=0.1, cooldown_s=100.0),
+        clock=lambda: 0.0)
+    for _ in range(8):
+        router.submit([1, 2], max_new_tokens=2)
+    assert asc.step(now=0.0) is not None    # the backlog fires once
+    assert asc.step(now=1.0) is None        # inside the cooldown
+    assert asc.step(now=200.0) is not None  # past it
+    router.run()

@@ -218,3 +218,42 @@ def test_serving_chaos_matrix_every_replica_fault_recovers(tmp_path):
     golden = results["none"]["tokens"]
     for kind in SERVING_FAULT_KINDS:
         assert results[kind]["tokens"] == golden, kind
+
+
+@pytest.mark.slow
+def test_serving_chaos_matrix_against_real_replica_processes(tmp_path):
+    """tools/chaos_run.py --matrix --plane serving --processes: the
+    replica fault kinds against a ProcessFleet of REAL replica
+    processes, the fault plan shipped for worker self-injection (a
+    crash is a dead process, a hang a SIGSTOP) — every request must
+    still complete exactly once, token-for-token equal to the
+    in-process fault-free golden, with zero leaked KV blocks."""
+    from autodist_tpu.runtime.faults import SERVING_FAULT_KINDS
+
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    for k in ("AUTODIST_TPU_WORKER", "AUTODIST_TPU_FAULT_PLAN",
+              "XLA_FLAGS", "AUTODIST_TPU_COORD_SERVICE"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_run.py"),
+         "--matrix", "--plane", "serving", "--processes",
+         "--telemetry-dir", str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=3000)
+    assert proc.returncode == 0, (
+        f"cross-process serving chaos matrix failed\n"
+        f"stdout:\n{proc.stdout[-4000:]}\nstderr:\n{proc.stderr[-4000:]}")
+    with open(tmp_path / "matrix.json") as f:
+        results = json.load(f)
+    assert set(results) == {"none", *SERVING_FAULT_KINDS}
+    assert all(r["ok"] for r in results.values()), results
+    golden = results["none"]["tokens"]
+    for kind in SERVING_FAULT_KINDS:
+        assert results[kind]["tokens"] == golden, kind
+    # the self-injected faults really happened in the worker processes:
+    # each fault scenario's telemetry carries the worker-side injection
+    # record merged from its replica-*-i0 directory
+    for kind in SERVING_FAULT_KINDS:
+        with open(tmp_path / kind / "metrics.jsonl") as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+        assert any(r.get("kind") == "fault" and r.get("fault") == kind
+                   and r.get("phase") == "injected" for r in recs), kind

@@ -346,3 +346,45 @@ def test_retry_opt_out_keeps_raw_oserror():
         c.put("k", b"v")
     assert not isinstance(ei.value, CoordUnavailableError)
     c.close()
+
+
+def test_concurrent_spawns_reserve_distinct_ports():
+    """The held-socket port election: ``reserve_coord_port`` keeps the
+    elected ephemeral port BOUND until the server adopts the fd, so N
+    concurrent spawns can never elect the same port — the old
+    bind-then-release probe raced exactly in the gap between election
+    and serve, and two clusters starting at once could collide."""
+    from autodist_tpu.runtime.coordination import reserve_coord_port
+
+    n = 12
+    socks = [reserve_coord_port() for _ in range(n)]   # all held at once
+    ports = [s.getsockname()[1] for s in socks]
+    assert len(set(ports)) == n, f"duplicate reserved ports: {ports}"
+    servers: list = [None] * n
+    errors: list = [None] * n
+
+    def adopt(i):
+        try:
+            servers[i] = CoordServer(listen_sock=socks[i])
+        except Exception as e:    # noqa: BLE001 — surfaced below
+            errors[i] = e
+
+    threads = [threading.Thread(target=adopt, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    try:
+        assert errors == [None] * n, errors
+        # each server serves on exactly the port its reservation held,
+        # and actually answers on it
+        for i, s in enumerate(servers):
+            assert s.port == ports[i]
+            with CoordClient("127.0.0.1", s.port, token=s.token) as c:
+                c.put("spawn/port", str(ports[i]).encode())
+                assert c.get("spawn/port") == str(ports[i]).encode()
+    finally:
+        for s in servers:
+            if s is not None:
+                s.stop()

@@ -1,0 +1,197 @@
+"""Disaggregated prefill/decode serving goldens (ISSUE 17).
+
+The bar: a request decodes the exact same token stream whether it runs
+through a colocated ``ContinuousBatcher`` or crosses the
+prefill→decode pool boundary through the compiled KV handoff — with
+zero leaked blocks in EITHER pool, the handoff program ADT110-clean
+(no gather above the pool-shard budget, no host transfer), every
+transfer a schema-gated ``kind="handoff"`` record naming its paired
+replicas, and the pool-split election pinned in both traffic
+directions (prefill-heavy elects prefill replicas, decode-heavy
+decode).
+"""
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from autodist_tpu import telemetry
+from autodist_tpu.analysis import lint_disagg, lint_handoff
+from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+from autodist_tpu.models.transformer import TransformerConfig
+from autodist_tpu.resource import ResourceSpec
+from autodist_tpu.serving import ContinuousBatcher, OverloadedError
+from autodist_tpu.serving.disagg import (DisaggConfig, DisaggServer,
+                                         elect_pool_split)
+from autodist_tpu.serving.remote import tiny_engine_factory
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+# Short ragged prompts: block-tail adoption (a partial last block
+# crosses the handoff), slot reuse (6 requests through 2-slot pools),
+# and both decode engines participating.
+MIX = [([1, 2, 3], 8), ([4, 5], 8), ([6], 8), ([7, 8, 9], 8),
+       ([3, 1], 8), ([2, 9, 4], 8)]
+
+
+def run_colocated(reqs):
+    """The golden: the same engine recipe, prefill+decode colocated."""
+    b = ContinuousBatcher(tiny_engine_factory())
+    rids = [b.submit(p, max_new_tokens=m, rid=f"r{i}", seed=i)
+            for i, (p, m) in enumerate(reqs)]
+    done = b.run()
+    return {rid: done[rid].tokens for rid in rids}
+
+
+def test_disagg_parity_zero_leak_and_handoff_records(tmp_path):
+    import telemetry_report as tr
+
+    golden = run_colocated(MIX)
+    telemetry.configure(out_dir=str(tmp_path))
+    srv = DisaggServer(tiny_engine_factory, prefill_replicas=1,
+                       decode_replicas=2)
+    for i, (p, m) in enumerate(MIX):
+        srv.submit(p, max_new_tokens=m, rid=f"r{i}", seed=i)
+    done = srv.run()
+    # token-for-token: the pool boundary is invisible to the client
+    for rid, want in golden.items():
+        assert done[rid].tokens == want, rid
+    # the handoff program compiled clean under ADT110/ADT104
+    assert srv.last_handoff_report is not None
+    assert srv.last_handoff_report.ok, \
+        srv.last_handoff_report.render("handoff lint")
+    # zero residency in EVERY pool once drained
+    for name, (free, used, total) in srv.block_accounting().items():
+        assert used == 0 and free == total, (name, free, used, total)
+    # both decode engines actually served (the least-loaded pick)
+    assert {done[r].decode_replica for r in golden} \
+        == {"decode-0", "decode-1"}
+    telemetry.flush()
+    # one schema-gated handoff record per request, replicas paired
+    assert tr.check_schema(str(tmp_path)) == []
+    with open(tmp_path / "metrics.jsonl") as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    handoffs = [r for r in recs if r.get("kind") == "handoff"]
+    assert len(handoffs) == len(MIX)
+    for r in handoffs:
+        assert r["prefill_replica"] == "prefill-0"
+        assert r["decode_replica"] in ("decode-0", "decode-1")
+        assert r["route"] == "ici"
+        assert 0 < r["per_device_gather_elems"] <= r["budget_elems"]
+    rendered = tr.render(str(tmp_path))
+    assert "## disaggregated serving" in rendered
+    assert "prefill-0 → decode-0" in rendered
+
+
+def test_submit_mirrors_batcher_validation():
+    srv = DisaggServer(tiny_engine_factory, prefill_replicas=1,
+                       decode_replicas=1, max_queue=1)
+    with pytest.raises(ValueError, match="empty"):
+        srv.submit([], max_new_tokens=4)
+    with pytest.raises(ValueError, match="exceeds"):
+        srv.submit(list(range(1, 30)), max_new_tokens=4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        srv.submit([1], max_new_tokens=0)
+    srv.submit([1, 2], max_new_tokens=4)
+    with pytest.raises(OverloadedError):
+        srv.submit([3, 4], max_new_tokens=4)
+    srv.run()
+
+
+def test_pool_shape_comes_from_exactly_one_source():
+    cfg = DisaggConfig(prefill_replicas=1, decode_replicas=1)
+    with pytest.raises(ValueError, match="config"):
+        DisaggServer(tiny_engine_factory, prefill_replicas=1,
+                     decode_replicas=1, config=cfg)
+    # an explicit empty pool is rejected, not silently defaulted to 1
+    with pytest.raises(ValueError, match="replica"):
+        DisaggServer(tiny_engine_factory, prefill_replicas=0,
+                     decode_replicas=1)
+    # no shape at all falls back to the smallest disaggregated fleet
+    srv = DisaggServer(tiny_engine_factory)
+    assert srv.config.prefill_replicas == 1
+    assert srv.config.decode_replicas == 1
+    srv = DisaggServer(tiny_engine_factory, config=cfg)
+    assert srv.describe()["prefill_replicas"] == 1
+
+
+# --------------------------------------------------------------------- #
+# the election: pinned in both traffic directions
+# --------------------------------------------------------------------- #
+def _trainable(max_len=512):
+    cfg = TransformerConfig(vocab_size=33, hidden_size=16, num_layers=2,
+                            num_heads=2, mlp_dim=32, max_len=max_len,
+                            dtype=jnp.float32, dropout_rate=0.0,
+                            attention_dropout_rate=0.0)
+    return make_pipeline_lm_trainable(cfg, optax.sgd(0.1),
+                                      jax.random.PRNGKey(0))
+
+
+def test_election_pinned_both_traffic_directions():
+    """rank_serving(objective="disagg"): a prompt-dominated mix elects
+    a prefill-leaning split, a decode-dominated mix a decode-leaning
+    one — the bottleneck-stage objective moves replicas toward the
+    stage the traffic loads."""
+    tr = _trainable()
+    spec = ResourceSpec({"topology": {"num_devices": 8,
+                                      "num_slices": 1}})
+    heavy_prompt, _ = elect_pool_split(
+        tr, spec, batch_slots=2, max_len=512,
+        mean_request_len=500, mean_prompt_len=480)
+    heavy_decode, _ = elect_pool_split(
+        tr, spec, batch_slots=2, max_len=512,
+        mean_request_len=500, mean_prompt_len=20)
+    assert heavy_prompt.prefill_replicas > heavy_decode.prefill_replicas
+    assert heavy_decode.decode_replicas > heavy_prompt.decode_replicas
+    # the elected split always fits the device budget it was given
+    for cand in (heavy_prompt, heavy_decode):
+        assert (cand.prefill_replicas + cand.decode_replicas) \
+            * cand.tensor_parallel <= 8
+        assert lint_disagg(cand, spec).ok
+
+
+def test_infeasible_split_is_rejected_not_built():
+    spec = ResourceSpec({"topology": {"num_devices": 2,
+                                      "num_slices": 1}})
+    report = lint_disagg(DisaggConfig(prefill_replicas=2,
+                                      decode_replicas=2), spec)
+    assert not report.ok
+    assert any(d.code == "ADT089" for d in report.errors)
+    with pytest.raises(ValueError, match="ADT089"):
+        DisaggServer(tiny_engine_factory,
+                     config=DisaggConfig(prefill_replicas=2,
+                                         decode_replicas=2),
+                     resource_spec=spec)
+
+
+def test_cross_slice_tp_split_is_rejected():
+    spec = ResourceSpec({"topology": {"num_devices": 8,
+                                      "num_slices": 4}})
+    report = lint_disagg(DisaggConfig(prefill_replicas=1,
+                                      decode_replicas=1,
+                                      tensor_parallel=4), spec)
+    assert not report.ok
+    assert any("ICI" in d.message or "slice" in d.message
+               for d in report.errors)
+
+
+def test_handoff_plan_budget_gate():
+    """lint_handoff: a plan whose per-device gather exceeds the pool
+    shard budget is an ADT072 error (the full-pool staging the
+    compiled route exists to prevent); a prefix-block plan is clean."""
+    plan = {"per_device_gather_elems": 160, "budget_elems": 1600,
+            "blocks": 1, "prefill_replica": "prefill-0",
+            "decode_replica": "decode-0"}
+    assert lint_handoff(plan).ok
+    bloated = dict(plan, per_device_gather_elems=3200)
+    report = lint_handoff(bloated)
+    assert not report.ok
+    assert any(d.code == "ADT072" for d in report.errors)
+    # an explicit budget overrides the plan's own
+    assert not lint_handoff(plan, budget_elems=100).ok

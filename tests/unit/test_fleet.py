@@ -167,6 +167,40 @@ def test_hedged_request_loser_cancelled(cfg, params):
     assert_zero_residency(fleet)
 
 
+@pytest.mark.slow
+def test_drain_races_in_flight_hedge_both_orderings(cfg, params):
+    """Draining while a hedge is mid-flight, in both orders — drain the
+    straggler that still holds the losing dispatch, and drain the
+    healthy replica that holds the winning one.  Either way the client
+    stream equals run-alone and every replica's pool settles back to
+    ``free + used == total`` with zero residency: a drain sweep must
+    not strand the hedge sibling's dispatch or its KV blocks."""
+    factory = make_factory(cfg, params, kv_layout="paged")
+    golden = run_alone(factory, [(PROMPTS[0], 0)])
+    for victim in ("replica-0", "replica-1"):
+        fleet = ServingFleet(factory, replicas=2,
+                             config=FleetConfig(hedge_timeout_s=0.02))
+        router = Router(fleet)
+        fleet.inject("replica-0", "slow", duration_s=0.5)
+        rid = router.submit(PROMPTS[0], max_new_tokens=MAX_NEW)
+        deadline = time.monotonic() + 10.0
+        while rid in router._open \
+                and len(router._open[rid].dispatches) < 2:
+            assert time.monotonic() < deadline, \
+                "hedge never fired against the straggler"
+            router.step()
+        assert rid in router._open, \
+            "request completed before the drain could race the hedge"
+        router.drain_replica(victim)
+        done = router.run()
+        comp = done[rid]
+        assert comp.tokens == golden[0], (victim, comp.tokens)
+        assert comp.hedged
+        assert_zero_residency(fleet)
+        for name, (free, used, total) in fleet.block_accounting().items():
+            assert free + used == total, (victim, name, free, used, total)
+
+
 def test_hang_detected_by_heartbeat_and_failed_over(cfg, params):
     """A hung replica (no beats, no progress) is declared dead by the
     reused HeartbeatMonitor freshness check and its requests fail

@@ -27,6 +27,14 @@ the post-recovery loss trajectory matching the fault-free golden::
     # single-replica fault-free golden, with zero leaked KV blocks
     JAX_PLATFORMS=cpu python tools/chaos_run.py --matrix --plane serving
 
+    # the same serving faults against REAL replica processes
+    # (ProcessFleet over the coordination service): the plan ships to
+    # the workers and replica-0 self-injects its own death — a crash
+    # is a dead process, a hang a SIGSTOP — while the golden stays
+    # in-process as the token-parity anchor
+    JAX_PLATFORMS=cpu python tools/chaos_run.py --matrix \
+        --plane serving --processes
+
 Per-kind expected outcome:
 
 =================  =====================================================
@@ -275,7 +283,8 @@ def _merge_worker_metrics(tel_dir: str):
     lines = []
     for entry in sorted(os.listdir(tel_dir)):
         sub = os.path.join(tel_dir, entry, "metrics.jsonl")
-        if not (entry.startswith("worker-") and os.path.exists(sub)):
+        if not (entry.startswith(("worker-", "replica-"))
+                and os.path.exists(sub)):
             continue
         with open(sub) as f:
             for line in f:
@@ -358,31 +367,37 @@ SERVE_MIX = ([1, 2, 3], 8), ([4, 5], 8), ([6], 8), ([7, 8, 9], 8), \
     ([3, 1], 8), ([2, 9, 4], 8)
 
 
-def _build_fleet(kind: str):
+def _build_fleet(kind: str, *, processes: bool = False, tel_dir=None,
+                 fault_plan=None):
     """The scenario fleet: 1 fault-free replica for the golden, 2 for
     every fault — hedging armed only for the straggler scenario so the
-    crash/hang recoveries are unambiguously the failover path's."""
-    import jax
-    import jax.numpy as jnp
-    import optax
+    crash/hang recoveries are unambiguously the failover path's.
 
-    from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
-    from autodist_tpu.models.transformer import TransformerConfig
-    from autodist_tpu.serving import (FleetConfig, ServingEngine,
-                                      ServingFleet)
+    Both planes serve through :func:`tiny_engine_factory` (the
+    deterministic PRNGKey(0) engine), so the in-process golden IS the
+    parity anchor for the cross-process scenarios: any process that
+    builds the engine from the same kwargs emits the same tokens.
 
-    cfg = TransformerConfig(vocab_size=33, hidden_size=16, num_layers=2,
-                            num_heads=2, mlp_dim=32, max_len=24,
-                            dtype=jnp.float32, dropout_rate=0.0,
-                            attention_dropout_rate=0.0)
-    params = make_pipeline_lm_trainable(
-        cfg, optax.sgd(0.1), jax.random.PRNGKey(0)).params
+    ``processes=True`` swaps in a :class:`ProcessFleet` — real replica
+    processes over the coordination service, the fault plan shipped for
+    worker self-injection — with the heartbeat window widened to
+    cross-process scale (a replacement spawn takes seconds of worker
+    boot, not microseconds of object construction)."""
+    from autodist_tpu.serving import FleetConfig, ServingFleet
+    from autodist_tpu.serving.remote import ProcessFleet, tiny_engine_factory
 
-    def factory():
-        return ServingEngine(cfg, params, num_slots=2, max_len=24,
-                             prefill_len=16, decode_steps=2,
-                             kv_layout="paged", kv_block_len=5)
-
+    if processes and kind != "none":
+        fleet_config = FleetConfig(
+            replicas=2,
+            hedge_timeout_s=0.5 if kind == "replica_slow" else None,
+            hedge_percentile=None,
+            max_replacements=1,
+            heartbeat_interval_s=0.1, heartbeat_timeout_s=2.0,
+            heartbeat_startup_grace_s=30.0)
+        return ProcessFleet(
+            {"factory": "autodist_tpu.serving.remote:tiny_engine_factory"},
+            config=fleet_config, telemetry_dir=tel_dir,
+            fault_plan=fault_plan)
     fleet_config = FleetConfig(
         replicas=1 if kind == "none" else 2,
         hedge_timeout_s=0.2 if kind == "replica_slow" else None,
@@ -390,46 +405,103 @@ def _build_fleet(kind: str):
         max_replacements=1,
         heartbeat_interval_s=0.05, heartbeat_timeout_s=0.5,
         heartbeat_startup_grace_s=0.5)
-    return ServingFleet(factory, config=fleet_config)
+    return ServingFleet(tiny_engine_factory, config=fleet_config)
 
 
-def run_serving_scenario(kind: str, tel_dir: str, out_path: str) -> int:
+def _await_worker_fault_records(kind: str, tel_dir: str,
+                                timeout_s: float = 15.0) -> None:
+    """Block until the self-injecting worker's fault records hit its
+    telemetry file: the straggler flushes its injected+resumed pair
+    only after its stall ends, which may be after the chief's requests
+    all hedged away and completed — merging before that flush would
+    fail the injected↔outcome pairing for a recovery that DID run."""
+    want = {"injected"} if kind in ("replica_crash", "replica_hang") \
+        else {"injected", "recovered"}
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        seen = set()
+        for entry in sorted(os.listdir(tel_dir)):
+            sub = os.path.join(tel_dir, entry, "metrics.jsonl")
+            if not (entry.startswith("replica-") and os.path.exists(sub)):
+                continue
+            with open(sub) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("kind") == "fault" \
+                            and rec.get("fault") == kind:
+                        seen.add(rec.get("phase"))
+        if want <= seen:
+            return
+        time.sleep(0.2)
+
+
+def run_serving_scenario(kind: str, tel_dir: str, out_path: str,
+                         processes: bool = False) -> int:
     """One serving scenario: the fixed mix through a fleet under one
     injected replica fault; every request must complete exactly once
     with zero leaked KV blocks and a schema-clean dispatch/fault
-    trail.  Token parity vs the golden is the matrix driver's join."""
+    trail.  Token parity vs the golden is the matrix driver's join.
+
+    ``processes=True`` runs the fault against REAL replica processes
+    (:class:`ProcessFleet`): the plan ships to the workers and
+    replica-0 self-injects its own death/stall ``at_s`` seconds after
+    its first submitted request — the chief holds no injector at all,
+    so the failure truly arrives from outside the scheduler loop.  The
+    golden stays in-process: parity is by construction of the shared
+    ``tiny_engine_factory``, and a fault-free remote run would only
+    re-prove the RPC mirror, which the remote-serving unit tests own."""
     from autodist_tpu import telemetry
     from autodist_tpu.runtime.faults import (FaultInjector, FaultPlan,
                                              FaultSpec)
     from autodist_tpu.serving import Router
 
     telemetry.configure(out_dir=tel_dir)
-    fleet = _build_fleet(kind)
-    router = Router(fleet)
+    processes = processes and kind != "none"
     spec = None
     if kind != "none":
-        spec = FaultSpec(kind, target="replica-0", at_step=2,
-                         duration_s=1.0)
+        spec = FaultSpec(kind, target="replica-0", at_s=0.5,
+                         duration_s=1.5) if processes else \
+            FaultSpec(kind, target="replica-0", at_step=2,
+                      duration_s=1.0)
     plan = FaultPlan(faults=[spec] if spec else [], seed=1234)
-    injector = FaultInjector(plan, self_target="chief", fleet=fleet)
+    fleet = _build_fleet(kind, processes=processes, tel_dir=tel_dir,
+                         fault_plan=plan)
+    router = Router(fleet)
+    # In-process: the chief owns the injection (it holds the fleet).
+    # Cross-process: the WORKER owns it (self-injection from the
+    # shipped plan) — a chief-side injector here would double-fire.
+    injector = None if processes \
+        else FaultInjector(plan, self_target="chief", fleet=fleet)
     rids = [router.submit(p, max_new_tokens=m) for p, m in SERVE_MIX[:4]]
     rnd = 0
     while router._open or rnd < 4:
-        injector.maybe_fire(rnd)
+        if injector is not None:
+            injector.maybe_fire(rnd)
         if rnd == 3:   # late arrivals keep the queue live mid-fault
             rids += [router.submit(p, max_new_tokens=m)
                      for p, m in SERVE_MIX[4:]]
         router.step()
+        if processes:
+            time.sleep(0.01)   # remote rounds poll RPC; don't spin hot
         rnd += 1
     # A short mix can finish inside a transient fault's window (every
     # request hedged off the straggler): keep the scheduler alive until
     # the fault resolves — the injector.drain_pending analog; ending
     # early would green-light a resume record that never fired.
-    while any(r._fault is not None for r in fleet.live):
-        router.step()
-        time.sleep(0.02)
+    if not processes:
+        while any(r._fault is not None for r in fleet.live):
+            router.step()
+            time.sleep(0.02)
     telemetry.flush()
+    if processes:
+        _await_worker_fault_records(kind, tel_dir)
+        _merge_worker_metrics(tel_dir)
     problems = _check_serving_outcome(kind, tel_dir, fleet, router, rids)
+    if processes:
+        fleet.close()
     record = {"kind": "chaos_scenario", "plane": "serving", "fault": kind,
               "tokens": {rid: router.completions[rid].tokens
                          for rid in rids if rid in router.completions},
@@ -510,9 +582,14 @@ def _check_serving_outcome(kind, tel_dir, fleet, router, rids) -> list:
 
 
 def run_serving_matrix(scenario_timeout: float,
-                       max_scenarios: int | None, out_dir: str) -> int:
+                       max_scenarios: int | None, out_dir: str,
+                       processes: bool = False) -> int:
     """Golden + every serving fault kind, each subprocessed and
-    watchdogged; token-for-token parity joined against the golden."""
+    watchdogged; token-for-token parity joined against the golden.
+    With ``processes=True`` the fault scenarios run against real
+    replica processes (the golden stays in-process — the parity
+    anchor), so the join proves the RPC plane re-homes mid-stream work
+    token-for-token across an actual process death."""
     results = {}
     golden_tokens = None
     todo = list(SERVING_SCENARIOS)
@@ -526,6 +603,8 @@ def run_serving_matrix(scenario_timeout: float,
         argv = [sys.executable, os.path.abspath(__file__),
                 "--plane", "serving", "--run-one", kind,
                 "--telemetry-dir", tel_dir, "--out", out_json]
+        if processes:
+            argv.append("--processes")
         t0 = time.monotonic()
         try:
             proc = subprocess.run(argv, timeout=scenario_timeout,
@@ -656,6 +735,11 @@ def main(argv=None) -> int:
     ap.add_argument("--matrix", action="store_true",
                     help="golden + every fault kind, each subprocessed "
                          "and watchdogged")
+    ap.add_argument("--processes", action="store_true",
+                    help="serving plane only: run the fault scenarios "
+                         "against REAL replica processes (ProcessFleet "
+                         "+ worker self-injection); the golden stays "
+                         "in-process as the parity anchor")
     ap.add_argument("--steps", type=int, default=14)
     ap.add_argument("--scenario-timeout", type=float, default=600.0)
     ap.add_argument("--max-scenarios", type=int, default=None,
@@ -671,18 +755,28 @@ def main(argv=None) -> int:
         if kind not in valid:
             ap.error(f"fault {kind!r} is not a --plane {plane} "
                      f"scenario (choose from {list(valid)})")
+        if args.processes and plane != "serving":
+            ap.error("--processes is a serving-plane switch (the "
+                     "training plane's LocalCluster is already "
+                     "process-backed)")
         tel_dir = args.telemetry_dir or tempfile.mkdtemp(
             prefix=f"chaos_{kind}_")
         out = args.out or os.path.join(tel_dir, "result.json")
         if plane == "serving":
-            return run_serving_scenario(kind, tel_dir, out)
+            return run_serving_scenario(kind, tel_dir, out,
+                                        processes=args.processes)
         return run_scenario(kind, args.steps, tel_dir, out)
     if args.matrix:
+        if args.processes and args.plane != "serving":
+            ap.error("--processes is a serving-plane switch (the "
+                     "training plane's LocalCluster is already "
+                     "process-backed)")
         out_dir = args.telemetry_dir or tempfile.mkdtemp(prefix="chaos_")
         print(f"chaos matrix artifacts: {out_dir}")
         if args.plane == "serving":
             return run_serving_matrix(args.scenario_timeout,
-                                      args.max_scenarios, out_dir)
+                                      args.max_scenarios, out_dir,
+                                      processes=args.processes)
         return run_matrix(args.steps, args.scenario_timeout,
                           args.max_scenarios, out_dir)
     ap.error("pick one of --fault/--matrix")

@@ -1,0 +1,235 @@
+#!/usr/bin/env python
+"""Trace-driven load generation for the serving fleet.
+
+Serving papers agree on one thing about traffic: it is never constant.
+Production request streams breathe on a daily cycle (diurnal), spike in
+correlated bursts (a client retry storm, a page going viral), and carry
+heavy-tailed inter-arrival gaps (a Poisson assumption undershoots the
+p99 queue depth badly).  An autoscaler tuned against a constant-rate
+generator learns nothing about any of those — so this module generates
+the three canonical shapes, seeded and reproducible, as explicit
+arrival traces the autoscaler tests replay:
+
+* :func:`diurnal_trace` — an inhomogeneous Poisson process whose rate
+  rides a sinusoid between ``base_rps`` and ``peak_rps`` (thinning
+  construction: draw at the peak rate, keep with probability
+  ``rate(t)/peak``).
+* :func:`bursty_trace` — an on/off (interrupted Poisson) process:
+  quiet ``idle_rps`` stretches punctuated by ``burst_s``-long windows
+  at ``burst_rps``.
+* :func:`heavy_tail_trace` — Pareto inter-arrival gaps (index
+  ``alpha``), scaled so the MEAN rate is still ``rps`` — same average
+  load as Poisson, far lumpier arrivals.
+
+A trace is a list of :class:`Arrival` rows (arrival time, prompt,
+decode budget), so it can be saved, inspected, or replayed against any
+``submit``-shaped callable.  :class:`LoadReplay` is the incremental
+consumer the serving loop polls (``due(now)`` → the arrivals whose time
+has come); :func:`replay` is the batteries-included real-time driver.
+
+CLI: ``python tools/loadgen.py --trace bursty --duration 5 --seed 0``
+prints the trace as JSON lines plus a rate summary.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    """One request's arrival: when, what prompt, how many tokens."""
+
+    t_s: float
+    prompt: tuple
+    max_new_tokens: int
+
+    def to_dict(self) -> dict:
+        return {"t_s": self.t_s, "prompt": list(self.prompt),
+                "max_new_tokens": self.max_new_tokens}
+
+
+def _requests(rng: np.random.RandomState, times, *, vocab_size: int,
+              prompt_len, max_new_tokens) -> list:
+    """Attach a random prompt + budget to each arrival time (uniform
+    over the given ``(lo, hi)`` inclusive ranges, ids in
+    ``[1, vocab_size)`` — 0 is the conventional pad)."""
+    p_lo, p_hi = prompt_len
+    m_lo, m_hi = max_new_tokens
+    out = []
+    for t in times:
+        n = int(rng.randint(p_lo, p_hi + 1))
+        prompt = tuple(int(x) for x in rng.randint(1, vocab_size, n))
+        out.append(Arrival(t_s=float(t), prompt=prompt,
+                           max_new_tokens=int(rng.randint(m_lo,
+                                                          m_hi + 1))))
+    return out
+
+
+def diurnal_trace(*, duration_s: float, base_rps: float, peak_rps: float,
+                  period_s: Optional[float] = None, seed: int = 0,
+                  vocab_size: int = 32, prompt_len=(2, 6),
+                  max_new_tokens=(4, 8)) -> list:
+    """The daily-cycle shape: rate rides a sinusoid from ``base_rps``
+    (trough, at t=0) up to ``peak_rps`` and back over ``period_s``
+    (default: one full cycle across the duration)."""
+    if peak_rps < base_rps:
+        raise ValueError("peak_rps must be >= base_rps")
+    period = float(period_s or duration_s)
+    rng = np.random.RandomState(seed)
+    times, t = [], 0.0
+    while True:
+        t += float(rng.exponential(1.0 / max(peak_rps, 1e-9)))
+        if t >= duration_s:
+            break
+        phase = 0.5 - 0.5 * np.cos(2 * np.pi * t / period)
+        rate = base_rps + (peak_rps - base_rps) * phase
+        if rng.uniform() < rate / peak_rps:    # thinning
+            times.append(t)
+    return _requests(rng, times, vocab_size=vocab_size,
+                     prompt_len=prompt_len, max_new_tokens=max_new_tokens)
+
+
+def bursty_trace(*, duration_s: float, idle_rps: float, burst_rps: float,
+                 burst_s: float, gap_s: float, seed: int = 0,
+                 vocab_size: int = 32, prompt_len=(2, 6),
+                 max_new_tokens=(4, 8)) -> list:
+    """The on/off shape: ``gap_s`` of ``idle_rps`` background, then
+    ``burst_s`` at ``burst_rps``, repeating.  The first burst starts at
+    ``gap_s`` — a replayed trace begins calm, so a test observes the
+    autoscaler's grow edge AND the shrink after the burst drains."""
+    if burst_rps < idle_rps:
+        raise ValueError("burst_rps must be >= idle_rps")
+    rng = np.random.RandomState(seed)
+    times, t = [], 0.0
+    cycle = gap_s + burst_s
+    # Thinning against the burst rate: stepping at the CURRENT regime's
+    # rate would let one long idle gap leap clean over a whole burst.
+    while True:
+        t += float(rng.exponential(1.0 / max(burst_rps, 1e-9)))
+        if t >= duration_s:
+            break
+        in_burst = (t % cycle) >= gap_s
+        rate = burst_rps if in_burst else idle_rps
+        if rng.uniform() < rate / burst_rps:
+            times.append(t)
+    return _requests(rng, times, vocab_size=vocab_size,
+                     prompt_len=prompt_len, max_new_tokens=max_new_tokens)
+
+
+def heavy_tail_trace(*, duration_s: float, rps: float, alpha: float = 1.5,
+                     seed: int = 0, vocab_size: int = 32,
+                     prompt_len=(2, 6), max_new_tokens=(4, 8)) -> list:
+    """The heavy-tailed shape: Pareto(``alpha``) inter-arrival gaps
+    with the scale chosen so the mean gap is ``1/rps`` (requires
+    ``alpha > 1`` for the mean to exist) — most gaps are short (packed
+    arrivals), a few are very long (dead air), at the same average
+    rate a Poisson process would give."""
+    if alpha <= 1.0:
+        raise ValueError("alpha must be > 1 (finite mean gap)")
+    rng = np.random.RandomState(seed)
+    mean_gap = 1.0 / max(rps, 1e-9)
+    scale = mean_gap * (alpha - 1.0) / alpha
+    times, t = [], 0.0
+    while True:
+        t += float(scale * (1.0 + rng.pareto(alpha)))
+        if t >= duration_s:
+            break
+        times.append(t)
+    return _requests(rng, times, vocab_size=vocab_size,
+                     prompt_len=prompt_len, max_new_tokens=max_new_tokens)
+
+
+#: name -> generator, for CLIs and tests that pick a shape by string.
+TRACES: dict = {
+    "diurnal": diurnal_trace,
+    "bursty": bursty_trace,
+    "heavy-tail": heavy_tail_trace,
+}
+
+
+class LoadReplay:
+    """Incremental trace consumer for a polling serving loop: each
+    ``due(now)`` call returns the arrivals whose time has come (in
+    order, each exactly once), where ``now`` is seconds since the
+    replay's epoch — the caller owns the clock, so tests can drive a
+    virtual one."""
+
+    def __init__(self, trace):
+        self._trace = sorted(trace, key=lambda a: a.t_s)
+        self._i = 0
+
+    def due(self, now: float) -> list:
+        start = self._i
+        while self._i < len(self._trace) \
+                and self._trace[self._i].t_s <= now:
+            self._i += 1
+        return self._trace[start:self._i]
+
+    @property
+    def exhausted(self) -> bool:
+        return self._i >= len(self._trace)
+
+    @property
+    def remaining(self) -> int:
+        return len(self._trace) - self._i
+
+
+def replay(trace, submit: Callable, *, speed: float = 1.0,
+           clock: Callable[[], float] = time.perf_counter,
+           sleep: Callable[[float], None] = time.sleep) -> int:
+    """Real-time replay: call ``submit(arrival)`` at each arrival's
+    time (divided by ``speed`` — 10.0 replays a 10-minute trace in a
+    minute).  Returns the number submitted."""
+    rep = LoadReplay(trace)
+    t0 = clock()
+    n = 0
+    while not rep.exhausted:
+        now = (clock() - t0) * speed
+        batch = rep.due(now)
+        if not batch:
+            nxt = rep._trace[rep._i].t_s
+            sleep(max((nxt - now) / speed, 0.0))
+            continue
+        for arrival in batch:
+            submit(arrival)
+            n += 1
+    return n
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", choices=sorted(TRACES), default="diurnal")
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--rps", type=float, default=8.0,
+                    help="mean rate (heavy-tail) / peak rate (others)")
+    args = ap.parse_args(argv)
+    if args.trace == "diurnal":
+        trace = diurnal_trace(duration_s=args.duration,
+                              base_rps=args.rps / 4, peak_rps=args.rps,
+                              seed=args.seed)
+    elif args.trace == "bursty":
+        trace = bursty_trace(duration_s=args.duration,
+                             idle_rps=args.rps / 8, burst_rps=args.rps,
+                             burst_s=args.duration / 5,
+                             gap_s=args.duration / 5, seed=args.seed)
+    else:
+        trace = heavy_tail_trace(duration_s=args.duration, rps=args.rps,
+                                 seed=args.seed)
+    for a in trace:
+        print(json.dumps(a.to_dict()))
+    rate = len(trace) / args.duration if args.duration else 0.0
+    print(f"# {len(trace)} arrivals over {args.duration:.1f}s "
+          f"({rate:.2f} rps mean)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,8 +77,27 @@ _FAULT_TERMINAL = ("recovered", "degraded", "escalated", "teardown")
 # that cannot be audited.
 _DISPATCH_KEYS = {"kind", "request", "replica", "reason", "re_emitted"}
 _DISPATCH_REASONS = ("route", "failover", "hedge", "drain")
-_KINDS = ("step", "serve", "reshard", "fault", "dispatch", "counter",
-          "gauge", "histogram")
+# Disaggregated-serving handoff records (autodist_tpu/serving/disagg.py):
+# one per prefill→decode KV-prefix transfer.  The replica ids come
+# PAIRED — a handoff names both the prefill replica that produced the
+# prefix and the decode replica that adopted it, or the route cannot be
+# audited; and the per-device gather must sit under the shard budget
+# (the executed form of the ADT072/ADT110 contract).
+_HANDOFF_KEYS = {"kind", "route", "blocks", "bytes_moved", "duration_ms",
+                 "prefill_replica", "decode_replica"}
+_HANDOFF_ROUTES = ("ici", "dcn")
+# Autoscaler transition records (autodist_tpu/serving/autoscale.py):
+# one per grow/shrink.  Each names the trigger that fired and its
+# measured value vs threshold; --check additionally requires the
+# trigger's gauge (autoscale/queue_depth / autoscale/ttft_p99_ms) in
+# the same run — a scale event whose trigger signal was never emitted
+# is a decision nobody can audit.
+_SCALE_KEYS = {"kind", "direction", "trigger", "value", "threshold",
+               "replicas_before", "replicas_after"}
+_SCALE_TRIGGERS = {"queue_depth": "autoscale/queue_depth",
+                   "ttft_p99": "autoscale/ttft_p99_ms"}
+_KINDS = ("step", "serve", "reshard", "fault", "dispatch", "handoff",
+          "scale", "counter", "gauge", "histogram")
 
 
 def load_jsonl(path: str) -> list[dict]:
@@ -182,6 +201,54 @@ def check_schema(run_dir: str) -> list[str]:
                         f"{rec['re_emitted']!r} — the at-most-once "
                         "contract re-emitted tokens to a client "
                         "stream")
+        elif kind == "handoff":
+            missing = _HANDOFF_KEYS - set(rec)
+            if missing:
+                problems.append(
+                    f"metrics.jsonl:{i + 1}: handoff record missing "
+                    f"{sorted(missing)}")
+            else:
+                if rec["route"] not in _HANDOFF_ROUTES:
+                    problems.append(
+                        f"metrics.jsonl:{i + 1}: unknown handoff route "
+                        f"{rec['route']!r} (have {list(_HANDOFF_ROUTES)})")
+                if not rec["prefill_replica"] or not rec["decode_replica"]:
+                    problems.append(
+                        f"metrics.jsonl:{i + 1}: handoff without its "
+                        "paired prefill/decode replica ids — the "
+                        "transfer route cannot be audited")
+                gather = rec.get("per_device_gather_elems")
+                budget = rec.get("budget_elems")
+                if gather is not None and budget and gather > budget:
+                    problems.append(
+                        f"metrics.jsonl:{i + 1}: handoff per-device "
+                        f"gather {gather} exceeds its shard budget "
+                        f"{budget} — a full-pool staging the ADT072 "
+                        "contract forbids")
+        elif kind == "scale":
+            missing = _SCALE_KEYS - set(rec)
+            if missing:
+                problems.append(
+                    f"metrics.jsonl:{i + 1}: scale record missing "
+                    f"{sorted(missing)}")
+            else:
+                if rec["direction"] not in ("grow", "shrink"):
+                    problems.append(
+                        f"metrics.jsonl:{i + 1}: unknown scale "
+                        f"direction {rec['direction']!r}")
+                if rec["trigger"] not in _SCALE_TRIGGERS:
+                    problems.append(
+                        f"metrics.jsonl:{i + 1}: unknown scale trigger "
+                        f"{rec['trigger']!r} (have "
+                        f"{sorted(_SCALE_TRIGGERS)})")
+                delta = rec["replicas_after"] - rec["replicas_before"]
+                want = 1 if rec["direction"] == "grow" else -1
+                if delta != want:
+                    problems.append(
+                        f"metrics.jsonl:{i + 1}: {rec['direction']} "
+                        f"claims {rec['replicas_before']} -> "
+                        f"{rec['replicas_after']} replicas — a scale "
+                        "step moves the count by exactly one")
         elif "name" not in rec:
             problems.append(f"metrics.jsonl:{i + 1}: {kind} without name")
         elif kind == "histogram" and "count" not in rec:
@@ -275,6 +342,22 @@ def check_schema(run_dir: str) -> list[str]:
                     f"metrics.jsonl: serve records declare "
                     f"kv_layout=\"paged\" but the {gname} gauge is "
                     "missing — the block-pool accounting never emitted")
+
+    # A scale transition must come with the gauge for the trigger it
+    # claims fired: the record says "queue depth crossed the line" —
+    # without the autoscale/queue_depth gauge in the same run, the
+    # signal behind the decision was never emitted and the transition
+    # cannot be audited against it.
+    for rec in records:
+        if rec.get("kind") != "scale":
+            continue
+        gname = _SCALE_TRIGGERS.get(rec.get("trigger"))
+        if gname is not None and gname not in gauges:
+            problems.append(
+                f"metrics.jsonl: scale record fired on "
+                f"{rec['trigger']!r} but the {gname} gauge is missing "
+                "— the trigger signal was never emitted")
+            break
 
     manifest = os.path.join(run_dir, "manifest.json")
     if os.path.exists(manifest):
@@ -373,6 +456,8 @@ def render(run_dir: str) -> str:
     dispatches = [r for r in records if r.get("kind") == "dispatch"]
     reshards = [r for r in records if r.get("kind") == "reshard"]
     faults = [r for r in records if r.get("kind") == "fault"]
+    handoffs = [r for r in records if r.get("kind") == "handoff"]
+    scales = [r for r in records if r.get("kind") == "scale"]
     counters = [r for r in records if r.get("kind") == "counter"]
     gauges = [r for r in records if r.get("kind") == "gauge"]
     hists = [r for r in records if r.get("kind") == "histogram"]
@@ -505,6 +590,60 @@ def render(run_dir: str) -> str:
             for name in sorted(depth):
                 replica = name[len("fleet/"):-len("/queue_depth")]
                 lines.append(f"| {replica} | {_fmt(depth[name])} |")
+            lines.append("")
+
+    if handoffs:
+        # The disaggregation section: one KV-prefix handoff per request
+        # that crossed the prefill→decode boundary, summarized by route
+        # plus the prefill→decode pairings — the same pairing --check
+        # gates on.
+        blocks = sum(int(r.get("blocks", 0)) for r in handoffs)
+        moved = sum(int(r.get("bytes_moved", 0)) for r in handoffs)
+        durs = np.asarray([r["duration_ms"] for r in handoffs
+                           if r.get("duration_ms") is not None], float)
+        routes = "/".join(sorted({str(r.get("route")) for r in handoffs}))
+        lines += ["## disaggregated serving", "",
+                  "| handoffs | route | blocks | MB moved | p50 ms | "
+                  "p99 ms |",
+                  "|---|---|---|---|---|---|",
+                  f"| {len(handoffs)} | {routes} | {blocks} "
+                  f"| {_fmt(moved / 1e6)} "
+                  f"| {_fmt(float(np.percentile(durs, 50)) if len(durs) else None)} "
+                  f"| {_fmt(float(np.percentile(durs, 99)) if len(durs) else None)} |",
+                  ""]
+        pairs = {}
+        for r in handoffs:
+            key = (r.get("prefill_replica"), r.get("decode_replica"))
+            pairs[key] = pairs.get(key, 0) + 1
+        lines += ["| prefill → decode | handoffs |", "|---|---|"]
+        for (src, dst) in sorted(pairs):
+            lines.append(f"| {src} → {dst} | {pairs[(src, dst)]} |")
+        lines.append("")
+
+    if scales:
+        # The autoscaling section: every grow/shrink transition with
+        # the trigger that fired it and the measured value against its
+        # threshold, in record order.
+        lines += ["## autoscaling", "",
+                  "| direction | trigger | value | threshold | "
+                  "replicas | replica |",
+                  "|---|---|---|---|---|---|"]
+        for r in scales:
+            lines.append(
+                f"| {r.get('direction')} | {r.get('trigger')} "
+                f"| {_fmt(r.get('value'))} | {_fmt(r.get('threshold'))} "
+                f"| {r.get('replicas_before')} → "
+                f"{r.get('replicas_after')} "
+                f"| {r.get('replica', '—')} |")
+        lines.append("")
+        final = {g["name"]: g["value"] for g in gauges
+                 if g["name"] in ("autoscale/queue_depth",
+                                  "autoscale/ttft_p99_ms")}
+        if final:
+            lines.append(
+                f"- trigger gauges (final): queue depth "
+                f"{_fmt(final.get('autoscale/queue_depth'))}, "
+                f"ttft p99 {_fmt(final.get('autoscale/ttft_p99_ms'))} ms")
             lines.append("")
 
     if reshards:
