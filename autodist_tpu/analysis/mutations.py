@@ -111,6 +111,37 @@ def _multislice_fixture():
     return strategy, spec, trainable
 
 
+def _expert_fixture(mesh=None, **builder_kwargs):
+    """dp×expert MoE plan through the ExpertParallel builder — the base
+    the moe_a2a precision / a2a_ring kernel / expert placement rules
+    mutate against."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu.models.moe_transformer import (MoeConfig,
+                                                     make_moe_lm_trainable)
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.strategy.parallel_builders import ExpertParallel
+
+    mesh = dict(mesh or {"data": 2, "expert": 2})
+    n = 1
+    for v in mesh.values():
+        n *= v
+    spec = ResourceSpec({"topology": {"platform": "cpu",
+                                      "num_devices": n},
+                         "mesh": mesh})
+    cfg = MoeConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                    num_heads=2, expert_hidden=32, num_experts=4,
+                    max_len=8, dtype=jnp.float32)
+    trainable = make_moe_lm_trainable(cfg, optax.sgd(0.05),
+                                      jax.random.PRNGKey(0),
+                                      batch_size=4, seq_len=8)
+    strategy = ExpertParallel(num_experts=4,
+                              **builder_kwargs).build(trainable, spec)
+    return strategy, spec, trainable
+
+
 def _fsdp_fixture():
     from autodist_tpu.resource import ResourceSpec
     from autodist_tpu.strategy.gspmd_builders import FSDPSharded
@@ -789,6 +820,39 @@ def _plan_mutations() -> list[PlanMutation]:
                 collective_precision={"tp_psum": "int8"},
                 kernel=("quant_ring",)),
             edit(lambda d: d["graph_config"].update({"precision": {}}))),
+        PlanMutation(
+            "moe_a2a_orphaned", "ADT020",
+            "moe_a2a narrowing hand-added to a 1-expert-degree plan "
+            "(no dispatch/combine wire exists to narrow)",
+            lambda: _expert_fixture(mesh={"data": 4, "expert": 1}),
+            edit(lambda d: d["graph_config"].update(
+                {"precision": {"moe_a2a": "int8"}}))),
+        PlanMutation(
+            "a2a_ring_policy_stripped", "ADT090",
+            "the moe_a2a policy hand-stripped from an a2a_ring-elected "
+            "plan (the fused dispatch/combine ring would silently "
+            "never run)",
+            lambda: _expert_fixture(
+                collective_precision={"moe_a2a": "int8"},
+                kernel=("a2a_ring",)),
+            edit(lambda d: d["graph_config"].update({"precision": {}}))),
+        PlanMutation(
+            "a2a_ring_pushed_over_dcn", "ADT090",
+            "expert_over_dcn hand-added to an a2a_ring-elected plan "
+            "(the ICI ppermute ring cannot span slices)",
+            lambda: _expert_fixture(
+                collective_precision={"moe_a2a": "int8"},
+                kernel=("a2a_ring",)),
+            edit(lambda d: d["graph_config"]["parallel"].update(
+                {"expert_over_dcn": True}))),
+        PlanMutation(
+            "expert_pushed_over_dcn", "ADT061",
+            "expert placement hand-flipped across the slice boundary "
+            "(every dispatch/combine a2a rides DCN; warns, never "
+            "prunes — the search may elect it on merit)",
+            lambda: _expert_fixture(),
+            edit(lambda d: d["graph_config"]["parallel"].update(
+                {"expert_over_dcn": True}))),
     ]
 
 
@@ -805,6 +869,7 @@ def _inject(line: str):
 def _program_mutations() -> list[ProgramMutation]:
     P = programs
     tp_only = (("tp_psum", "int8"),)
+    moe_only = (("moe_a2a", "int8"),)
     T = P.DEC_T
     lane = P.DEC_SLOTS * 1 * T * P.DEC_HEAD_DIM
     min_gathers = P.Z3_V * P.Z3_LEAVES
@@ -956,6 +1021,30 @@ def _program_mutations() -> list[ProgramMutation]:
             lambda: [R.fused_kernel_replaced(("collective_matmul",),
                                              tp=2)],
             lambda t: P.pipeline_step_text(2, comm_overlap="matmul")),
+        ProgramMutation(
+            "a2a_ring_kernel_dropped", "ADT120",
+            "the fused s8 dispatch/combine ring goes missing (the "
+            "composed monolithic-all-to-all program a dropped kernel "
+            "slot compiles to)",
+            lambda: P.moe_step_text(2, moe_only,
+                                    ("a2a_ring",)),
+            lambda: [R.fused_kernel_replaced(("a2a_ring",), expert=2)],
+            lambda t: P.moe_step_text(2, moe_only)),
+        ProgramMutation(
+            "moe_a2a_policy_dropped", "ADT109",
+            "an int8-policied dispatch/combine boundary compiles to an "
+            "fp32 all-to-all wire (the program a dropped policy would "
+            "compile to)",
+            lambda: P.moe_step_text(2, moe_only),
+            lambda: [R.quantized_wire(mins={"all-to-all": 4})],
+            lambda t: P.moe_step_text(2)),
+        ProgramMutation(
+            "unpolicied_moe_a2a_narrowed", "ADT109",
+            "an fp32-policy MoE program silently narrows its "
+            "dispatch/combine wire",
+            lambda: P.moe_step_text(2),
+            lambda: [R.quantized_wire(clean=True)],
+            lambda t: P.moe_step_text(2, moe_only)),
         ProgramMutation(
             "paged_decode_densified", "ADT115",
             "a paged-elected decode compiles the dense [slots x "

@@ -264,6 +264,11 @@ def rule_orphan_precision_slot(ctx: PlanContext):
             and nc.synchronizer.zero_stage >= 3 for nc in nodes),
         "grad": any(isinstance(nc.synchronizer, AllReduceSynchronizer)
                     for nc in nodes),
+        # The dispatch/combine all_to_all only exists under the expert
+        # lowering with a >1 expert axis; an unresolved mesh (no spec,
+        # no declared axes) stays permissive.
+        "moe_a2a": (ctx.graph.lowering == "expert"
+                    and ctx.mesh.get(const.EXPERT_AXIS, 2) > 1),
     }
     for slot, value in precision.items():
         if not has.get(slot, True):
@@ -520,6 +525,29 @@ def rule_kernel_enabling_knob(ctx: PlanContext):
                 "run",
                 where="graph_config.kernel.quant_ring",
                 fix="drop comm_overlap or the quant_ring election")
+    if "a2a_ring" in kernel:
+        if (ctx.graph.lowering != "expert"
+                or ctx.precision().get("moe_a2a") != "int8"):
+            yield Diagnostic(
+                "ADT090",
+                "kernel 'a2a_ring' fuses q/dq into the s8 "
+                "dispatch/combine ring, but this plan has no int8 "
+                "moe_a2a boundary (lowering="
+                f"{ctx.graph.lowering!r}, precision="
+                f"{ctx.precision() or '{}'})",
+                where="graph_config.kernel.a2a_ring",
+                fix="set collective_precision's moe_a2a slot to "
+                    "'int8' under the expert lowering, or drop the "
+                    "election")
+        elif ctx.parallel.get("expert_over_dcn"):
+            yield Diagnostic(
+                "ADT090",
+                "kernel 'a2a_ring' is an ICI ppermute ring; with "
+                "expert_over_dcn the dispatch/combine hops would span "
+                "the slice boundary the ring cannot cross",
+                where="graph_config.kernel.a2a_ring",
+                fix="keep the expert axis within a slice, or drop the "
+                    "election")
     if "collective_matmul" in kernel and (ctx.tp <= 1
                                           or overlap != "matmul"):
         yield Diagnostic(
@@ -567,6 +595,28 @@ def rule_dcn_axis_misuse(ctx: PlanContext):
             where=nc.var_name,
             fix="shard over 'model'/'pipe' (ici axes) and leave 'dcn' "
                 "to the data-parallel replica set")
+
+
+@plan_rule
+def rule_expert_over_dcn(ctx: PlanContext):
+    """Expert sharding across the slice boundary is *legal* — unlike
+    ADT060's variable sharding, the search emits it deliberately when
+    the DCN links beat the priced within-slice alternative — but every
+    dispatch/combine ``all_to_all`` then rides the slow inter-slice
+    fabric, so it warns rather than errors: visible in a lint sweep,
+    never pruned from the search frontier."""
+    if ctx.graph.lowering != "expert":
+        return
+    if ctx.parallel.get("expert_over_dcn"):
+        yield Diagnostic(
+            "ADT061",
+            "expert axis spans the cross-slice DCN boundary: every "
+            "dispatch/combine all_to_all pays inter-slice bandwidth "
+            "and latency (the hierarchical cost model prices this; "
+            "elect it only when the numbers say so)",
+            where="parallel.expert_over_dcn",
+            fix="keep the expert axis within a slice unless the "
+                "priced across-DCN placement wins on this topology")
 
 
 # --------------------------------------------------------------------------- #

@@ -377,7 +377,7 @@ def no_collectives() -> Rule:
                     "must skip the sync")
 
 
-def fused_kernel_replaced(kernels, tp: int = 2) -> Rule:
+def fused_kernel_replaced(kernels, tp: int = 2, expert: int = 2) -> Rule:
     """ADT120: every elected fused kernel actually replaced its
     composed op soup.  Evidence, per kernel:
 
@@ -388,7 +388,12 @@ def fused_kernel_replaced(kernels, tp: int = 2) -> Rule:
       TRUE-``s8`` collective-permutes (the composed int8 lowering has
       zero — its wire is one monolithic fp16-levels all-reduce);
     * ``collective_matmul`` additionally shows the ring itself:
-      ``>= tp-1`` collective-permutes (the blocking sibling has none).
+      ``>= tp-1`` collective-permutes (the blocking sibling has none);
+    * ``a2a_ring`` additionally shows the dispatch/combine ring wire:
+      ``>= 2(expert-1)`` TRUE-``s8`` collective-permutes per step (one
+      (expert-1)-hop shift ring each for dispatch and combine; the
+      composed int8 a2a lowers to monolithic s8 ``all-to-all`` ops,
+      which contribute zero collective-permutes).
     """
     kernels = tuple(kernels)
 
@@ -417,6 +422,15 @@ def fused_kernel_replaced(kernels, tp: int = 2) -> Rule:
                         f"collective_matmul elected but only {perms} "
                         f"collective-permute(s) (expected >= {tp - 1}) "
                         "— the chunked ring is missing")
+            if name == "a2a_ring":
+                s8_perms = f.narrowed.get("collective-permute", 0)
+                want = 2 * (expert - 1)
+                if s8_perms < want:
+                    out.append(
+                        f"a2a_ring elected but only {s8_perms} "
+                        f"narrowed collective-permute(s) (expected >= "
+                        f"{want} for the {expert}-way dispatch/combine "
+                        "rings) — the s8 ring wire is missing")
         return out
 
     return Rule("ADT120", "fused_kernel_replaced",
@@ -509,10 +523,15 @@ def rules_for_strategy(strategy, *, vocab_size: Optional[int] = None,
     tp = max(int(par.get("tensor_parallel", 1)), 1)
     precision = normalize_precision(gc.precision)
     kernel = normalize_kernel(getattr(gc, "kernel", None))
-    train_kernels = tuple(k for k in ("quant_ring", "collective_matmul")
+    train_kernels = tuple(k for k in ("quant_ring", "collective_matmul",
+                                      "a2a_ring")
                           if k in kernel)
     if train_kernels:
-        rules.append(fused_kernel_replaced(train_kernels, tp=tp))
+        from autodist_tpu import const
+        expert_deg = max(int((gc.mesh_axes or {})
+                             .get(const.EXPERT_AXIS, 1) or 1), 1)
+        rules.append(fused_kernel_replaced(train_kernels, tp=tp,
+                                           expert=expert_deg))
     compressors = {getattr(nc.synchronizer, "compressor", "none") or "none"
                    for nc in strategy.node_configs}
     zero_stages = {nc.synchronizer.zero_stage
@@ -537,6 +556,13 @@ def rules_for_strategy(strategy, *, vocab_size: Optional[int] = None,
         if max(zero_stages, default=0) >= 3 \
                 and precision.get("zero3_gather"):
             mins["all-gather"] = zero3_min_gathers
+        if precision.get("moe_a2a") and "a2a_ring" not in kernel \
+                and gc.lowering == "expert" \
+                and int((gc.mesh_axes or {}).get("expert", 2) or 2) > 1:
+            # Composed narrowed dispatch/combine: the wire is monolithic
+            # bf16/s8 all-to-all ops.  Under a2a_ring those become s8
+            # collective-permutes and ADT120 carries the evidence.
+            mins["all-to-all"] = 1
         if mins:
             rules.append(quantized_wire(mins=mins))
 

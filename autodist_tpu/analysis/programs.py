@@ -239,6 +239,56 @@ def zero_step_text(zero_stage: int, collective_precision=None) -> str:
 
 
 # --------------------------------------------------------------------------- #
+# MoE expert-parallel programs
+# --------------------------------------------------------------------------- #
+def moe_runner(expert: int = 2, collective_precision=None, kernel=None,
+               zero_stage: int = 0):
+    """dp×expert MoE LM (mesh {data:2, expert:E}) through the
+    ExpertParallel strategy — the dispatch/combine all_to_all pair is
+    the program's moe_a2a wire boundary."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist
+    from autodist_tpu.models.moe_transformer import (MoeConfig,
+                                                     make_moe_lm_trainable)
+
+    cfg = MoeConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                    num_heads=2, expert_hidden=32, num_experts=4,
+                    max_len=8, dtype=jnp.float32)
+    trainable = make_moe_lm_trainable(cfg, optax.adam(1e-2),
+                                      jax.random.PRNGKey(0),
+                                      batch_size=4, seq_len=8)
+    spec = {"topology": {"platform": "cpu", "num_devices": 2 * expert},
+            "mesh": {"data": 2, "expert": expert}}
+    if isinstance(collective_precision, tuple):
+        collective_precision = dict(collective_precision)
+    return AutoDist(spec, "ExpertParallel", zero_stage=zero_stage,
+                    num_experts=4,
+                    collective_precision=collective_precision,
+                    kernel=kernel).build(trainable)
+
+
+@functools.lru_cache(maxsize=None)
+def moe_step_text(expert: int = 2, collective_precision=None,
+                  kernel=None, zero_stage: int = 0) -> str:
+    import jax
+    import numpy as np
+
+    r = np.random.RandomState(0)
+    x = r.randint(0, 32, (8, 8)).astype(np.int32)
+    batch = {"x": x, "y": np.roll(x, -1, axis=1)}
+    runner = moe_runner(expert, collective_precision, kernel, zero_stage)
+    try:
+        return compiled_text(runner.lowered.step_fn, runner.state,
+                             runner._place_batch(batch),
+                             jax.random.PRNGKey(0))
+    finally:
+        runner.close()
+
+
+# --------------------------------------------------------------------------- #
 # Elastic reshard programs
 # --------------------------------------------------------------------------- #
 # Distinctive dim of the resharded matrix (no other tensor dimension

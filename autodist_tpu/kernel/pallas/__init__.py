@@ -22,6 +22,12 @@ shape-dependent too):
   .collective_matmul_row_fused` — the ``ppermute``-chunked row-parallel
   matmul of ``parallel/tensor.py collective_matmul_row`` with the hop
   accumulate + chunk matmul fused into one kernel pass.
+* :func:`~autodist_tpu.kernel.pallas.a2a_ring.quantized_ring_all_to_all`
+  — the quant_ring generalized from reduce to permute: the MoE
+  dispatch/combine ``all_to_all`` rewritten as a ``ppermute`` rotation
+  ring whose every hop carries a TRUE ``s8`` chunk + fp32 scale, with
+  the q/dq fused into the hop (no convert sandwich around one
+  monolithic collective).
 
 Every kernel runs under the Pallas interpreter off-TPU (the simulated
 CPU mesh the test harness uses), so each carries a CPU golden pinned
@@ -37,12 +43,12 @@ from __future__ import annotations
 # The Strategy IR's kernel-slot vocabulary (strategy/ir.py
 # normalize_kernel re-exports this; kernel code stays IR-agnostic).
 KERNEL_CHOICES = ("flash_decode", "flash_prefill", "quant_ring",
-                  "collective_matmul")
+                  "collective_matmul", "a2a_ring")
 
-# Kernels that change the *training* program (the pipeline lowering
-# honors them); flash_decode/flash_prefill are serving-side (the
-# decode and chunked-prefill programs).
-TRAINING_KERNELS = ("quant_ring", "collective_matmul")
+# Kernels that change the *training* program (the pipeline and expert
+# lowerings honor them); flash_decode/flash_prefill are serving-side
+# (the decode and chunked-prefill programs).
+TRAINING_KERNELS = ("quant_ring", "collective_matmul", "a2a_ring")
 
 # Op-metadata marker prefix: `with jax.named_scope(kernel_marker(name))`
 # around a pallas_call stamps every emitted op's `op_name` metadata, and
@@ -86,4 +92,8 @@ def __getattr__(name):
         from autodist_tpu.kernel.pallas.collective_matmul import \
             collective_matmul_row_fused
         return collective_matmul_row_fused
+    if name == "quantized_ring_all_to_all":
+        from autodist_tpu.kernel.pallas.a2a_ring import \
+            quantized_ring_all_to_all
+        return quantized_ring_all_to_all
     raise AttributeError(name)

@@ -62,7 +62,7 @@ class MoeBlock(nn.Module):
     expert_sharded: bool
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, a2a=(None, False)):
         from jax import lax
 
         cfg = self.cfg
@@ -86,9 +86,11 @@ class MoeBlock(nn.Module):
                         jnp.float32)
         tokens = x.reshape(B * L, H).astype(jnp.float32)
         if self.expert_sharded:
+            a2a_precision, a2a_kernel = a2a
             out, aux = expert_parallel_ffn(
                 tokens, gate, wi, wo, axis_name=const.EXPERT_AXIS,
-                capacity_factor=cfg.capacity_factor)
+                capacity_factor=cfg.capacity_factor,
+                a2a_precision=a2a_precision, a2a_kernel=a2a_kernel)
         else:
             G = tokens.shape[0]
             capacity = max(int(np.ceil(
@@ -104,7 +106,7 @@ class MoeTransformerLM(nn.Module):
     expert_sharded: bool = False
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, a2a=(None, False)):
         cfg = self.cfg
         enc = cfg.encoder_cfg()
         B, L = tokens.shape
@@ -121,7 +123,7 @@ class MoeTransformerLM(nn.Module):
             x = nn.LayerNorm(dtype=cfg.dtype,
                              name=f"layer_{i}_ln_attention")(x + a)
             m, aux = MoeBlock(cfg, self.expert_sharded,
-                              name=f"layer_{i}_moe")(x)
+                              name=f"layer_{i}_moe")(x, a2a)
             aux_total = aux_total + aux
             x = nn.LayerNorm(dtype=cfg.dtype,
                              name=f"layer_{i}_ln_moe")(x + m)
@@ -146,12 +148,31 @@ def make_moe_lm_trainable(cfg: MoeConfig, optimizer, rng, *,
         if hasattr(rng, "dtype") else rng), tokens)["params"]
     model = MoeTransformerLM(cfg, expert_sharded=expert_sharded)
 
+    # The dispatch/combine wire election slot: ``lower_expert_ir``
+    # writes the strategy's ``precision["moe_a2a"]`` + ``a2a_ring``
+    # kernel election here BEFORE the step traces, and the loss reads it
+    # at trace time — the lowering binds the wire, not the model author.
+    a2a_slot = {"precision": None, "kernel": False}
+
     def loss(p, extra, batch, step_rng):
-        logits, aux = model.apply({"params": p}, batch["x"])
+        logits, aux = model.apply(
+            {"params": p}, batch["x"],
+            a2a=(a2a_slot["precision"], a2a_slot["kernel"]))
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, batch["y"][..., None], axis=-1)
         nll = -jnp.mean(ll)
         total = nll + cfg.aux_weight * aux
         return total, extra, {"loss": total, "nll": nll, "aux": aux}
 
-    return Trainable(loss, params, optimizer, name="moe_lm")
+    t = Trainable(loss, params, optimizer, name="moe_lm")
+    t.moe_a2a = a2a_slot
+    # Declared MoE shape: the topology-aware search keys its
+    # expert-parallel candidate family off these (they parameterize the
+    # objective, so the search records — never sweeps — them).
+    t.num_experts = cfg.num_experts
+    t.capacity_factor = cfg.capacity_factor
+    # Token hint for the cost model's activation terms (the a2a
+    # dispatch/combine payload scales with it); the factory knows the
+    # step shape, so the search never has to guess it from a batch.
+    t.tokens_per_step = batch_size * seq_len
+    return t

@@ -8,12 +8,16 @@ the Mesh-TensorFlow / GShard formulation).
 """
 from __future__ import annotations
 
+import functools
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
 from autodist_tpu import const
+from autodist_tpu.kernel import quantize as qz
 
 
 def top2_gating(gate_logits, capacity: int):
@@ -58,20 +62,107 @@ def top2_gating(gate_logits, capacity: int):
     return dispatch, combine, aux_loss
 
 
+def _qa2a_impl(x, axis_name, split_axis, concat_axis, precision):
+    """One narrowed tiled all_to_all: the convert *sandwich* around a
+    single monolithic collective (vs. the fused ring that moves q/dq
+    inside the hops).  ``bf16``: cast → a2a → cast.  ``int8``: quantize
+    the whole local payload against ONE abs-max scale, ship true ``s8``,
+    all_gather the n scales alongside and dequantize per source block of
+    the concat dim."""
+    n = lax.axis_size(axis_name)
+    if precision == "bf16":
+        y = lax.all_to_all(x.astype(jnp.bfloat16), axis_name,
+                           split_axis=split_axis, concat_axis=concat_axis,
+                           tiled=True)
+        return y.astype(x.dtype)
+    if precision != "int8":
+        raise ValueError(f"moe_a2a precision {precision!r}; expected one "
+                         f"of {list(qz.PRECISIONS)}")
+    xf = x.astype(jnp.float32)
+    scale = qz.abs_max_scale(xf)
+    q = qz.quantize_levels(xf, scale).astype(jnp.int8)
+    q = lax.all_to_all(q, axis_name, split_axis=split_axis,
+                       concat_axis=concat_axis, tiled=True)
+    # lint: allow-raw-collective — fp32 scale side-channel OF the policied s8 a2a
+    scales = lax.all_gather(scale, axis_name)            # [n], source order
+    # The output concat dim is n source-ordered blocks of the input's
+    # concat length; each block dequantizes with its source's scale.
+    c = x.shape[concat_axis]
+    moved = jnp.moveaxis(q.astype(jnp.float32), concat_axis, 0)
+    rest = moved.shape[1:]
+    blocks = moved.reshape((n, c) + rest)
+    blocks = blocks * scales.reshape((n,) + (1,) * (blocks.ndim - 1))
+    out = jnp.moveaxis(blocks.reshape((n * c,) + rest), 0, concat_axis)
+    return out.astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _qa2a(x, axis_name, split_axis, concat_axis, precision):
+    return _qa2a_impl(x, axis_name, split_axis, concat_axis, precision)
+
+
+def _qa2a_fwd(x, axis_name, split_axis, concat_axis, precision):
+    return _qa2a_impl(x, axis_name, split_axis, concat_axis, precision), None
+
+
+def _qa2a_bwd(axis_name, split_axis, concat_axis, precision, _, ct):
+    # The cotangent of an all_to_all is the all_to_all with split/concat
+    # swapped; the backward wire narrows like the forward (the moe_a2a
+    # policy covers BOTH directions — tolerance contract, not a detail).
+    return (_qa2a_impl(ct, axis_name, concat_axis, split_axis, precision),)
+
+
+_qa2a.defvjp(_qa2a_fwd, _qa2a_bwd)
+
+
+def quantized_all_to_all(x, axis_name, *, split_axis: int,
+                         concat_axis: int, precision: Optional[str] = None):
+    """Tiled ``lax.all_to_all`` under a ``moe_a2a`` wire precision.
+
+    ``None``/``"fp32"`` is the exact collective; ``"bf16"``/``"int8"``
+    narrow the wire as a composed convert sandwich (one whole-payload
+    scale — contrast the per-chunk scales of the elected
+    ``a2a_ring`` kernel), with the transposed all_to_all at the same
+    precision as backward."""
+    if precision in (None, "fp32"):
+        return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+    return _qa2a(x, axis_name, split_axis, concat_axis, precision)
+
+
 def expert_parallel_ffn(tokens, gate_w, expert_wi, expert_wo, *,
                         axis_name: str = const.EXPERT_AXIS,
-                        capacity_factor: float = 2.0):
+                        capacity_factor: float = 2.0,
+                        a2a_precision: Optional[str] = None,
+                        a2a_kernel: bool = False):
     """MoE FFN (call inside ``shard_map``).
 
     tokens: [G, M] local tokens;  gate_w: [M, E] replicated;
     expert_wi: [E_local, M, H], expert_wo: [E_local, H, M] — this device's
     experts.  Returns ([G, M], aux_loss).
+
+    ``a2a_precision`` narrows the dispatch/combine wire (the
+    ``GraphConfig.precision["moe_a2a"]`` policy); ``a2a_kernel`` swaps
+    both all_to_alls for the fused s8 ``ppermute`` ring
+    (:func:`autodist_tpu.kernel.pallas.a2a_ring.ring_dispatch` — the
+    elected ``a2a_ring`` kernel; implies the int8 wire).
     """
     P = lax.axis_size(axis_name)
     G, M = tokens.shape
     E_local = expert_wi.shape[0]
     E = E_local * P
     capacity = max(int(np.ceil(2 * G * capacity_factor / E)), 4)
+
+    if a2a_kernel:
+        from autodist_tpu.kernel.pallas.a2a_ring import ring_dispatch
+
+        def route(x, split_axis, concat_axis):
+            return ring_dispatch(x, axis_name, split_axis, concat_axis)
+    else:
+        def route(x, split_axis, concat_axis):
+            return quantized_all_to_all(
+                x, axis_name, split_axis=split_axis,
+                concat_axis=concat_axis, precision=a2a_precision)
 
     gate_logits = tokens @ gate_w                        # [G, E]
     dispatch, combine, aux = top2_gating(gate_logits, capacity)
@@ -81,14 +172,12 @@ def expert_parallel_ffn(tokens, gate_w, expert_wi, expert_wo, *,
                     dispatch.astype(jnp.float32))
     # all_to_all (tiled): every device keeps its E_local experts, gathering
     # those experts' slots from all P devices → [E_local, P*C, M]
-    xs = lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=1,
-                        tiled=True)
+    xs = route(xs, 0, 1)
     h = jnp.einsum("ecm,emh->ech", xs, expert_wi.astype(jnp.float32))
     h = jax.nn.gelu(h)
     ys = jnp.einsum("ech,ehm->ecm", h, expert_wo.astype(jnp.float32))
     # route back: [E, C, M] on every source device
-    ys = lax.all_to_all(ys, axis_name, split_axis=1, concat_axis=0,
-                        tiled=True)
+    ys = route(ys, 1, 0)
     out = jnp.einsum("ecm,gec->gm", ys, combine)
     return out.astype(tokens.dtype), aux
 
@@ -141,6 +230,29 @@ def lower_expert_ir(trainable, strategy, mesh):
             raise ValueError(
                 f"expert variable {name} leading dim {shape} must divide "
                 f"the {E_shards}-way expert axis")
+
+    # Bind the dispatch/combine wire election into the loss: the
+    # trainable publishes a mutable ``moe_a2a`` slot (its loss reads the
+    # slot at trace time — `make_moe_lm_trainable` threads it down to
+    # ``expert_parallel_ffn``), and the lowering writes the strategy's
+    # ``precision["moe_a2a"]`` + ``kernel["a2a_ring"]`` election into it.
+    # A strategy that elects either without a slot to bind would silently
+    # train at fp32 — fail loudly instead.
+    from autodist_tpu.parallel._spmd import emit_kernel_gauges
+    a2a_prec = strategy.graph_config.precision.get("moe_a2a")
+    a2a_kern = bool(strategy.graph_config.kernel.get("a2a_ring"))
+    slot = getattr(trainable, "moe_a2a", None)
+    if slot is not None:
+        slot["precision"] = a2a_prec
+        slot["kernel"] = a2a_kern
+    elif a2a_prec or a2a_kern:
+        raise ValueError(
+            "strategy elects a moe_a2a wire policy "
+            f"(precision={a2a_prec!r}, a2a_ring={a2a_kern}) but trainable "
+            f"{trainable.name!r} has no moe_a2a binding slot (see "
+            "make_moe_lm_trainable)")
+    emit_kernel_gauges({k: True for k, v in
+                        strategy.graph_config.kernel.items() if v})
 
     def param_spec(name, leaf):
         if name in expert_vars:

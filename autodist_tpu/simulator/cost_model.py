@@ -162,6 +162,20 @@ KERNEL_PROFILE: dict = {
     "spec_draft_flops_frac": 0.15,
     "spec_marginal_token_cost": 0.35,
     "spec_acceptance_default": 0.7,
+    # MoE dispatch/combine ring (``a2a_ring``, the quant_ring
+    # generalized from reduce to permute).  Unlike the reduce ring, the
+    # composed int8 all_to_all ALREADY ships true s8 (a permute never
+    # sums, so there is no fp16-levels headroom wire to beat) — the
+    # analytic wire factor therefore matches GATHER_WIRE_FACTOR's int8
+    # 0.25 and the election crossover lives in the q/dq term: the fused
+    # hop quantizes/dequantizes in VMEM (``a2a_ring_qdq_factor`` < 1 vs
+    # the composed sandwich's HBM-shaped converts) but pays 2(n-1) hop
+    # launches per dispatch+combine pair where the monolithic collective
+    # pays 2 — so the ring wins exactly when the payload is large enough
+    # that the q/dq saving clears the extra alphas (``bench.py moe``
+    # measures both on silicon).
+    "a2a_ring_wire_factor": 0.25,
+    "a2a_ring_qdq_factor": 0.5,
 }
 
 # The grad slot's realization: which EF compressor a bf16/int8 gradient
@@ -296,6 +310,14 @@ class StrategyCost:
     # and the search report can show per-level comm per candidate.
     dcn_bytes: float = 0.0
     dcn_time_s: float = 0.0
+    # Expert-parallel all_to_all term (MoE dispatch + combine, forward
+    # and backward), already included in comm_bytes / comm_time_s (or
+    # the dcn terms when the expert axis spans slices) — broken out so
+    # the drift report can join the predicted dispatch/combine wire
+    # against the measured step and the search report can show the
+    # placement trade (within-slice ICI vs across-DCN) per candidate.
+    a2a_bytes: float = 0.0
+    a2a_time_s: float = 0.0
 
     @property
     def score(self) -> float:
@@ -741,6 +763,10 @@ class CostModel:
         hidden_bytes = 0.0
         extra_colls = 0
         peak_logits = 0.0
+        # Expert dispatch/combine breakout (bytes ride the comm or dcn
+        # pools above; the time share is re-derived for the report).
+        a2a_b = 0.0
+        a2a_t = 0.0
 
         # Per-collective precision policy (PR 8): wire factors shrink
         # each policied boundary's bytes; the q/dq compute term charges
@@ -1316,12 +1342,71 @@ class CostModel:
                                  else 2 if opt_div > 1 else 1)
                     colls += (2 * accum if stage >= 3
                               else 2 if opt_div > 1 else 1)
-            if tokens:
-                # all_to_all dispatch + combine, fwd + bwd: 4 passes of
-                # the local token activations, (E-1)/E leaving the device
-                comm += 4.0 * tokens_per_dev * hidden * _ACT_BYTES \
-                    * (E - 1) / max(E, 1)
-                colls += 4
+            if tokens and E > 1:
+                # Hierarchical all_to_all term: dispatch + combine, fwd
+                # + bwd — 4 passes of the capacity-padded routed slots.
+                # Top-2 routing fills E x C = 2 x cf x G slots, so the
+                # [E, C, M] payload is (2 x capacity_factor) local token
+                # activations, (E-1)/E of it leaving the device.
+                knobs = strategy.graph_config.parallel
+                cap_f = float(knobs.get("capacity_factor", 2.0))
+                over_dcn = bool(knobs.get("expert_over_dcn", False))
+                a2a_prec = policy.get("moe_a2a", "fp32")
+                payload = 4.0 * (2.0 * cap_f) * tokens_per_dev * hidden \
+                    * _ACT_BYTES * (E - 1) / E
+                # Permute-shaped: the wire narrows like a gather (true
+                # s8 at int8 — no summing, no fp16-levels headroom).
+                factor = GATHER_WIRE_FACTOR[a2a_prec]
+                a2a_kernel = ("a2a_ring" in kern_cfg
+                              and a2a_prec == "int8" and not over_dcn)
+                if a2a_kernel:
+                    factor = float(kp["a2a_ring_wire_factor"])
+                wired = payload * factor
+                saved_bytes += payload - wired
+                if a2a_prec != "fp32":
+                    # whole payload quantized before / dequantized after
+                    # each pass; the fused ring does both inside the hop
+                    # (the calibratable VMEM-vs-HBM factor).
+                    qdq_a2a = qdq(payload / _ACT_BYTES, a2a_prec) \
+                        * (float(kp["a2a_ring_qdq_factor"])
+                           if a2a_kernel else 1.0)
+                    qdq_s += qdq_a2a
+                    a2a_t += qdq_a2a
+                # The ring decomposes each all_to_all into E-1 ppermute
+                # hops (2(E-1) per dispatch+combine pair — the ADT120
+                # wire signature); the monolithic collective is one
+                # launch per pass.
+                a2a_launches = 4 * (E - 1) if a2a_kernel else 4
+                if over_dcn:
+                    # Expert axis spanning slices: every routed slot
+                    # crosses DCN each pass, never overlap-credited —
+                    # exactly why the search keeps experts within a
+                    # slice (ADT061 flags plans that don't) unless the
+                    # topology's link constants invert the trade.
+                    dcn_b += wired
+                    t = wired / bw_dcn + dcn_alpha * a2a_launches
+                    dcn_t += t
+                    a2a_t += t
+                    dcn_colls += a2a_launches
+                elif a2a_kernel:
+                    # Fused ring: the kernel issues each hop's ppermute
+                    # (and on silicon its RDMA), so the 4(E-1) launches
+                    # are priced at the calibratable fused alpha — the
+                    # composed monolithic collective pays the full
+                    # hop_alpha per pass.  This launch trade (against
+                    # the halved q/dq above) is the ring-vs-composed
+                    # crossover the search arbitrates.
+                    comm += wired
+                    extra_colls += a2a_launches
+                    t_launch = float(kp["fused_hop_alpha_s"]) \
+                        * a2a_launches
+                    overlap_s += t_launch
+                    a2a_t += wired / bw_link + t_launch
+                else:
+                    comm += wired
+                    colls += a2a_launches
+                    a2a_t += wired / bw_link + hop_alpha * a2a_launches
+                a2a_b += wired
             if tokens and act_hint:
                 mem += act_hint * tokens_per_dev
         comm_time = ((comm / bw_link + hop_alpha * colls + overlap_s
@@ -1346,6 +1431,9 @@ class CostModel:
                                              else 0.0),
                             dcn_bytes=dcn_b,
                             dcn_time_s=(dcn_t if total_devices > 1
+                                        else 0.0),
+                            a2a_bytes=a2a_b,
+                            a2a_time_s=(a2a_t if total_devices > 1
                                         else 0.0))
 
     # ------------------------------------------------------------------ #

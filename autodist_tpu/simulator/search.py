@@ -88,11 +88,32 @@ class KnobConfig:
     # resolves the set); None keeps the composed lowerings.
     kernel: Optional[str] = None
     pipeline: bool = True      # stage-structured (Pipeline) vs generic
+    # Expert-parallel (MoE) family, PR 18.  ``expert`` > 0 marks the
+    # candidate as the expert lowering with that expert-axis degree
+    # (1 = the dense point: experts replicated, no all_to_all);
+    # ``num_experts``/``capacity_factor`` are copied from the
+    # trainable's declared MoE shape (they change the *objective*, so
+    # the search records — never sweeps — them); ``expert_over_dcn``
+    # is the placement knob of arxiv 2110.10548's sharpest trade: the
+    # expert axis spans slices (mesh drops the separate dcn axis, the
+    # a2a pays DCN rates, ADT061 flags it) — emitted only so inverted
+    # link constants can elect it.
+    expert: int = 0
+    num_experts: int = 0
+    capacity_factor: float = 2.0
+    expert_over_dcn: bool = False
 
     def mesh(self) -> dict:
         """The candidate's mesh factorization — dcn outermost (slice
-        boundaries), model innermost (tp rides the shortest links)."""
+        boundaries), model/expert innermost (they ride the shortest
+        links — unless ``expert_over_dcn`` deliberately crosses)."""
         shape: dict = {}
+        if self.expert:
+            if not self.expert_over_dcn and self.dp_dcn > 1:
+                shape[const.DCN_AXIS] = self.dp_dcn
+            shape[const.DATA_AXIS] = self.dp_ici
+            shape[const.EXPERT_AXIS] = self.expert
+            return shape
         if self.dp_dcn > 1:
             shape[const.DCN_AXIS] = self.dp_dcn
         if self.dp_ici > 1 or not self.pipeline:
@@ -112,9 +133,11 @@ class KnobConfig:
         model — where calibration can disfavor fusion
         (``fused_hop_alpha_s`` at or above the measured ``hop_alpha``)
         — ever prices it.  The kernel-vs-composed election must always
-        reach pricing, in both directions."""
+        reach pricing, in both directions.  Expert candidates group by
+        their expert degree + placement for the same reason: the
+        within-slice-vs-across-DCN election is the cost model's call."""
         return (self.dp_dcn, self.dp_ici, self.pp, self.tp,
-                bool(self.kernel))
+                bool(self.kernel), self.expert, self.expert_over_dcn)
 
     def knob_string(self) -> str:
         """Descriptive candidate name, e.g.
@@ -139,6 +162,10 @@ class KnobConfig:
             parts.append(self.compressor)
         if self.kernel:
             parts.append("kern")
+        if self.expert:
+            parts.append(f"ex{self.expert}"
+                         + ("xdcn" if self.expert_over_dcn else ""))
+            parts.append(f"cf{self.capacity_factor:g}")
         return "_".join(parts)
 
     def knobs(self) -> dict:
@@ -150,7 +177,11 @@ class KnobConfig:
                 "comm_overlap": self.comm_overlap,
                 "collective_precision": self.collective_precision,
                 "compressor": self.compressor,
-                "kernel": self.kernel}
+                "kernel": self.kernel,
+                "expert": self.expert,
+                "num_experts": self.num_experts,
+                "capacity_factor": self.capacity_factor,
+                "expert_over_dcn": self.expert_over_dcn}
 
 
 @dataclasses.dataclass
@@ -285,6 +316,13 @@ def enumerate_configs(trainable: Trainable, resource_spec: ResourceSpec,
                       if n_ici % p == 0 and num_stages % p == 0]
     else:
         pp_choices = [1]
+    if not stage_structured and int(getattr(trainable, "num_experts", 0)
+                                    or 0) > 1:
+        # An expert-sharded trainable's loss binds the ``expert`` mesh
+        # axis at trace time: only the expert family (degree 1 = the
+        # dense point) can lower it, so the generic dp/tp/zero families
+        # are not emitted at all.
+        pp_choices = []
 
     configs = []
     for pp in pp_choices:
@@ -337,10 +375,55 @@ def enumerate_configs(trainable: Trainable, resource_spec: ResourceSpec,
                                             num_microbatches=M,
                                             vocab_parallel=vp,
                                             zero_stage=zero,
-                                            comm_overlap=ov,
-                                            collective_precision=prec,
                                             compressor=comp,
+                                            collective_precision=prec,
+                                            comm_overlap=ov,
                                             kernel=kern, **base))
+
+    # ---- expert-parallel family (PR 18) ------------------------------- #
+    # A generic trainable that declares its MoE shape (``num_experts``
+    # attribute — make_moe_lm_trainable sets it) additionally gets the
+    # expert-lowering family: every within-slice expert degree that
+    # divides both the slice and the expert count (degree 1 is the
+    # dense point — experts replicated, no all_to_all — so
+    # dense-vs-MoE is the cost model's election, decided by the a2a
+    # term vs. the replicated tables' memory + sync), plus the
+    # across-DCN placements when the topology is multi-slice (emitted
+    # despite ADT061's warning so inverted link constants can elect
+    # them).  The moe_a2a wire precision and the a2a_ring kernel ride
+    # the same precision/kernel columns as every other boundary.
+    num_experts = int(getattr(trainable, "num_experts", 0) or 0)
+    if not stage_structured and num_experts > 1:
+        cap_f = float(getattr(trainable, "capacity_factor", 2.0) or 2.0)
+        moe = dict(num_experts=num_experts, capacity_factor=cap_f,
+                   pipeline=False)
+        placements = []
+        for e_ici in _divisors(n_ici):
+            if num_experts % e_ici:
+                continue
+            placements.append((e_ici, n_dcn, n_ici // e_ici, False))
+            if n_dcn > 1 and num_experts % (n_dcn * e_ici) == 0:
+                placements.append((n_dcn * e_ici, 1, n_ici // e_ici,
+                                   True))
+        for e, dcn, dp_ici, over in placements:
+            for zero in space.zero_stage:
+                for prec in space.collective_precision:
+                    for kern in space.kernel:
+                        if kern and not (prec == "int8" and e > 1
+                                         and not over):
+                            # a2a_ring needs the int8 moe_a2a wire and
+                            # an actual within-slice ring to fuse.
+                            continue
+                        if prec and e <= 1:
+                            # degree-1 expert axis has no a2a boundary
+                            # for the wire policy to narrow (the ADT020
+                            # orphan-slot contradiction).
+                            continue
+                        configs.append(KnobConfig(
+                            dp_dcn=dcn, dp_ici=dp_ici, pp=1, tp=1,
+                            zero_stage=zero, collective_precision=prec,
+                            kernel=kern, expert=e, expert_over_dcn=over,
+                            **moe))
     return configs
 
 
@@ -419,6 +502,18 @@ def _proxies(cfg: KnobConfig, st: _Stats) -> tuple[float, float, float]:
     if cfg.pipeline and tokens_local and cfg.pp > 1:
         T = M * V + cfg.pp - 1
         comm += 2.0 * T * (tokens_local / M) * st.hidden * 2.0
+    if cfg.expert > 1 and tokens_local:
+        # MoE dispatch/combine payload (capacity-padded, 4 passes),
+        # narrowed by the moe_a2a wire factor; DCN-placed a2a counts at
+        # the bandwidth-ratio penalty like every cross-slice byte.  The
+        # q/dq passes charge compute — mirrors the cost model's
+        # monotone precision trade so a narrowed candidate and its
+        # fp32 sibling never dominate each other.
+        a2a_f = {None: 1.0, "bf16": 0.5, "int8": 0.25}.get(
+            cfg.collective_precision, 1.0)
+        a2a = 4.0 * 2.0 * cfg.capacity_factor * tokens_local \
+            * st.hidden * 2.0 * (cfg.expert - 1) / cfg.expert * a2a_f
+        comm += a2a * (st.dcn_penalty if cfg.expert_over_dcn else 1.0)
 
     launches = 2.0
     if cfg.zero_stage >= 3:
@@ -434,6 +529,11 @@ def _proxies(cfg: KnobConfig, st: _Stats) -> tuple[float, float, float]:
     if cfg.collective_precision and cfg.tp > 1 and tokens_local:
         compute += 2.0 * V * tokens_local * st.hidden * 1e-10 \
             * (2.0 if ring_kern else 1.0)
+    if cfg.expert > 1 and tokens_local and cfg.collective_precision:
+        # the moe_a2a q/dq passes (the offsetting term of the a2a wire
+        # saving above)
+        compute += 4.0 * 2.0 * cfg.capacity_factor * tokens_local \
+            * st.hidden * 1e-10
     if cfg.pipeline and cfg.pp > 1 and st.tokens:
         bubble = (cfg.pp - 1) / (M * V + cfg.pp - 1)
         model_elems = (st.stage_bytes + st.shared_bytes) / 4.0
@@ -585,6 +685,24 @@ def search_strategies(trainable: Trainable,
                 # A stage-structured trainable lowers through the
                 # pipeline backend only (and a generic one never does);
                 # a seed that cannot lower must not reach the frontier.
+                continue
+            if int(getattr(trainable, "num_experts", 0) or 0) > 1 \
+                    and strategy.graph_config.lowering != "expert":
+                # An expert-sharded loss binds the ``expert`` mesh axis
+                # at trace time; only expert-lowering seeds can run it.
+                continue
+            axes = set(strategy.graph_config.mesh_axes or {})
+            if axes and any(
+                    a and a not in axes
+                    for nc in strategy.node_configs
+                    if nc.partitioner is not None
+                    for entry in (nc.partitioner.spec or [])
+                    for a in (entry if isinstance(entry, (list, tuple))
+                              else [entry])):
+                # A seed whose variable specs name a mesh axis this
+                # topology lacks (e.g. gspmd TensorParallel on a spec
+                # with no model axis) cannot lower here — the same
+                # does-not-fit screen as a build-time ValueError.
                 continue
             if (global_batch is not None
                     and strategy.graph_config.lowering == "pipeline"):

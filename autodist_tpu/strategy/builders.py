@@ -378,6 +378,51 @@ def builder_from_knobs(knobs, *, stage_structured: bool = True
     prec = k.get("collective_precision") or None
     kern = k.get("kernel") or None
 
+    # Expert-parallel family (PR 18): an ``expert`` degree routes the
+    # point onto ExpertParallel before the pipeline/generic resolution
+    # below — the moe_a2a boundary is the ONLY one this lowering emits,
+    # so a bare precision string resolves onto that slot alone and a
+    # "fused" kernel request onto the a2a_ring (each rejected when its
+    # enabling knob is absent, like every other family).
+    expert = int(k.get("expert", 0) or 0)
+    if expert and not stage_structured:
+        from autodist_tpu.strategy.parallel_builders import ExpertParallel
+
+        for knob, value in (("vocab_parallel", vocab_parallel),
+                            ("comm_overlap", comm_overlap),
+                            ("num_microbatches",
+                             int(k.get("num_microbatches", 1) or 1) > 1)):
+            if value:
+                raise ValueError(
+                    f"{knob} has no realization under the expert "
+                    "lowering")
+        over_dcn = bool(k.get("expert_over_dcn", False))
+        precision = None
+        if prec:
+            if expert <= 1:
+                raise ValueError(
+                    f"collective_precision={prec!r} touches no boundary "
+                    "of a degree-1 expert axis (no all_to_all to narrow)")
+            precision = {"moe_a2a": prec}
+        kernel = None
+        if kern:
+            if prec == "int8" and expert > 1 and not over_dcn:
+                kernel = ("a2a_ring",)
+            else:
+                raise ValueError(
+                    f"kernel='fused' enables no kernel for this expert "
+                    f"knob set (expert={expert}, "
+                    f"collective_precision={prec!r}, "
+                    f"expert_over_dcn={over_dcn})")
+        return ExpertParallel(
+            zero_stage=zero_stage or None,
+            compressor=compressor,
+            collective_precision=precision,
+            num_experts=int(k.get("num_experts", 0) or 0) or None,
+            capacity_factor=float(k.get("capacity_factor", 2.0) or 2.0),
+            expert_over_dcn=over_dcn,
+            kernel=kernel)
+
     # Resolve a bare precision string onto only the boundary classes
     # this knob set emits (a full-slot policy on a plan without the
     # matching boundary is the ADT020 silent no-op the linter flags).

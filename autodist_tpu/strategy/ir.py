@@ -183,6 +183,10 @@ PRECISION_BOUNDARIES = (
     # ZeRO-3 on-demand parameter gathers (forward all-gather) and their
     # custom-VJP backward cotangent reduce-scatter.
     "zero3_gather",
+    # MoE dispatch/combine all-to-alls over the expert axis (forward AND
+    # backward; permute-shaped, so the wire narrows like a gather — a
+    # true s8 wire, no level-headroom bit).
+    "moe_a2a",
 )
 
 # Wire bits per precision (telemetry gauges / the report schema gate).

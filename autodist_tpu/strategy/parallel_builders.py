@@ -486,12 +486,50 @@ class ExpertParallel(StrategyBuilder):
                  detect: bool = True, *, zero_stage: int = None,
                  zero1: bool = None,
                  compressor: str = "none", zero_min_bytes=None,
-                 collective_precision=None):
+                 collective_precision=None, num_experts: int = None,
+                 capacity_factor: float = 2.0,
+                 expert_over_dcn: bool = False, kernel=None):
         self.expert_params = tuple(expert_params)
         self.detect = detect
         self.zero_stage = _resolve_zero_stage(zero_stage, zero1)
         self.precision = normalize_precision(collective_precision)
         _check_grad_precision(self.precision, compressor)
+        # MoE shape knobs (PR 18): recorded on the strategy's parallel
+        # slot so the cost model prices the dispatch/combine payload
+        # (capacity-factor scaling, placement level) and the manifest /
+        # drift join can read the elected shape back.
+        self.num_experts = num_experts
+        self.capacity_factor = float(capacity_factor)
+        if self.capacity_factor <= 0:
+            raise ValueError(
+                f"capacity_factor must be > 0, got {capacity_factor}")
+        # Placement: the expert axis stays within a slice unless
+        # explicitly crossed — an across-DCN a2a pays DCN rates every
+        # microbatch and plan lint ADT061 flags it (the search only
+        # emits it to let inverted link constants elect it).
+        self.expert_over_dcn = bool(expert_over_dcn)
+        # Fused-kernel tier: a2a_ring swaps both dispatch/combine
+        # all_to_alls for the fused-q/dq s8 ppermute ring.  Like
+        # quant_ring it needs its enabling knobs (validated here so the
+        # search skips unbuildable combos; lower_expert_ir re-checks the
+        # binding and ADT090 reports hand-edited JSON).
+        self.kernel = normalize_kernel(kernel)
+        for k in self.kernel:
+            if k in ("quant_ring", "collective_matmul"):
+                raise ValueError(
+                    f"kernel {k!r} fuses a tensor-parallel ring; the "
+                    "expert lowering has no tp_psum/matmul boundary — "
+                    "use the Pipeline builder")
+        if "a2a_ring" in self.kernel:
+            if self.precision.get("moe_a2a") != "int8":
+                raise ValueError(
+                    "kernel 'a2a_ring' fuses q/dq into the s8 "
+                    "dispatch/combine ring: it needs "
+                    "collective_precision's moe_a2a slot at 'int8'")
+            if self.expert_over_dcn:
+                raise ValueError(
+                    "kernel 'a2a_ring' is an ICI ring; it cannot span "
+                    "slices — drop expert_over_dcn or the kernel")
         self.make_sync = _default_sync(self.zero_stage, compressor,
                                        zero_min_bytes)
 
@@ -503,6 +541,20 @@ class ExpertParallel(StrategyBuilder):
                 f"spec resolves to {shape} — declare e.g. "
                 "mesh: {expert: ...}")
         E = shape[const.EXPERT_AXIS]
+        if self.num_experts is not None and self.num_experts % E:
+            raise ValueError(
+                f"num_experts={self.num_experts} must divide the "
+                f"{E}-way expert axis (each device holds E/axis experts)")
+        # expert_over_dcn's mesh absorbs the slice dimension INTO the
+        # expert axis (no separate dcn axis) — so the check is against
+        # the topology's slice count, not the mesh.
+        n_slices = max(int(getattr(resource_spec, "num_slices", 1) or 1),
+                       1)
+        if self.expert_over_dcn and n_slices <= 1 \
+                and shape.get(const.DCN_AXIS, 1) <= 1:
+            raise ValueError(
+                "expert_over_dcn declares the expert axis spans slices, "
+                f"but the spec resolves single-slice ({shape})")
         nodes = []
         matched = set()
         for i in trainable.var_infos():
@@ -544,6 +596,13 @@ class ExpertParallel(StrategyBuilder):
                 "expert_params=... or name them with 'expert'/'moe'")
         cfg = self._graph_config(resource_spec)
         cfg.lowering = "expert"
-        cfg.parallel = {}
+        cfg.parallel = {
+            "num_experts": (self.num_experts if self.num_experts
+                            is not None else E),
+            "capacity_factor": self.capacity_factor,
+            "expert_over_dcn": self.expert_over_dcn,
+            "zero_stage": self.zero_stage,
+        }
         cfg.precision = dict(self.precision)
+        cfg.kernel = dict(self.kernel)
         return Strategy(node_configs=nodes, graph_config=cfg)

@@ -114,6 +114,8 @@ def drift_report(strategy=None, cost_model=None,
                                        0.0),
             "dcn_bytes": getattr(predicted, "dcn_bytes", 0.0),
             "dcn_time_s": getattr(predicted, "dcn_time_s", 0.0),
+            "a2a_bytes": getattr(predicted, "a2a_bytes", 0.0),
+            "a2a_time_s": getattr(predicted, "a2a_time_s", 0.0),
         }
 
     comm_s = float(predicted.get("comm_time_s") or 0.0)
@@ -183,6 +185,12 @@ def drift_report(strategy=None, cost_model=None,
         # against measured step time to fit dcn_gbps.
         "comm_time_dcn_s": dcn_s or None,
         "dcn_bytes": pred_dcn_bytes or None,
+        # Expert dispatch/combine all_to_all breakout (already included
+        # in comm_time_s, and in the dcn terms when the expert axis
+        # crosses slices): the share a MoE hardware window joins
+        # against measured step time to fit the a2a_ring constants.
+        "a2a_bytes": float(predicted.get("a2a_bytes") or 0.0) or None,
+        "a2a_time_s": float(predicted.get("a2a_time_s") or 0.0) or None,
         "comm_bytes": predicted.get("comm_bytes"),
         "num_collectives": predicted.get("num_collectives"),
         "feasible": predicted.get("feasible"),
@@ -308,6 +316,9 @@ def drift_report(strategy=None, cost_model=None,
         tel.gauge("comm/wire_bytes_saved").set(pred_wire_saved)
     if pred_dcn_bytes > 0:
         tel.gauge("comm/dcn_bytes").set(pred_dcn_bytes)
+    pred_a2a_bytes = float(predicted.get("a2a_bytes") or 0.0)
+    if pred_a2a_bytes > 0:
+        tel.gauge("comm/a2a_bytes").set(pred_a2a_bytes)
 
     out_dir = out_dir or tel.out_dir
     if out_dir and tel.enabled:

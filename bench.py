@@ -267,7 +267,8 @@ def main():
     # the flash-decode Pallas kernel at the same cache occupancy.
     run = (_bench_serve if "serve" in sys.argv[1:]
            else _bench_quant if "quant" in sys.argv[1:]
-           else _bench_flash if "flash" in sys.argv[1:] else _bench)
+           else _bench_flash if "flash" in sys.argv[1:]
+           else _bench_moe if "moe" in sys.argv[1:] else _bench)
     dog = _Watchdog(2400, "backend init").arm()
     try:
         run(dog)
@@ -521,6 +522,140 @@ def _bench_flash(dog):
     dog.disarm()
     print(json.dumps(record), flush=True)
     telemetry.gauge("bench/flash_decode_speedup").set(ratio)
+    telemetry.flush()
+
+
+def _bench_moe(dog):
+    """`bench.py moe`: fused-vs-composed dispatch/combine step ratio —
+    the measured half of the a2a_ring kernel claim (the interpreter
+    goldens prove the ring numerics, ADT120 proves the s8 ppermute wire
+    is in the program; this puts a wall-clock number on the q/dq-fusion
+    trade).  Both legs run the SAME int8 moe_a2a wire policy so the
+    ratio isolates the kernel (fused in-hop q/dq vs composed
+    quantize→all_to_all→dequantize), and the record carries the cost
+    model's predicted a2a split beside the measurement so a hardware
+    window can recalibrate `"kernel"` (a2a_ring_wire_factor /
+    a2a_ring_qdq_factor) mechanically.  Same provenance-stamped
+    one-line record shape and UNAVAILABLE fresh-process backoff as the
+    other modes."""
+    import jax.numpy as jnp
+    import optax
+
+    from autodist_tpu import AutoDist, telemetry
+    from autodist_tpu.models.moe_transformer import (MoeConfig,
+                                                     make_moe_lm_trainable)
+    from autodist_tpu.resource import ResourceSpec
+    from autodist_tpu.simulator.cost_model import CostModel
+
+    on_accel = jax.default_backend() != "cpu"
+    rs = ResourceSpec({})
+    n = rs.num_devices()
+    if on_accel:
+        cfg = MoeConfig(vocab_size=32768, hidden_size=1024,
+                        num_layers=2, num_heads=16, expert_hidden=4096,
+                        num_experts=8, max_len=512, dtype=jnp.bfloat16)
+        per_dev, steps = 2, 20
+    else:  # CPU dev smoke: same code path, toy size (interpret mode)
+        cfg = MoeConfig(vocab_size=128, hidden_size=32, num_layers=1,
+                        num_heads=2, expert_hidden=64, num_experts=4,
+                        max_len=16, dtype=jnp.float32)
+        per_dev, steps = 1, 3
+    # The largest expert-axis degree this host supports: divides both
+    # the device count and the expert count (the ring kernel needs >= 2
+    # ranks to put anything on the wire).
+    expert = max((d for d in range(1, n + 1)
+                  if n % d == 0 and cfg.num_experts % d == 0),
+                 default=1)
+    if expert < 2:
+        dog.disarm()
+        print(json.dumps({
+            "metric": "moe_a2a_ring_speedup", "value": 0.0,
+            "unit": "ratio", "vs_baseline": 0.0, "skipped": True,
+            "error": f"need an expert axis >= 2 ({n} device(s), "
+                     f"{cfg.num_experts} experts)",
+            "provenance": _provenance()}))
+        return
+    dp = n // expert
+    spec = {"topology": {"num_devices": n},
+            "mesh": ({"data": dp, "expert": expert} if dp > 1
+                     else {"expert": expert})}
+    # The batch dim shards over data x expert, so it must divide the
+    # full device count.
+    batch = per_dev * n
+    telemetry.annotate(bench="moe_a2a_ring_speedup", devices=n,
+                       chip=rs.chip.name, kernel=["a2a_ring"])
+    r = np.random.RandomState(0)
+    b = {"x": r.randint(0, cfg.vocab_size, (batch, cfg.max_len))
+         .astype(np.int32),
+         "y": r.randint(0, cfg.vocab_size, (batch, cfg.max_len))
+         .astype(np.int32)}
+
+    def timed(kernel):
+        trainable = make_moe_lm_trainable(
+            cfg, optax.adam(1e-3), jax.random.PRNGKey(0),
+            batch_size=batch, seq_len=cfg.max_len)
+        ad = AutoDist(spec, "ExpertParallel",
+                      num_experts=cfg.num_experts,
+                      capacity_factor=cfg.capacity_factor,
+                      collective_precision={"moe_a2a": "int8"},
+                      kernel=kernel)
+        strategy = ad.build_or_load_strategy(trainable)
+        runner = ad.build(trainable, strategy)
+        try:
+            float(np.asarray(runner.step(b)["loss"]))     # compile+warm
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                metrics = runner.step(b)
+            float(np.asarray(metrics["loss"]))
+            dt = (time.perf_counter() - t0) / steps
+        finally:
+            runner.close()
+        cost = CostModel(ResourceSpec(spec)).strategy_cost(trainable,
+                                                           strategy)
+        return dt, cost
+
+    dog.stage = f"moe bench composed a2a (ex{expert}/dp{dp}: " \
+                "build+compile+steps)"
+    try:
+        dt_composed, cost_c = timed(None)
+        dog.stage = f"moe bench fused a2a_ring (ex{expert}/dp{dp}: " \
+                    "build+compile+steps)"
+        dt_ring, cost_r = timed(("a2a_ring",))
+    except Exception as e:
+        dog.disarm()
+        if "UNAVAILABLE" in str(e) or "Connection" in str(e):
+            _unavailable_exit(f"transport: {e}")
+        print(json.dumps({
+            "metric": "moe_a2a_ring_speedup", "value": 0.0,
+            "unit": "ratio", "vs_baseline": 0.0,
+            "error": f"moe bench failed: {e}",
+            "provenance": _provenance()}))
+        sys.exit(4)
+    ratio = dt_composed / dt_ring if dt_ring > 0 else 0.0
+    kp = CostModel(rs).kernel_profile
+    record = {
+        "metric": "moe_a2a_ring_speedup",
+        "value": round(ratio, 4), "unit": "ratio",
+        "vs_baseline": round(ratio, 4), "devices": n,
+        "chip": rs.chip.name, "expert_axis": expert, "dp": dp,
+        "num_experts": cfg.num_experts,
+        "capacity_factor": cfg.capacity_factor,
+        "batch": batch, "steps": steps,
+        "step_ms_composed": round(dt_composed * 1e3, 3),
+        "step_ms_ring": round(dt_ring * 1e3, 3),
+        "predicted_a2a_ms_composed": round(cost_c.a2a_time_s * 1e3, 4),
+        "predicted_a2a_ms_ring": round(cost_r.a2a_time_s * 1e3, 4),
+        "predicted_a2a_bytes_composed": round(cost_c.a2a_bytes, 1),
+        "predicted_a2a_bytes_ring": round(cost_r.a2a_bytes, 1),
+        "a2a_ring_wire_factor": kp["a2a_ring_wire_factor"],
+        "a2a_ring_qdq_factor": kp["a2a_ring_qdq_factor"],
+        "measured_favors_ring": ratio > 1.0,
+        "predicted_favors_ring": cost_r.a2a_time_s < cost_c.a2a_time_s,
+        "scored": True, "provenance": _provenance(),
+    }
+    dog.disarm()
+    print(json.dumps(record), flush=True)
+    telemetry.gauge("bench/moe_a2a_ring_speedup").set(ratio)
     telemetry.flush()
 
 

@@ -1,0 +1,196 @@
+"""The fused quantized dispatch/combine ring (PR 18).
+
+Interpreter-mode goldens for ``kernel/pallas/a2a_ring.py`` against its
+arithmetic mirror (bit-exact: per-chunk scales, own chunk never on the
+wire) and the exact fp32 ``lax.all_to_all`` (one int8 rounding per
+off-device chunk), across edge shapes: one row per peer (split dim ==
+ring size), non-dividing split dims rejected loudly, the backward
+riding the transposed ring, and GShard capacity-overflow drops staying
+exact zeros through the quantized hops.
+
+Kernel modules are imported inside tests (conftest guard: Pallas
+modules are never top-level imports in a tier-1 module); shapes stay
+tiny so the interpreter runs in seconds.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+pytestmark = pytest.mark.slow
+
+
+def _ring_fn(n, split_axis=0, concat_axis=0, grad=False):
+    from autodist_tpu.kernel.pallas.a2a_ring import (
+        quantized_ring_all_to_all, ring_dispatch)
+
+    mesh = Mesh(np.array(jax.devices()[:n]), ("expert",))
+    if grad:
+        def run(x, ct):
+            y, vjp = jax.vjp(
+                lambda a: ring_dispatch(a, "expert", split_axis,
+                                        concat_axis), x)
+            (gx,) = vjp(ct)
+            return y, gx
+        return jax.jit(jax.shard_map(
+            run, mesh=mesh, in_specs=(P("expert"), P("expert")),
+            out_specs=(P("expert"), P("expert")), check_vma=False))
+    return jax.jit(jax.shard_map(
+        lambda x: quantized_ring_all_to_all(
+            x, "expert", split_axis=split_axis, concat_axis=concat_axis),
+        mesh=mesh, in_specs=P("expert"), out_specs=P("expert"),
+        check_vma=False))
+
+
+@pytest.mark.parametrize("n,rows,cols", [(2, 4, 16), (4, 8, 5),
+                                         (4, 4, 16)])
+def test_a2a_ring_matches_reference(n, rows, cols):
+    """Bit-exact vs the host mirror — per-chunk abs-max scales, the own
+    chunk exact — including the one-row-per-peer edge (rows == n)."""
+    from autodist_tpu.kernel.pallas.a2a_ring import reference_ring_all_to_all
+
+    r = np.random.RandomState(0)
+    shards = [jnp.asarray(r.randn(rows, cols), jnp.float32)
+              for _ in range(n)]
+    got = _ring_fn(n)(jnp.concatenate(shards, 0))
+    refs = reference_ring_all_to_all(shards, split_axis=0, concat_axis=0)
+    for i in range(n):
+        np.testing.assert_array_equal(
+            np.asarray(got[i * rows:(i + 1) * rows]), np.asarray(refs[i]))
+
+
+def test_a2a_ring_within_int8_of_exact():
+    """One int8 rounding per off-device chunk vs the exact all_to_all;
+    the own chunk agrees exactly."""
+    n, rows, cols = 4, 8, 16
+    r = np.random.RandomState(1)
+    shards = [jnp.asarray(r.randn(rows, cols), jnp.float32)
+              for _ in range(n)]
+    x = jnp.concatenate(shards, 0)
+    got = np.asarray(_ring_fn(n)(x))
+
+    mesh = Mesh(np.array(jax.devices()[:n]), ("expert",))
+    exact = np.asarray(jax.jit(jax.shard_map(
+        lambda a: jax.lax.all_to_all(a, "expert", 0, 0, tiled=True),
+        mesh=mesh, in_specs=P("expert"), out_specs=P("expert"),
+        check_vma=False))(x))
+    per_chunk = rows // n
+    for dev in range(n):
+        blk = slice(dev * rows, (dev + 1) * rows)
+        for src in range(n):
+            sub = slice(dev * rows + src * per_chunk,
+                        dev * rows + (src + 1) * per_chunk)
+            chunk = exact[sub]
+            tol = 0.0 if src == dev \
+                else float(np.abs(chunk).max()) / 127.0 + 1e-7
+            np.testing.assert_allclose(got[sub], chunk, atol=tol)
+        assert np.abs(got[blk] - exact[blk]).max() > 0  # wire was s8
+
+
+def test_a2a_ring_rejects_non_dividing_split():
+    """A split dim the ring size doesn't divide fails loudly at trace
+    time, not with silent truncation."""
+    r = np.random.RandomState(2)
+    x = jnp.asarray(r.randn(4 * 6, 8), jnp.float32)  # 6 rows/dev, n=4
+    with pytest.raises(ValueError, match="must divide the 4-way"):
+        _ring_fn(4)(x)
+
+
+def test_ring_dispatch_backward_is_transposed_ring():
+    """The custom-vjp backward is the ring with split/concat swapped —
+    bit-exact vs the host mirror of the transposed exchange."""
+    from autodist_tpu.kernel.pallas.a2a_ring import reference_ring_all_to_all
+
+    n, rows, cols = 4, 8, 6
+    r = np.random.RandomState(3)
+    x_shards = [jnp.asarray(r.randn(rows, cols), jnp.float32)
+                for _ in range(n)]
+    # forward: split 0, concat 1 -> per-device (rows/n, n*cols);
+    # cotangent rides the ring back with the axes swapped.
+    ct_shards = [jnp.asarray(r.randn(rows // n, n * cols), jnp.float32)
+                 for _ in range(n)]
+    y, gx = _ring_fn(n, split_axis=0, concat_axis=1, grad=True)(
+        jnp.concatenate(x_shards, 0), jnp.concatenate(ct_shards, 0))
+
+    y_ref = reference_ring_all_to_all(x_shards, split_axis=0,
+                                      concat_axis=1)
+    gx_ref = reference_ring_all_to_all(ct_shards, split_axis=1,
+                                       concat_axis=0)
+    pr, gr = rows // n, rows
+    for i in range(n):
+        np.testing.assert_array_equal(
+            np.asarray(y[i * pr:(i + 1) * pr]), np.asarray(y_ref[i]))
+        np.testing.assert_array_equal(
+            np.asarray(gx[i * gr:(i + 1) * gr]), np.asarray(gx_ref[i]))
+
+
+def test_a2a_ring_capacity_overflow_drops_stay_exact_zero():
+    """GShard overflow drops ride THROUGH the quantized ring unchanged:
+    routing is decided in fp32 before the wire, so the kernel path drops
+    exactly the tokens the dense reference drops, and a fully-dropped
+    token's output row stays exactly zero (zero blocks quantize to
+    exact zeros through the scale floor)."""
+    from autodist_tpu.parallel.moe import (dense_moe_reference,
+                                           expert_parallel_ffn)
+
+    n, G, E, M, H = 4, 8, 4, 16, 32
+    r = np.random.RandomState(4)
+    # Adversarial gate: every token's top-2 is experts {0, 1} (tokens
+    # carry a constant first feature), so capacity 4 < G drops the
+    # overflow outright.
+    gate_w = jnp.asarray(r.randn(M, E) * 0.01, jnp.float32)
+    gate_w = gate_w.at[0, 0].set(10.0).at[0, 1].set(5.0)
+    wi = jnp.asarray(r.randn(E, M, H) * 0.2, jnp.float32)
+    wo = jnp.asarray(r.randn(E, H, M) * 0.2, jnp.float32)
+    tokens = jnp.asarray(r.randn(n * G, M), jnp.float32)
+    tokens = tokens.at[:, 0].set(1.0)
+
+    mesh = Mesh(np.array(jax.devices()[:n]), ("expert",))
+    fn = jax.jit(jax.shard_map(
+        lambda t, g, a, b: expert_parallel_ffn(
+            t, g, a, b, capacity_factor=1.0, a2a_precision="int8",
+            a2a_kernel=True)[0],
+        mesh=mesh,
+        in_specs=(P("expert"), P(), P("expert"), P("expert")),
+        out_specs=P("expert"), check_vma=False))
+    out = np.asarray(fn(tokens, gate_w, wi, wo))
+
+    capacity = max(int(np.ceil(2 * G * 1.0 / E)), 4)
+    assert capacity < G  # the overflow is real
+    dropped_any = False
+    for p in range(n):
+        shard = tokens[p * G:(p + 1) * G]
+        ref = np.asarray(dense_moe_reference(shard, gate_w, wi, wo,
+                                             capacity)[0])
+        got = out[p * G:(p + 1) * G]
+        dropped = ~np.any(ref != 0.0, axis=1)
+        dropped_any |= bool(dropped.any())
+        # dropped rows: exact zeros on BOTH paths; surviving rows:
+        # within the quantized wire's tolerance of the fp32 reference.
+        np.testing.assert_array_equal(got[dropped], 0.0)
+        scale = np.abs(ref).max()
+        np.testing.assert_allclose(got[~dropped], ref[~dropped],
+                                   atol=0.05 * scale)
+    assert dropped_any
+
+
+def test_expert_count_must_divide_axis():
+    """num_experts % expert-axis != 0 is rejected at build time with the
+    shape in the message, not lowered into a ragged shard."""
+    import optax
+
+    from autodist_tpu import AutoDist
+    from autodist_tpu.models.moe_transformer import (MoeConfig,
+                                                     make_moe_lm_trainable)
+
+    cfg = MoeConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                    num_heads=2, expert_hidden=32, num_experts=6,
+                    max_len=8, dtype=jnp.float32)
+    tr = make_moe_lm_trainable(cfg, optax.adam(1e-2),
+                               jax.random.PRNGKey(0), batch_size=4,
+                               seq_len=8)
+    spec = {"topology": {"platform": "cpu", "num_devices": 4},
+            "mesh": {"expert": 4}}
+    with pytest.raises(ValueError, match="num_experts=6 must divide"):
+        AutoDist(spec, "ExpertParallel", num_experts=6).build(tr)

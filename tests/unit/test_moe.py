@@ -1,6 +1,8 @@
 """MoE expert parallelism: the all_to_all dispatch must compute exactly
 what the single-device dense reference computes per token group, and the
 layer must train."""
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -114,3 +116,95 @@ def test_moe_trains():
         losses.append(float(l))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+# --------------------------------------------------------------------------- #
+# End-to-end trajectory goldens: the expert-parallel LM vs the dense
+# single-device reference, across the strategy grid (PR 18).
+# --------------------------------------------------------------------------- #
+STEPS = 4
+
+
+def _moe_cfg():
+    from autodist_tpu.models.moe_transformer import MoeConfig
+
+    # capacity_factor 4.0 gives every top-2 route a slot at this token
+    # count, so sharded-vs-dense routing parity is exact and the only
+    # trajectory deviations are collective arithmetic (wire precision,
+    # per-shard aux-loss averaging) — measured max |dnll| <= 4.5e-4
+    # across the whole grid, 10x inside the tolerance below.
+    return MoeConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                     num_heads=2, expert_hidden=32, num_experts=4,
+                     capacity_factor=4.0, max_len=8, dtype=jnp.float32)
+
+
+def _moe_trajectory(runner):
+    r = np.random.RandomState(0)
+    nlls = []
+    try:
+        for _ in range(STEPS):
+            x = r.randint(0, 64, (8, 8)).astype(np.int32)
+            m = runner.step({"x": x, "y": np.roll(x, -1, axis=1)})
+            nlls.append(float(np.asarray(m["nll"])))
+    finally:
+        runner.close()
+    return nlls
+
+
+def _moe_trainable(expert_sharded):
+    from autodist_tpu.models.moe_transformer import make_moe_lm_trainable
+
+    return make_moe_lm_trainable(_moe_cfg(), optax.adam(1e-2),
+                                 jax.random.PRNGKey(0), batch_size=8,
+                                 seq_len=8, expert_sharded=expert_sharded)
+
+
+@functools.lru_cache(maxsize=1)
+def _dense_reference_nlls():
+    from autodist_tpu import AutoDist
+
+    runner = AutoDist({"topology": {"platform": "cpu",
+                                    "num_devices": 1}},
+                      "AllReduce").build(_moe_trainable(False))
+    return tuple(_moe_trajectory(runner))
+
+
+@pytest.mark.parametrize("expert,zero_stage,precision", [
+    (2, 1, None), (2, 3, None), (4, 1, None), (4, 3, None),
+    (2, 1, "int8"), (2, 3, "int8"), (4, 1, "int8"), (4, 3, "int8"),
+])
+def test_moe_lm_trajectory_matches_dense(expert, zero_stage, precision):
+    """The sharded LM's nll trajectory tracks the dense single-device
+    reference across expert-degree x ZeRO x wire-precision — the
+    all_to_all round trip, the local-expert grads, and the quantized
+    wire must all preserve training semantics."""
+    from autodist_tpu import AutoDist
+
+    mesh = {"expert": expert} if expert == 4 \
+        else {"data": 4 // expert, "expert": expert}
+    runner = AutoDist(
+        {"topology": {"platform": "cpu", "num_devices": 4},
+         "mesh": mesh},
+        "ExpertParallel", zero_stage=zero_stage, num_experts=4,
+        capacity_factor=4.0,
+        collective_precision=({"moe_a2a": precision} if precision
+                              else None)).build(_moe_trainable(True))
+    nlls = _moe_trajectory(runner)
+    ref = _dense_reference_nlls()
+    assert np.isfinite(nlls).all()
+    np.testing.assert_allclose(nlls, ref, atol=5e-3)
+
+
+def test_moe_lm_trajectory_with_a2a_ring_kernel():
+    """The fused-ring wire (per-chunk scales, s8 ppermute hops) stays
+    inside the same trajectory envelope as the composed int8 sandwich."""
+    from autodist_tpu import AutoDist
+
+    runner = AutoDist(
+        {"topology": {"platform": "cpu", "num_devices": 4},
+         "mesh": {"expert": 4}},
+        "ExpertParallel", zero_stage=1, num_experts=4,
+        capacity_factor=4.0, collective_precision={"moe_a2a": "int8"},
+        kernel=("a2a_ring",)).build(_moe_trainable(True))
+    nlls = _moe_trajectory(runner)
+    np.testing.assert_allclose(nlls, _dense_reference_nlls(), atol=5e-3)

@@ -1,0 +1,111 @@
+"""Expert parallelism as a search citizen (PR 18): election pins.
+
+The two-slice search over the MoE trainable must (a) elect the expert
+lowering over its own dense point on the merits of the priced a2a
+term, (b) keep the expert axis within a slice under default link
+constants and deliberately cross DCN only when inverted constants make
+the a2a cheaper there (ADT061 stays a WARNING so the candidate is
+electable), and (c) elect the fused a2a_ring kernel exactly when the
+calibratable kernel constants favor it — both directions pinned, so a
+constant regression in either the pricing or the candidate family
+breaks a test, not silently the election.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from autodist_tpu import AutoDist
+from autodist_tpu.models.moe_transformer import (MoeConfig,
+                                                 make_moe_lm_trainable)
+from autodist_tpu.resource import ResourceSpec
+from autodist_tpu.simulator.search import search_strategies
+
+pytestmark = pytest.mark.slow
+
+VOCAB = 32
+
+
+def make_moe_lm():
+    cfg = MoeConfig(vocab_size=VOCAB, hidden_size=16, num_layers=1,
+                    num_heads=4, expert_hidden=32, num_experts=8,
+                    max_len=8, dtype=jnp.float32)
+    return make_moe_lm_trainable(cfg, optax.adam(1e-3),
+                                 jax.random.PRNGKey(0), batch_size=4,
+                                 seq_len=8)
+
+
+def two_slice_spec():
+    return ResourceSpec({"topology": {"platform": "cpu",
+                                      "num_devices": 8,
+                                      "num_slices": 2}})
+
+
+def _search(**cost_model_kwargs):
+    return search_strategies(make_moe_lm(), two_slice_spec(),
+                             global_batch=8, **cost_model_kwargs)
+
+
+def test_moe_search_elects_expert_within_slice_with_ring():
+    """Default constants: the MoE point beats its own dense sibling on
+    the priced a2a term, the expert axis stays within a slice, the wire
+    is int8, and the fused ring is elected (launches at the fused alpha
+    + halved q/dq beat the composed sandwich)."""
+    res = _search()
+    win = res.winner
+    assert win is not None and win.config is not None
+    assert win.config.expert > 1            # MoE beat the dense point
+    assert not win.config.expert_over_dcn   # a2a stays on ICI
+    assert win.config.collective_precision == "int8"
+    assert win.config.kernel == "fused"     # a2a_ring elected
+    assert "a2a_ring" in (win.strategy.graph_config.kernel or {})
+    # the election was real: the frontier priced dense siblings too,
+    # and the a2a term is broken out on the winner's cost.
+    assert any(c.config is not None and c.config.expert == 1
+               for c in res.frontier)
+    assert win.cost.a2a_bytes > 0
+    assert win.cost.a2a_time_s > 0
+
+
+def test_moe_search_inverted_links_elect_expert_over_dcn():
+    """Pathological links (starved ICI, abundant low-alpha DCN) flip
+    the placement: the expert axis deliberately spans slices — the
+    candidate must survive its ADT061 WARNING to be electable."""
+    res = _search(link_profile={"ici_gbps": 0.05, "dcn_gbps": 500.0,
+                                "dcn_alpha_s": 1e-7})
+    win = res.winner
+    assert win is not None and win.config is not None
+    assert win.config.expert > 1
+    assert win.config.expert_over_dcn
+
+
+def test_moe_search_unfavorable_kernel_constants_keep_composed():
+    """Calibrated constants that price the fused hops slow and the
+    in-hop q/dq expensive un-elect the ring: the winner keeps the int8
+    wire but through the composed quantize->all_to_all->dequantize."""
+    res = _search(kernel_profile={"fused_hop_alpha_s": 1e-4,
+                                  "a2a_ring_qdq_factor": 4.0})
+    win = res.winner
+    assert win is not None and win.config is not None
+    assert win.config.expert > 1
+    assert not win.config.expert_over_dcn
+    assert win.config.collective_precision == "int8"
+    assert win.config.kernel is None
+    assert "a2a_ring" not in (win.strategy.graph_config.kernel or {})
+
+
+def test_moe_search_winner_lowers_and_trains():
+    """The elected strategy is not just priceable — it builds on its
+    own re-factored spec and takes a finite training step."""
+    res = _search()
+    win = res.winner
+    runner = AutoDist(win.spec, "AllReduce").build(make_moe_lm(),
+                                                   win.strategy)
+    try:
+        r = np.random.RandomState(0)
+        x = r.randint(0, VOCAB, (8, 8)).astype(np.int32)
+        m = runner.step({"x": x, "y": np.roll(x, -1, axis=1)})
+        assert np.isfinite(float(np.asarray(m["loss"])))
+    finally:
+        runner.close()

@@ -305,6 +305,76 @@ def lint_zoo(max_programs=None, plan_only=False, decode=True,
                             "plan": [d.to_dict() for d in plan],
                             "program": [d.to_dict() for d in prog],
                             "program_rules": [r.name for r in rules]})
+        # MoE expert-parallel candidates: the composed wire ladder
+        # (fp32, int8 moe_a2a) plus the a2a_ring-elected program whose
+        # ADT120 proof is the fused s8 dispatch/combine ring replacing
+        # the monolithic all-to-alls.  Shares the memoized moe corpus
+        # with the mutation matrix.
+        import jax as _jax
+        import jax.numpy as _jnp
+        import optax as _optax
+
+        from autodist_tpu.models.moe_transformer import (
+            MoeConfig, make_moe_lm_trainable)
+        from autodist_tpu.resource import ResourceSpec
+        from autodist_tpu.strategy.parallel_builders import ExpertParallel
+
+        moe_spec = ResourceSpec({"topology": {"platform": "cpu",
+                                              "num_devices": 4},
+                                 "mesh": {"data": 2, "expert": 2}})
+        moe_cfg = MoeConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                            num_heads=2, expert_hidden=32,
+                            num_experts=4, max_len=8,
+                            dtype=_jnp.float32)
+        moe_trainable = make_moe_lm_trainable(
+            moe_cfg, _optax.sgd(0.05), _jax.random.PRNGKey(0),
+            batch_size=4, seq_len=8)
+        moe_cases = [
+            ("moe/fp32", dict(), (None, None)),
+            ("moe/int8",
+             dict(collective_precision={"moe_a2a": "int8"}),
+             ((("moe_a2a", "int8"),), None)),
+            ("moe/int8+a2a_ring",
+             dict(collective_precision={"moe_a2a": "int8"},
+                  kernel=("a2a_ring",)),
+             ((("moe_a2a", "int8"),), ("a2a_ring",))),
+        ]
+        for name, bkw, (prec_key, kern_key) in moe_cases:
+            if max_programs is not None and compiled >= max_programs:
+                out(f"{name}: SKIPPED (--max-programs budget)")
+                results.append({"candidate": name,
+                                "program": "skipped (--max-programs "
+                                           "budget)"})
+                continue
+            compiled += 1
+            strategy = ExpertParallel(num_experts=4, **bkw).build(
+                moe_trainable, moe_spec)
+            plan = lint_plan(strategy, resource_spec=moe_spec,
+                             trainable=moe_trainable)
+            n_err += len(plan.errors)
+            n_warn += len(plan.warnings)
+            try:
+                text = programs.moe_step_text(2, prec_key, kern_key)
+            except Exception as e:
+                n_err += 1
+                out(f"{name}: FAILED to lower/compile — {e}")
+                results.append({"candidate": name,
+                                "plan": [d.to_dict() for d in plan],
+                                "program_error":
+                                    f"{type(e).__name__}: {e}"})
+                continue
+            rules = _rfs(strategy)
+            prog = lint_program(text, rules, where=name)
+            n_err += len(prog.errors)
+            n_warn += len(prog.warnings)
+            out(f"{name}: plan {len(plan.errors)}E/"
+                f"{len(plan.warnings)}W, program {len(prog.errors)}E"
+                f" ({len(rules)} rules)")
+            results.append({"candidate": name,
+                            "plan": [d.to_dict() for d in plan],
+                            "program": [d.to_dict() for d in prog],
+                            "program_rules": [r.name for r in rules]})
+
         flash_cases = [("kernel/flash_decode", "dense")]
         if paged:
             # The paged-cache flash decode: ADT120's marker proof plus
@@ -356,6 +426,35 @@ def _search_fixtures():
                                               "num_devices": 8,
                                               "num_slices": 2}}),
                    batch)
+
+    # MoE on a two-slice topology: the search synthesizes the expert
+    # family (dense point, within-slice and across-DCN placements, the
+    # moe_a2a wire ladder, the a2a_ring kernel election) — this fixture
+    # gates that none of it is unlintable and the hierarchical a2a
+    # pricing elects a winner.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from autodist_tpu.models.moe_transformer import (MoeConfig,
+                                                     make_moe_lm_trainable)
+
+    # num_heads=4: the tp knob family sweeps divisors of the 4-way ICI
+    # degree, and the head axis must divide every swept tp.
+    moe_cfg = MoeConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                        num_heads=4, expert_hidden=32, num_experts=8,
+                        max_len=8, dtype=jnp.float32)
+    moe_trainable = make_moe_lm_trainable(moe_cfg, optax.sgd(0.05),
+                                          jax.random.PRNGKey(0),
+                                          batch_size=4, seq_len=8)
+    r = np.random.RandomState(0)
+    x = r.randint(0, 32, (8, 8)).astype(np.int32)
+    yield ("moe_lm@2slice", moe_trainable,
+           ResourceSpec({"topology": {"platform": "cpu",
+                                      "num_devices": 8,
+                                      "num_slices": 2}}),
+           {"x": x, "y": np.roll(x, -1, axis=1)})
 
 
 def lint_search(plan_only=False, out=print, top=10) -> tuple[int, int,

@@ -33,7 +33,7 @@ _PRECISION_BITS = {"fp32": 32, "bf16": 16, "int8": 8}
 # for flash_decode); a manifest run.kernel annotation without its gauge
 # means the election was silently dropped — --check fails it.
 _KERNEL_CHOICES = ("flash_decode", "flash_prefill", "quant_ring",
-                   "collective_matmul")
+                   "collective_matmul", "a2a_ring")
 # Per-request serving records (autodist_tpu/serving/batcher.py): the
 # latency facts the serving section aggregates.  The PR-16 throughput-
 # ladder fields are REQUIRED: every completion reports its prefix hit
@@ -435,6 +435,37 @@ def check_schema(run_dir: str) -> list[str]:
                     "drift.json: predicted.comm_time_dcn_s without "
                     "predicted.dcn_bytes — per-level comm terms out "
                     "of sync")
+            # Expert dispatch/combine breakout comes paired the same
+            # way, and an expert-parallel run (manifest run.moe with a
+            # >1 expert axis) must carry it plus the comm/a2a_bytes
+            # gauge — their absence means the cost model priced the
+            # MoE plan with no a2a term at all.
+            if pred.get("a2a_time_s") and not pred.get("a2a_bytes"):
+                problems.append(
+                    "drift.json: predicted.a2a_time_s without "
+                    "predicted.a2a_bytes — a2a breakout terms out "
+                    "of sync")
+            moe_ann = None
+            if os.path.exists(manifest):
+                try:
+                    with open(manifest) as f:
+                        moe_ann = (json.load(f).get("run") or {}).get(
+                            "moe")
+                except ValueError:
+                    pass
+            if (isinstance(moe_ann, dict)
+                    and int(moe_ann.get("expert_axis", 1) or 1) > 1):
+                if not pred.get("a2a_bytes"):
+                    problems.append(
+                        "manifest run.moe declares an expert axis > 1 "
+                        "but drift.json predicted.a2a_bytes is "
+                        "missing — the dispatch/combine term was "
+                        "never priced")
+                elif "comm/a2a_bytes" not in gauges:
+                    problems.append(
+                        "manifest run.moe declares an expert axis > 1 "
+                        "but metrics.jsonl has no comm/a2a_bytes "
+                        "gauge — the a2a breakout was never emitted")
         except ValueError as e:
             problems.append(f"drift.json: invalid ({e})")
     return problems
