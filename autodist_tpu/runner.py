@@ -237,7 +237,7 @@ class DistributedRunner:
     MAX_STEP_TIMES = 100000
 
     def run(self, data: Iterable, num_steps: Optional[int] = None,
-            log_every: int = 0):
+            log_every: int = 0, drift_monitor=None):
         """Drive ``num_steps`` steps from an iterable of host batches.
 
         Every step blocks on its metrics and its wall time is recorded
@@ -246,6 +246,12 @@ class DistributedRunner:
         host/device overlap.  Throughput-critical loops should use
         :meth:`run_steps` / ``fit(steps_per_loop=k)``, which keep
         dispatch fused and async.
+
+        ``drift_monitor`` (a :class:`telemetry.DriftMonitor`) opts the
+        loop into ONLINE drift detection: every step's wall time feeds
+        the monitor, which gauges ``drift/<term>_ratio`` and emits a
+        ``kind="drift"`` record when measured/predicted crosses its
+        threshold — the live half of the post-hoc ``drift_report``.
         """
         metrics = {}
         it = iter(data)
@@ -268,6 +274,8 @@ class DistributedRunner:
             self._run_examples += bsz
             telemetry.record_step(step=self._host_step - 1, duration_s=dt,
                                   examples=bsz or None)
+            if drift_monitor is not None:
+                drift_monitor.observe_step(self._host_step - 1, dt)
             if log_every and (i + 1) % log_every == 0:
                 logging.info("step %d %s (%.1f ms/step)",
                              int(self.state["step"]),

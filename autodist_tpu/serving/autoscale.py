@@ -38,10 +38,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from collections import deque
 from typing import Optional
-
-import numpy as np
 
 from autodist_tpu import telemetry
 
@@ -87,8 +84,12 @@ class Autoscaler:
         self.fleet = router.fleet
         self.config = config or AutoscaleConfig()
         self._clock = clock
-        self._ttfts: deque = deque(maxlen=self.config.ttft_window)
-        self._seen_completions: set = set()
+        # A VIEW over the router aggregator's shared TTFT window — the
+        # router pushes every completion at ``_complete``, so the
+        # autoscaler's trigger and the ``slo/ttft_p99_ms`` gauge read
+        # the identical numbers (no second private deque to drift).
+        self._window = router.aggregator.window("ttft_ms").resize(
+            self.config.ttft_window)
         self._last_scale_s: Optional[float] = None
         self.events: list = []     # every transition, for callers/tests
 
@@ -104,15 +105,10 @@ class Autoscaler:
         return load / max(len(admitting), 1)
 
     def ttft_p99_ms(self) -> float:
-        """p99 TTFT over the recent completion window (0 until the
-        first completion lands — an empty fleet is not slow)."""
-        for rid, comp in self.router.completions.items():
-            if rid not in self._seen_completions:
-                self._seen_completions.add(rid)
-                self._ttfts.append(comp.ttft_s * 1e3)
-        if not self._ttfts:
-            return 0.0
-        return float(np.percentile(np.asarray(self._ttfts), 99))
+        """p99 TTFT over the shared recent-completion window (0 until
+        the first completion lands — an empty fleet is not slow)."""
+        p99 = self._window.percentile(99)
+        return 0.0 if p99 is None else p99
 
     # ---- the control step -------------------------------------------- #
     def step(self, now: Optional[float] = None) -> Optional[dict]:

@@ -74,6 +74,10 @@ class Request:
     # interleave-parity contract extended to sampling).  Ignored by
     # greedy engines.
     seed: int = 0
+    # Distributed-trace id minted at the fleet edge (Router.submit) and
+    # carried through every record/span this request touches — None for
+    # untraced standalone use.
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -95,6 +99,7 @@ class Completion:
     spec_proposed: int = 0
     spec_accepted: int = 0
     prefill_chunks: int = 1
+    trace_id: Optional[str] = None
 
     @property
     def tokens_per_sec(self) -> Optional[float]:
@@ -137,7 +142,8 @@ class ContinuousBatcher:
     # ------------------------------------------------------------------ #
     def submit(self, prompt, *, max_new_tokens: int = 16,
                eos_id: Optional[int] = None, rid: Optional[str] = None,
-               deadline_s: Optional[float] = None, seed: int = 0) -> str:
+               deadline_s: Optional[float] = None, seed: int = 0,
+               trace_id: Optional[str] = None) -> str:
         """Queue one request; returns its id.  Prompts must fit the
         engine's prompt bucket; a budget exceeding the cache capacity
         is accepted but the request truncates at capacity
@@ -150,7 +156,11 @@ class ContinuousBatcher:
         of silently burning slot time nobody is waiting for.
 
         ``seed`` keys this request's sampled stream on a
-        temperature > 0 engine (greedy engines ignore it)."""
+        temperature > 0 engine (greedy engines ignore it).
+
+        ``trace_id`` tags the request's records and spans with a
+        distributed-trace id (defaults to the ambient trace context
+        when one is active)."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -179,12 +189,14 @@ class ContinuousBatcher:
                 f"({len(self._queue)}/{self.max_queue}); backing off "
                 "and resubmitting is the caller's move")
         rid = rid if rid is not None else f"req-{next(self._ids)}"
+        if trace_id is None:
+            trace_id = telemetry.current_trace_id()
         now = time.perf_counter()
         self._queue.append(Request(
             rid=rid, prompt=prompt, max_new_tokens=int(max_new_tokens),
             eos_id=eos_id, submit_s=now,
             deadline_s=now + deadline_s if deadline_s is not None
-            else None, seed=int(seed)))
+            else None, seed=int(seed), trace_id=trace_id))
         telemetry.gauge("serve/queue_depth").set(len(self._queue))
         return rid
 
@@ -309,8 +321,10 @@ class ContinuousBatcher:
         if not taken:
             return
         now = time.perf_counter()
+        tids = [req.trace_id for _, req, _ in taken if req.trace_id]
         try:
-            with telemetry.span("serve/prefill", admitted=len(taken)):
+            with telemetry.span("serve/prefill", admitted=len(taken),
+                                **({"trace_ids": tids} if tids else {})):
                 toks = self.engine.prefill(prompts, p_lens, admit,
                                            seeds=seeds)
         except Exception:
@@ -378,7 +392,8 @@ class ContinuousBatcher:
             prefix_hit_blocks=int(prefix_hit_blocks),
             spec_proposed=int(spec_proposed),
             spec_accepted=int(spec_accepted),
-            prefill_chunks=int(prefill_chunks))
+            prefill_chunks=int(prefill_chunks),
+            trace_id=req.trace_id)
         self.completions[req.rid] = comp
         telemetry.counter("serve/requests").inc()
         itl = np.asarray(comp.inter_token_ms) if comp.inter_token_ms \
@@ -398,7 +413,8 @@ class ContinuousBatcher:
             prefix_hit_blocks=comp.prefix_hit_blocks,
             spec_proposed=comp.spec_proposed,
             spec_accepted=comp.spec_accepted,
-            prefill_chunks=comp.prefill_chunks)
+            prefill_chunks=comp.prefill_chunks,
+            **({"trace_id": req.trace_id} if req.trace_id else {}))
         return comp
 
     def _evict(self, i: int):
@@ -429,7 +445,10 @@ class ContinuousBatcher:
             return
         K = self.engine.decode_steps
         t0 = time.perf_counter()
-        with telemetry.span("serve/decode", tokens=int(active.sum()) * K):
+        tids = [s.req.trace_id for s, a in zip(self._slots, active)
+                if a and s is not None and s.req.trace_id]
+        with telemetry.span("serve/decode", tokens=int(active.sum()) * K,
+                            **({"trace_ids": tids} if tids else {})):
             if hasattr(self.engine, "decode_window"):
                 w = self.engine.decode_window(active)
                 toks, counts = w.tokens, w.counts

@@ -153,6 +153,7 @@ class _DisaggRequest:
     _src_slot: int = -1
     _dst_slot: int = -1
     first_tok_s: float = 0.0
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -166,6 +167,7 @@ class DisaggCompletion:
     prefill_replica: str
     decode_replica: str
     ttft_s: float
+    trace_id: Optional[str] = None
 
 
 # --------------------------------------------------------------------------- #
@@ -277,12 +279,14 @@ class DisaggServer:
     # ------------------------------------------------------------------ #
     def submit(self, prompt, *, max_new_tokens: int = 16,
                eos_id: Optional[int] = None, rid: Optional[str] = None,
-               seed: int = 0) -> str:
+               seed: int = 0, trace_id: Optional[str] = None) -> str:
         """Queue one request; returns its id.  The same admission
         contract as the colocated batcher: prompts must fit the
         prefill engines' bucket, and a bounded queue sheds loudly
         (:class:`OverloadedError`) instead of buffering without
-        bound."""
+        bound.  ``trace_id`` (supplied, ambient, or minted here) tags
+        the request's prefill span, ``kind="handoff"`` record, and
+        decode span — the cross-pool hop stays one trace."""
         prompt = [int(t) for t in prompt]
         eng = self.prefill_pool[0][1]
         max_prompt = getattr(eng, "max_prompt_tokens", eng.prefill_len)
@@ -304,10 +308,14 @@ class DisaggServer:
             rid = f"{self.name}-{self._auto_rid}"
         if rid in self._reqs:
             raise ValueError(f"duplicate rid {rid!r}")
+        if trace_id is None:
+            trace_id = telemetry.current_trace_id() \
+                or telemetry.mint_trace_id()
         req = _DisaggRequest(rid=rid, prompt=prompt,
                              max_new_tokens=int(max_new_tokens),
                              eos_id=eos_id, seed=int(seed),
-                             submit_s=time.perf_counter())
+                             submit_s=time.perf_counter(),
+                             trace_id=trace_id)
         self._reqs[rid] = req
         self._queue.append(req)
         telemetry.gauge("disagg/queue_depth").set(len(self._queue))
@@ -391,8 +399,10 @@ class DisaggServer:
                 taken.append((i, req))
             if not taken:
                 continue
+            tids = [req.trace_id for _, req in taken if req.trace_id]
             with telemetry.span("disagg/prefill", replica=pname,
-                                admitted=len(taken)):
+                                admitted=len(taken),
+                                **({"trace_ids": tids} if tids else {})):
                 toks = eng.prefill(prompts, p_lens, admit, seeds=seeds)
             t_first = time.perf_counter()
             for i, req in taken:
@@ -540,7 +550,8 @@ class DisaggServer:
                 budget_elems=plan.budget_elems,
                 prefill_replica=plan.prefill_replica,
                 decode_replica=plan.decode_replica,
-                duration_ms=dt_ms)
+                duration_ms=dt_ms,
+                **({"trace_id": req.trace_id} if req.trace_id else {}))
 
     # ---- stage 3: decode windows -------------------------------------- #
     def _decode_round(self) -> None:
@@ -555,8 +566,10 @@ class DisaggServer:
             active = np.zeros((eng.num_slots,), bool)
             for r in mine:
                 active[r._dst_slot] = True
+            tids = [r.trace_id for r in mine if r.trace_id]
             with telemetry.span("disagg/decode", replica=pname,
-                                active=int(active.sum())):
+                                active=int(active.sum()),
+                                **({"trace_ids": tids} if tids else {})):
                 toks = eng.decode(active)          # [K, B]
             for r in mine:
                 r.tokens.extend(int(t) for t in toks[:, r._dst_slot])
@@ -579,7 +592,8 @@ class DisaggServer:
                         finish_reason=done,
                         prefill_replica=r.prefill_replica,
                         decode_replica=r.decode_replica,
-                        ttft_s=r.first_tok_s - r.submit_s)
+                        ttft_s=r.first_tok_s - r.submit_s,
+                        trace_id=r.trace_id)
                     telemetry.counter("serve/completed").inc()
 
     # ------------------------------------------------------------------ #

@@ -222,7 +222,8 @@ def _apply_op(batcher, op: dict, extra_done: dict) -> bool:
                            max_new_tokens=int(op["max_new_tokens"]),
                            eos_id=op.get("eos_id"), rid=op["rid"],
                            seed=int(op.get("seed", 0)),
-                           deadline_s=op.get("deadline_s"))
+                           deadline_s=op.get("deadline_s"),
+                           trace_id=op.get("trace_id"))
         except (OverloadedError, ValueError) as e:
             logging.warning("remote replica shed %s: %s", op["rid"], e)
             extra_done[op["rid"]] = {"tokens": [], "finish": "shed"}
@@ -399,7 +400,8 @@ class RemoteBatcher:
 
     def submit(self, prompt, *, max_new_tokens: int = 16,
                eos_id: Optional[int] = None, rid: Optional[str] = None,
-               deadline_s: Optional[float] = None, seed: int = 0) -> str:
+               deadline_s: Optional[float] = None, seed: int = 0,
+               trace_id: Optional[str] = None) -> str:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -422,7 +424,7 @@ class RemoteBatcher:
         self._put_op({"op": "submit", "rid": rid, "prompt": prompt,
                       "max_new_tokens": int(max_new_tokens),
                       "eos_id": eos_id, "seed": int(seed),
-                      "deadline_s": deadline_s})
+                      "deadline_s": deadline_s, "trace_id": trace_id})
         self._pending.add(rid)
         self._queue.append(_MirrorRequest(rid))
         return rid
@@ -739,6 +741,14 @@ class ProcessFleet(ServingFleet):
         for replica in self.replicas:
             if replica.running:
                 replica.shutdown()
+        # Workers flush their telemetry shards at stop-op exit: give
+        # the live ones a graceful window to drain the op before the
+        # SIGTERM sweep, or the shards a distributed trace stitches
+        # from die with their processes.
+        deadline = time.monotonic() + 5.0
+        while any(r.handle.running for r in self.replicas) \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
         self.coordinator.terminate()
         if self._prev_service is None:
             os.environ.pop("AUTODIST_TPU_COORD_SERVICE", None)

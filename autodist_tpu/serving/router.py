@@ -41,10 +41,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import time
-from collections import deque
 from typing import Optional
-
-import numpy as np
 
 from autodist_tpu import telemetry
 from autodist_tpu.serving.batcher import OverloadedError
@@ -83,6 +80,7 @@ class FleetCompletion:
     failovers: int = 0
     hedged: bool = False
     hedge_won: bool = False
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -115,6 +113,7 @@ class _Open:
     # sweep's sibling flag (a drain re-home delayed the same way).
     failover_from: Optional[str] = None
     drain_pending: bool = False
+    trace_id: Optional[str] = None
 
 
 class Router:
@@ -132,11 +131,11 @@ class Router:
         self._open: dict[str, _Open] = {}
         self._ids = itertools.count()
         self.completions: dict[str, FleetCompletion] = {}
-        # Completed e2e_s for the hedge-percentile calibration: a
-        # bounded recent window, not the full history — a long-lived
-        # router must not grow memory (or its per-round percentile
-        # cost) with every request it ever served.
-        self._latencies: deque = deque(maxlen=512)
+        # The fleet-level telemetry view: hedge calibration reads the
+        # shared ``e2e_s`` window, the autoscaler views ``ttft_ms``, and
+        # the SLO gauges are emitted from the same numbers — one
+        # windowed-percentile implementation, zero private copies.
+        self.aggregator = telemetry.TelemetryAggregator()
 
     # ------------------------------------------------------------------ #
     # submission + dispatch
@@ -144,7 +143,8 @@ class Router:
     def submit(self, prompt, *, max_new_tokens: int = 16,
                eos_id: Optional[int] = None, seed: int = 0,
                rid: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> str:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> str:
         """Queue one request with the fleet; returns its id.  The
         failover contract needs room to re-prefill *prompt + emitted*,
         so ``len(prompt) + max_new_tokens - 1`` must fit the engines'
@@ -153,7 +153,14 @@ class Router:
         re-prefill a first-class admission instead of a rejection).
         A request that cannot fit even that is rejected with the coded
         :class:`PromptBudgetError` — a permanent sizing fact the
-        caller must not retry, unlike transient overload."""
+        caller must not retry, unlike transient overload.
+
+        Every request gets a distributed-trace id here at the fleet
+        edge (``trace_id`` to supply one, ambient trace context next,
+        a freshly minted id otherwise); every dispatch/serve/handoff
+        record and span the request touches — on any replica, in any
+        process — carries it, and ``telemetry.stitch_trace`` resolves
+        it into one per-request timeline."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -180,12 +187,16 @@ class Router:
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError("deadline_s must be > 0")
         rid = rid if rid is not None else f"freq-{next(self._ids)}"
+        if trace_id is None:
+            trace_id = telemetry.current_trace_id() \
+                or telemetry.mint_trace_id()
         now = time.perf_counter()
         req = _Open(rid=rid, prompt=prompt,
                     max_new_tokens=int(max_new_tokens), eos_id=eos_id,
                     seed=int(seed), submit_s=now,
                     deadline_abs=(now + deadline_s
-                                  if deadline_s is not None else None))
+                                  if deadline_s is not None else None),
+                    trace_id=trace_id)
         self._open[rid] = req
         self._dispatch(req, reason="route")
         return rid
@@ -220,7 +231,7 @@ class Router:
             replica.batcher.submit(
                 req.prompt + req.emitted, max_new_tokens=budget,
                 eos_id=req.eos_id, rid=sub, seed=req.seed,
-                deadline_s=remaining)
+                deadline_s=remaining, trace_id=req.trace_id)
         except OverloadedError:
             # Shed at the replica (it started draining between pick and
             # submit, or its queue bound tripped): try the others.
@@ -241,7 +252,8 @@ class Router:
         telemetry.record_event(
             "dispatch", request=req.rid, replica=replica.name,
             reason=reason, re_emitted=0, base=base,
-            queue_depth=replica.load, from_replica=from_replica)
+            queue_depth=replica.load, from_replica=from_replica,
+            **({"trace_id": req.trace_id} if req.trace_id else {}))
         self._emit_depth_gauges()
         return disp
 
@@ -350,10 +362,12 @@ class Router:
             e2e_s=now - req.submit_s,
             replica=winner.replica.name if winner is not None else None,
             failovers=req.failovers, hedged=req.hedged,
-            hedge_won=hedge_won)
+            hedge_won=hedge_won, trace_id=req.trace_id)
         self.completions[req.rid] = comp
         del self._open[req.rid]
-        self._latencies.append(comp.e2e_s)
+        self.aggregator.observe_completion(
+            ttft_s=comp.ttft_s, e2e_s=comp.e2e_s, finish_reason=reason)
+        self.aggregator.emit_slo_gauges()
         telemetry.counter("fleet/requests").inc()
         self._emit_depth_gauges()
 
@@ -417,12 +431,11 @@ class Router:
         cfg = self.config
         if cfg.hedge_timeout_s is not None:
             return cfg.hedge_timeout_s
+        window = self.aggregator.window("e2e_s")
         if cfg.hedge_percentile is None \
-                or len(self._latencies) < cfg.hedge_min_samples:
+                or len(window) < cfg.hedge_min_samples:
             return None
-        return float(np.percentile(
-            np.asarray(self._latencies, float),
-            cfg.hedge_percentile)) * cfg.hedge_factor
+        return window.percentile(cfg.hedge_percentile) * cfg.hedge_factor
 
     def _sweep_hedge(self):
         deadline = self._hedge_deadline()

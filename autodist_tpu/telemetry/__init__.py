@@ -18,12 +18,18 @@ rebuilt process-wide).  Typical use::
 Disabled entirely with ``AUTODIST_TPU_TELEMETRY=0`` (no files, shared
 no-op span/instrument singletons).  See ``docs/usage/observability.md``.
 """
+from autodist_tpu.telemetry import tracing
+from autodist_tpu.telemetry.aggregate import (RollingWindow,
+                                              TelemetryAggregator)
 from autodist_tpu.telemetry.core import (NULL_SPAN, Telemetry, configure,
                                          get, reset)
-from autodist_tpu.telemetry.drift import drift_report
+from autodist_tpu.telemetry.drift import DriftMonitor, drift_report
 from autodist_tpu.telemetry.metrics import (NULL_INSTRUMENT, Counter, Gauge,
                                             Histogram, MetricsRegistry)
 from autodist_tpu.telemetry.records import build_manifest, provenance
+from autodist_tpu.telemetry.tracing import (current_trace_id, mint_trace_id,
+                                            request_timeline, stitch_trace,
+                                            trace_context)
 
 __all__ = [
     "Telemetry", "get", "configure", "reset", "enabled", "span", "counter",
@@ -32,6 +38,9 @@ __all__ = [
     "summary", "drift_report", "provenance", "build_manifest",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "NULL_SPAN", "NULL_INSTRUMENT",
+    "tracing", "mint_trace_id", "current_trace_id", "trace_context",
+    "stitch_trace", "request_timeline",
+    "RollingWindow", "TelemetryAggregator", "DriftMonitor",
 ]
 
 

@@ -1,0 +1,199 @@
+"""Live fleet telemetry aggregation: ONE shared rolling-window
+percentile implementation and the fleet-level SLO monitor over it.
+
+Before this module, three call sites each kept a private bounded deque
+over the same completion traffic — the Router's hedge-calibration
+latencies, the Autoscaler's TTFT window, and whatever a report wanted
+to percentile after the fact — and two of them could disagree on the
+same stream (different maxlens, different refresh points).
+:class:`RollingWindow` is the one implementation; the Router and the
+Autoscaler are now *views* over the same :class:`TelemetryAggregator`
+windows (``e2e_s`` / ``ttft_ms``), and the SLO gauges
+(``slo/ttft_p99_ms`` / ``slo/inter_token_p99_ms`` / ``slo/error_rate``
+plus the threshold-burn gauges) read the identical numbers.
+
+Cross-process, the aggregator *tails* worker metrics shards: each
+worker flushes ``kind="serve"`` records into its own
+``<tel_dir>/<replica>-i<inc>/metrics.jsonl``; :meth:`tail_shards`
+re-reads each shard from its remembered offset and folds the new
+records into the same windows — fleet-level percentiles without a new
+transport.
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from autodist_tpu.telemetry import core as _core
+
+# Finish reasons that count against the SLO error budget: the request
+# left without the stream its client asked for (budget/EOS terminals
+# and operator-driven cancels are successes, not errors).
+ERROR_FINISHES = ("shed", "deadline_exceeded")
+
+
+class RollingWindow:
+    """A bounded window of recent scalar observations with exact
+    percentiles over the retained span — the ONE windowed-percentile
+    implementation every consumer views (hedge calibration, autoscale
+    trigger, SLO gauges, the online drift monitor)."""
+
+    def __init__(self, maxlen: int = 512):
+        if maxlen < 1:
+            raise ValueError("window maxlen must be >= 1")
+        self._buf: deque = deque(maxlen=int(maxlen))
+
+    @property
+    def maxlen(self) -> int:
+        return self._buf.maxlen
+
+    def push(self, value: float) -> None:
+        self._buf.append(float(value))
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def values(self) -> np.ndarray:
+        return np.asarray(self._buf, float)
+
+    def percentile(self, q: float) -> Optional[float]:
+        """The q-th percentile of the retained window; ``None`` when
+        empty — an empty window has no latency, and callers that want
+        0.0 (the autoscaler's "an empty fleet is not slow") say so."""
+        if not self._buf:
+            return None
+        return float(np.percentile(self.values(), q))
+
+    def mean(self) -> Optional[float]:
+        if not self._buf:
+            return None
+        return float(self.values().mean())
+
+    def resize(self, maxlen: int) -> "RollingWindow":
+        """Re-bound the window, keeping the most recent values — the
+        hook that lets a later consumer (the autoscaler's
+        ``ttft_window`` knob) narrow a window the router already
+        created without forking the stream."""
+        if maxlen < 1:
+            raise ValueError("window maxlen must be >= 1")
+        if maxlen != self._buf.maxlen:
+            self._buf = deque(self._buf, maxlen=int(maxlen))
+        return self
+
+
+class TelemetryAggregator:
+    """Named rolling windows + error accounting over one traffic
+    stream, with the SLO gauges emitted from the same numbers every
+    view reads.
+
+    ``slo_ttft_p99_ms`` / ``slo_inter_token_p99_ms`` are optional SLO
+    thresholds: when set, :meth:`emit_slo_gauges` additionally emits
+    ``slo/<signal>_burn`` — measured over threshold, the classic
+    burn-rate gauge (1.0 = exactly at the objective)."""
+
+    def __init__(self, *, slo_ttft_p99_ms: Optional[float] = None,
+                 slo_inter_token_p99_ms: Optional[float] = None):
+        self._windows: dict[str, RollingWindow] = {}
+        self._offsets: dict[str, int] = {}
+        self.slo_ttft_p99_ms = slo_ttft_p99_ms
+        self.slo_inter_token_p99_ms = slo_inter_token_p99_ms
+        self.requests = 0
+        self.errors = 0
+
+    def window(self, name: str, maxlen: int = 512) -> RollingWindow:
+        """Get-or-create the named window.  The first creation fixes
+        the bound; a consumer that needs a different span calls
+        :meth:`RollingWindow.resize` explicitly (so two views can never
+        silently percentile different windows under one name)."""
+        win = self._windows.get(name)
+        if win is None:
+            win = self._windows[name] = RollingWindow(maxlen)
+        return win
+
+    # ---- observation ------------------------------------------------- #
+    def observe(self, name: str, value: float) -> None:
+        self.window(name).push(value)
+
+    def observe_completion(self, *, ttft_s: float, e2e_s: float,
+                           finish_reason: str) -> None:
+        """Fold one finished request into the shared windows — the
+        Router calls this at ``_complete``, the shard tail calls it per
+        ``kind="serve"`` record, and every percentile consumer reads
+        the result."""
+        self.window("ttft_ms").push(float(ttft_s) * 1e3)
+        self.window("e2e_s").push(float(e2e_s))
+        self.requests += 1
+        if finish_reason in ERROR_FINISHES:
+            self.errors += 1
+
+    # ---- cross-process shard tailing --------------------------------- #
+    def tail_shards(self, tel_dir: str) -> int:
+        """Fold NEW ``kind="serve"`` records from every worker metrics
+        shard under ``tel_dir`` (``<replica>-i<inc>/metrics.jsonl``)
+        into the windows; per-file byte offsets make repeated calls
+        incremental.  Returns how many records were folded."""
+        folded = 0
+        try:
+            entries = sorted(os.listdir(tel_dir))
+        except OSError:
+            return 0
+        for name in entries:
+            path = os.path.join(tel_dir, name, "metrics.jsonl")
+            if not os.path.isfile(path):
+                continue
+            offset = self._offsets.get(path, 0)
+            try:
+                size = os.path.getsize(path)
+                if size < offset:
+                    offset = 0   # a replacement incarnation rewrote it
+                with open(path) as f:
+                    f.seek(offset)
+                    chunk = f.read()
+                    self._offsets[path] = f.tell()
+            except OSError:
+                continue
+            for line in chunk.splitlines():
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict) \
+                        or rec.get("kind") != "serve":
+                    continue
+                if rec.get("ttft_ms") is not None:
+                    self.window("ttft_ms").push(float(rec["ttft_ms"]))
+                if rec.get("inter_token_p99_ms") is not None:
+                    self.window("inter_token_ms").push(
+                        float(rec["inter_token_p99_ms"]))
+                self.requests += 1
+                if rec.get("finish") in ERROR_FINISHES:
+                    self.errors += 1
+                folded += 1
+        return folded
+
+    # ---- the unified SLO view ---------------------------------------- #
+    def error_rate(self) -> float:
+        return self.errors / self.requests if self.requests else 0.0
+
+    def emit_slo_gauges(self) -> dict:
+        """Refresh the fleet-level SLO gauges from the shared windows
+        and return the values emitted.  Empty windows gauge 0.0 (no
+        traffic is not a violation), and burn gauges appear only when
+        their threshold is configured."""
+        ttft = self.window("ttft_ms").percentile(99) or 0.0
+        itl = self.window("inter_token_ms").percentile(99) or 0.0
+        rate = self.error_rate()
+        out = {"slo/ttft_p99_ms": ttft, "slo/inter_token_p99_ms": itl,
+               "slo/error_rate": rate}
+        if self.slo_ttft_p99_ms:
+            out["slo/ttft_burn"] = ttft / self.slo_ttft_p99_ms
+        if self.slo_inter_token_p99_ms:
+            out["slo/inter_token_burn"] = \
+                itl / self.slo_inter_token_p99_ms
+        for name, value in out.items():
+            _core.get().gauge(name).set(value)
+        return out

@@ -30,6 +30,7 @@ import time
 from typing import Optional
 
 from autodist_tpu import const
+from autodist_tpu.telemetry import tracing
 from autodist_tpu.telemetry.metrics import (NULL_INSTRUMENT, MetricsRegistry)
 
 # In-memory caps (the default-on-cheap contract): beyond them new spans /
@@ -129,6 +130,10 @@ class Telemetry:
     def span(self, name: str, **args):
         if not self.enabled:
             return NULL_SPAN
+        if "trace_id" not in args and "trace_ids" not in args:
+            tid = tracing.current_trace_id()
+            if tid is not None:
+                args["trace_id"] = tid
         return Span(self, name, args)
 
     def _record_span(self, span: Span, t0: float, t1: float, tid: int,
@@ -204,6 +209,15 @@ class Telemetry:
         rec = {"kind": str(kind)}
         for k, v in fields.items():
             rec[k] = _jsonable(v)
+        # The same wall-anchored timestamp spans carry: what lets the
+        # trace stitcher fold typed records into the merged timeline as
+        # causally-ordered instant events.
+        rec.setdefault("ts_us", self._epoch_wall_us
+                       + (time.perf_counter() - self._epoch_perf) * 1e6)
+        if "trace_id" not in rec:
+            tid = tracing.current_trace_id()
+            if tid is not None:
+                rec["trace_id"] = tid
         with self._lock:
             if len(self._steps) >= MAX_STEP_RECORDS:
                 self._steps_dropped += 1

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 # Propose a link-constant update only when prediction and measurement
 # disagree by more than this factor — below it the analytic default is
@@ -331,3 +331,105 @@ def drift_report(strategy=None, cost_model=None,
         except OSError:  # report still returned; file is best-effort
             pass
     return report
+
+
+# --------------------------------------------------------------------------- #
+# Online (windowed) drift: the live half of the calibration loop
+# --------------------------------------------------------------------------- #
+class DriftMonitor:
+    """Windowed measured-vs-predicted drift, evaluated DURING the run.
+
+    :func:`drift_report` joins prediction against measurement once, at
+    the end; this monitor keeps the join live — a rolling window of
+    measured values per term (the shared
+    :class:`~autodist_tpu.telemetry.aggregate.RollingWindow`), a
+    ``drift/<term>_ratio`` gauge refreshed every ``every_n_steps``
+    observed steps, and ONE schema-gated ``kind="drift"`` record each
+    time a term's measured/predicted ratio crosses the ``threshold``
+    band (edge-triggered: a term sitting in breach re-records only
+    after it first returns inside the band).  ``on_drift`` is the
+    opt-in callback hook the ROADMAP's re-election loop plugs into —
+    this monitor lands the mechanical signal; invoking
+    ``ElasticController.hot_swap`` from it stays follow-on work.
+
+    ``predicted`` maps term name → predicted value (terms with a
+    non-positive prediction are ignored: no ratio exists).  Feed
+    measurements with :meth:`observe_step` (the runner hook) or the
+    generic :meth:`observe`.
+    """
+
+    def __init__(self, predicted: dict, *, every_n_steps: int = 10,
+                 threshold: float = 0.25, window: int = 64,
+                 on_drift: Optional[Callable[[dict], None]] = None):
+        from autodist_tpu.telemetry.aggregate import RollingWindow
+
+        if every_n_steps < 1:
+            raise ValueError("every_n_steps must be >= 1")
+        if threshold <= 0:
+            raise ValueError("threshold must be > 0")
+        self.predicted = {str(k): float(v) for k, v in predicted.items()
+                          if v is not None and float(v) > 0}
+        if not self.predicted:
+            raise ValueError(
+                "DriftMonitor needs at least one term with a positive "
+                "predicted value")
+        self.every_n_steps = int(every_n_steps)
+        self.threshold = float(threshold)
+        self.on_drift = on_drift
+        self._windows = {term: RollingWindow(window)
+                         for term in self.predicted}
+        self._breached: set = set()
+        self._observed = 0
+        self.events: list = []   # every emitted drift record, in order
+
+    def observe(self, term: str, value: float) -> None:
+        """Push one measured value for ``term`` (unknown terms are
+        ignored — the monitor only tracks what was predicted)."""
+        win = self._windows.get(term)
+        if win is not None:
+            win.push(float(value))
+
+    def observe_step(self, step: int, duration_s: float) -> None:
+        """The runner hook: fold one measured step and evaluate every
+        ``every_n_steps`` observations."""
+        self.observe("step_time", duration_s)
+        self._observed += 1
+        if self._observed % self.every_n_steps == 0:
+            self.evaluate(step)
+
+    def ratios(self) -> dict:
+        """Current measured(p50-of-window)/predicted per term (terms
+        with an empty window are absent)."""
+        out = {}
+        for term, win in self._windows.items():
+            measured = win.percentile(50)
+            if measured is not None:
+                out[term] = measured / self.predicted[term]
+        return out
+
+    def evaluate(self, step: int) -> list:
+        """Refresh the ``drift/<term>_ratio`` gauges and emit the
+        edge-triggered ``kind="drift"`` records; returns the records
+        emitted by THIS call."""
+        from autodist_tpu import telemetry
+
+        fired = []
+        for term, ratio in self.ratios().items():
+            telemetry.gauge(f"drift/{term}_ratio").set(ratio)
+            breach = abs(ratio - 1.0) > self.threshold
+            if breach and term not in self._breached:
+                self._breached.add(term)
+                event = dict(
+                    term=term, ratio=float(ratio),
+                    threshold=self.threshold, step=int(step),
+                    predicted=self.predicted[term],
+                    measured=float(ratio * self.predicted[term]),
+                    direction="over" if ratio > 1.0 else "under")
+                telemetry.record_event("drift", **event)
+                self.events.append(event)
+                fired.append(event)
+                if self.on_drift is not None:
+                    self.on_drift(event)
+            elif not breach:
+                self._breached.discard(term)
+        return fired

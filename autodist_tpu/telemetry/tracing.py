@@ -1,0 +1,225 @@
+"""Distributed request tracing: trace ids, context propagation, and
+cross-process shard stitching.
+
+A request that enters :meth:`Router.submit` is minted ONE ``trace_id``
+that travels with it everywhere it goes — chief-side dispatch records,
+the coord-service submit op, the worker batcher's prefill/decode spans,
+the disaggregated handoff record, the completion's ``kind="serve"``
+record.  Each process keeps writing its own telemetry shard exactly as
+before (``<tel_dir>/trace.json`` chief-side,
+``<tel_dir>/<replica>-i<inc>/trace.json`` per worker incarnation);
+:func:`stitch_trace` merges the shards into ONE chrome-trace whose
+events keep their real pids — loadable as-is in ``chrome://tracing`` /
+Perfetto, with one named process track per shard.
+
+Span timestamps are wall-clock anchored at telemetry construction
+(``epoch_wall_us + monotonic delta``, :mod:`autodist_tpu.telemetry.core`),
+so shards from different processes land on one comparable timeline
+without any clock negotiation.  Typed records (``dispatch`` / ``fault``
+/ ``handoff`` / ``scale`` / ``serve`` / ``drift``) carry the same-anchor
+``ts_us`` stamp and are folded into the stitched trace as instant
+events — a failover reads causally in one view: the fault instant on
+the dead replica's track, the ``dispatch/failover`` instant on the
+chief's, the re-prefill span on the survivor's.
+
+The context plumbing is :mod:`contextvars`-based so the ambient trace
+id survives threads the way spans' nesting stacks do: code inside
+``with trace_context() as tid:`` gets its spans and records auto-tagged
+without threading ``trace_id=`` through every call.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import json
+import os
+from typing import Optional
+
+# Record kinds folded into the stitched trace as instant events (the
+# causal glue between span shards); anything else stays JSONL-only.
+_FOLDED_KINDS = ("dispatch", "fault", "handoff", "scale", "serve",
+                 "drift")
+
+_ids = itertools.count()
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "autodist_tpu_trace_id", default=None)
+
+
+def mint_trace_id() -> str:
+    """A process-unique trace id: pid + a monotone counter — no
+    randomness, so a deterministic run mints a deterministic sequence
+    (the cross-process parity tests rely on reproducible submits)."""
+    return f"tr-{os.getpid():x}-{next(_ids):04x}"
+
+
+def current_trace_id() -> Optional[str]:
+    """The ambient trace id (``None`` outside any trace context)."""
+    return _current.get()
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str] = None):
+    """Bind ``trace_id`` (minting one when not given) as the ambient
+    trace for the dynamic extent: spans and records emitted inside are
+    auto-tagged with it.  Yields the id."""
+    tid = trace_id if trace_id is not None else mint_trace_id()
+    token = _current.set(tid)
+    try:
+        yield tid
+    finally:
+        _current.reset(token)
+
+
+# --------------------------------------------------------------------------- #
+# Stitching
+# --------------------------------------------------------------------------- #
+def _load_trace_events(path: str) -> list:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        events = data.get("traceEvents", [])
+        return events if isinstance(events, list) else []
+    except (OSError, ValueError):
+        return []
+
+
+def _load_records(path: str) -> list:
+    records = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    except OSError:
+        pass
+    return records
+
+
+def _shard_dirs(run_dir: str) -> list:
+    """Worker shard directories under ``run_dir`` (any subdirectory a
+    worker flushed a trace or metrics shard into), name-sorted for a
+    deterministic stitch."""
+    shards = []
+    try:
+        entries = sorted(os.listdir(run_dir))
+    except OSError:
+        return []
+    for name in entries:
+        sub = os.path.join(run_dir, name)
+        if not os.path.isdir(sub):
+            continue
+        if os.path.exists(os.path.join(sub, "trace.json")) \
+                or os.path.exists(os.path.join(sub, "metrics.jsonl")):
+            shards.append(sub)
+    return shards
+
+
+def _fold_record(rec: dict, pid: int) -> Optional[dict]:
+    """One typed record as a chrome-trace instant event (``ph="i"``) on
+    its process's track — only records stamped with the wall-anchored
+    ``ts_us`` fold (pre-stamp records stay JSONL-only)."""
+    kind = rec.get("kind")
+    ts = rec.get("ts_us")
+    if kind not in _FOLDED_KINDS or not isinstance(ts, (int, float)):
+        return None
+    detail = {"dispatch": rec.get("reason"), "fault": rec.get("phase"),
+              "scale": rec.get("direction"), "serve": rec.get("finish"),
+              "drift": rec.get("term")}.get(kind)
+    name = f"{kind}/{detail}" if detail else str(kind)
+    args = {k: v for k, v in rec.items() if k not in ("kind", "ts_us")}
+    args["folded"] = True
+    return {"name": name, "ph": "i", "s": "g", "pid": pid, "tid": 0,
+            "ts": float(ts), "args": args}
+
+
+def _shard_events(shard_dir: str, fallback_pid: int) -> tuple:
+    """``(span events, folded record instants, pid)`` for one shard."""
+    events = [ev for ev in _load_trace_events(
+        os.path.join(shard_dir, "trace.json"))
+        if ev.get("ph") != "M"
+        and not (ev.get("args") or {}).get("folded")
+        and not (ev.get("args") or {}).get("stitched_from")]
+    pid = next((ev["pid"] for ev in events
+                if isinstance(ev.get("pid"), int)), fallback_pid)
+    instants = []
+    for rec in _load_records(os.path.join(shard_dir, "metrics.jsonl")):
+        ev = _fold_record(rec, pid)
+        if ev is not None:
+            instants.append(ev)
+    return events, instants, pid
+
+
+def stitch_trace(run_dir: str, out_path: Optional[str] = None) -> dict:
+    """Merge the chief's span shard and every worker shard under
+    ``run_dir`` into ONE chrome trace, written to ``out_path``
+    (default: ``run_dir/trace.json`` — the stitched trace REPLACES the
+    chief shard, so a run directory always holds exactly one
+    ``trace.json``).  Idempotent: re-stitching drops previously folded
+    instants and metadata before merging again.
+
+    Returns the stitched trace dict; its ``stitched`` key records the
+    pids and shard directories merged (chrome ignores extra top-level
+    keys)."""
+    events = []
+    pid_labels: dict[int, str] = {}
+    chief_events, chief_instants, chief_pid = _shard_events(
+        run_dir, os.getpid())
+    events += chief_events + chief_instants
+    pid_labels[chief_pid] = "chief"
+    for i, shard in enumerate(_shard_dirs(run_dir)):
+        label = os.path.basename(shard)
+        shard_events, instants, pid = _shard_events(
+            shard, fallback_pid=-(i + 1))
+        for ev in shard_events + instants:
+            # Provenance marker: absorbed-from-a-worker-shard events
+            # are dropped when the stitched output is re-read as the
+            # chief shard, then re-absorbed fresh — idempotency.
+            ev.setdefault("args", {})["stitched_from"] = label
+        events += shard_events + instants
+        pid_labels.setdefault(pid, label)
+    meta = [{"name": "process_name", "ph": "M", "ts": 0.0, "pid": pid,
+             "tid": 0, "args": {"name": label}}
+            for pid, label in sorted(pid_labels.items())]
+    events.sort(key=lambda ev: (ev.get("ts", 0.0), ev.get("pid", 0)))
+    trace = {"traceEvents": meta + events, "displayTimeUnit": "ms",
+             "stitched": {"pids": sorted(pid_labels),
+                          "shards": len(pid_labels)}}
+    out_path = out_path or os.path.join(run_dir, "trace.json")
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    return trace
+
+
+# --------------------------------------------------------------------------- #
+# Per-request timelines
+# --------------------------------------------------------------------------- #
+def event_trace_ids(ev: dict) -> list:
+    """Every trace id an event is tagged with (a batched span carries
+    the ``trace_ids`` of all its resident requests; a record instant
+    carries one ``trace_id``)."""
+    args = ev.get("args") or {}
+    ids = []
+    tid = args.get("trace_id")
+    if tid:
+        ids.append(tid)
+    many = args.get("trace_ids")
+    if isinstance(many, (list, tuple)):
+        ids.extend(t for t in many if t)
+    return ids
+
+
+def request_timeline(trace: dict, trace_id: str) -> list:
+    """The ts-ordered events of one request across every process: the
+    spans and folded instants tagged with ``trace_id``."""
+    events = [ev for ev in trace.get("traceEvents", [])
+              if trace_id in event_trace_ids(ev)]
+    events.sort(key=lambda ev: ev.get("ts", 0.0))
+    return events

@@ -1015,11 +1015,14 @@ def _bench_serve_fleet(dog, replicas: int):
         ttfts = sorted(c.ttft_s for c in done.values())
         p99 = float(np.percentile(np.asarray(ttfts), 99)) * 1e3
         failovers = sum(c.failovers for c in done.values())
-        return tokens / wall if wall > 0 else 0.0, p99, failovers
+        traced = sum(1 for c in done.values() if c.trace_id)
+        return (tokens / wall if wall > 0 else 0.0, p99, failovers,
+                len(done), traced)
 
     try:
-        rate, ttft_p99, _ = run_mix(kill=False)
-        rate_killed, ttft_p99_killed, failovers = run_mix(kill=True)
+        rate, ttft_p99, _, _, _ = run_mix(kill=False)
+        (rate_killed, ttft_p99_killed, failovers, sampled,
+         traced) = run_mix(kill=True)
     except Exception as e:
         dog.disarm()
         if "UNAVAILABLE" in str(e) or "Connection" in str(e):
@@ -1040,6 +1043,10 @@ def _bench_serve_fleet(dog, replicas: int):
         "ttft_ms_p99_replica_killed": round(ttft_p99_killed, 2),
         "tokens_per_sec_replica_killed": round(rate_killed, 2),
         "failovers_on_kill": failovers,
+        # Trace provenance: every routed request is minted a trace id
+        # at submit; resolved counts completions that kept theirs
+        # across dispatch (and the kill run's failover re-dispatch).
+        "trace_sample": {"sampled": sampled, "resolved": traced},
         "scored": True, "provenance": _provenance(),
     }
     dog.disarm()
@@ -1145,7 +1152,8 @@ def _bench_serve(dog):
         for _ in range(requests):
             plen = int(r.randint(1, prefill_len + 1))
             batcher.submit(r.randint(0, cfg.vocab_size, (plen,)).tolist(),
-                           max_new_tokens=max_new)
+                           max_new_tokens=max_new,
+                           trace_id=telemetry.mint_trace_id())
         # Step the scheduler by hand so the peak concurrently-admitted
         # count is observable between rounds (run() loops internally).
         capacity = 0
@@ -1184,6 +1192,11 @@ def _bench_serve(dog):
         if itls else None,
         "inter_token_ms_p99": round(float(np.percentile(itls, 99)), 3)
         if itls else None,
+        # Trace provenance: each timed submit carried a minted trace
+        # id; resolved counts completions that kept theirs end to end.
+        "trace_sample": {"sampled": len(done),
+                         "resolved": sum(1 for c in done.values()
+                                         if c.trace_id)},
         "scored": True, "provenance": _provenance(),
     }
     dog.disarm()

@@ -33,7 +33,14 @@ def _serve_fleet(args, cfg, trainable):
     """The ``--replicas N`` path: serve the request mix through a
     fleet behind the router, then kill one replica mid-run to show the
     failover path re-homing its in-flight requests (the fleet lint is
-    printed first, the launch-gate habit)."""
+    printed first, the launch-gate habit).
+
+    ``--processes`` runs the same mix against REAL replica processes
+    (:class:`ProcessFleet` over the tiny shared worker engine — the
+    model-size flags don't ship to workers) and stitches every
+    process's telemetry shard into ONE ``trace.json``: open it in
+    Perfetto and each request's distributed trace reads across the
+    chief's dispatch instants and both workers' prefill/decode spans."""
     import time
 
     import numpy as np
@@ -41,15 +48,28 @@ def _serve_fleet(args, cfg, trainable):
     from autodist_tpu import serving, telemetry
     from autodist_tpu.resource import ResourceSpec
 
-    def factory():
-        return serving.ServingEngine(
-            cfg, trainable.params,
-            tensor_parallel=args.tensor_parallel,
-            vocab_parallel=args.vocab_parallel, num_slots=args.slots,
-            max_len=args.max_len, prefill_len=args.prefill_len,
-            decode_steps=args.decode_steps)
+    if args.processes:
+        # The tiny worker engine's admission budget, not the CLI's.
+        args.vocab, args.max_new = 33, min(args.max_new, 6)
+        prompt_cap = 16 - args.max_new
+        fleet = serving.ProcessFleet(
+            {"factory": "autodist_tpu.serving.remote:"
+                        "tiny_engine_factory"},
+            config=serving.FleetConfig(replicas=args.replicas),
+            telemetry_dir=args.telemetry_dir)
+    else:
+        prompt_cap = max(args.prefill_len - args.max_new, 1)
 
-    fleet = serving.ServingFleet(factory, replicas=args.replicas)
+        def factory():
+            return serving.ServingEngine(
+                cfg, trainable.params,
+                tensor_parallel=args.tensor_parallel,
+                vocab_parallel=args.vocab_parallel,
+                num_slots=args.slots,
+                max_len=args.max_len, prefill_len=args.prefill_len,
+                decode_steps=args.decode_steps)
+
+        fleet = serving.ServingFleet(factory, replicas=args.replicas)
     report = fleet.lint(resource_spec=ResourceSpec(
         {"topology": {"num_devices":
                       max(args.replicas * args.tensor_parallel, 1)}}))
@@ -60,8 +80,7 @@ def _serve_fleet(args, cfg, trainable):
     t0 = time.perf_counter()
     rids = []
     for _ in range(args.requests):
-        plen = int(r.randint(1, max(args.prefill_len - args.max_new, 1)
-                             + 1))
+        plen = int(r.randint(1, max(prompt_cap, 1) + 1))
         prompt = r.randint(0, args.vocab, (plen,)).tolist()
         rids.append(router.submit(prompt, max_new_tokens=args.max_new))
     router.step()
@@ -80,12 +99,41 @@ def _serve_fleet(args, cfg, trainable):
         telemetry.annotate(serve=True, replicas=args.replicas,
                            requests=len(done), tokens=tokens)
         telemetry.flush()
+    if args.processes:
+        # Workers flush their telemetry shards on the stop op: close
+        # first and wait for the processes to exit, so the stitch
+        # below sees every shard.
+        fleet.close()
+        deadline = time.perf_counter() + 30.0
+        while any(x.handle.running for x in fleet.replicas) \
+                and time.perf_counter() < deadline:
+            time.sleep(0.05)
+    stitched = None
+    if args.telemetry_dir:
+        stitched = telemetry.stitch_trace(args.telemetry_dir)
+        traced = {t for ev in stitched["traceEvents"]
+                  for t in telemetry.tracing.event_trace_ids(ev)}
+        print(f"stitched trace.json: "
+              f"{len(stitched['traceEvents'])} events from "
+              f"{stitched['stitched']['shards']} process shard(s) "
+              f"(pids {stitched['stitched']['pids']}), "
+              f"{len(traced)} traced request(s)")
     if args.smoke:
         assert len(done) == args.requests
         assert all(c.finish_reason in ("eos", "max_tokens", "max_len")
                    for c in done.values())
-        acc = fleet.block_accounting()
-        assert all(u == 0 for _, u, _ in acc.values()), acc
+        assert all(c.trace_id for c in done.values())
+        if not args.processes:
+            acc = fleet.block_accounting()
+            assert all(u == 0 for _, u, _ in acc.values()), acc
+        if stitched is not None:
+            traced = {t for ev in stitched["traceEvents"]
+                      for t in telemetry.tracing.event_trace_ids(ev)}
+            assert all(c.trace_id in traced for c in done.values()), \
+                "a completion's trace id resolves to no stitched event"
+            if args.processes:
+                assert len(stitched["stitched"]["pids"]) >= 2, \
+                    stitched["stitched"]
         print("fleet serve smoke ok")
 
 
@@ -127,6 +175,12 @@ def main():
                          "depth-aware dispatch, failover/hedging) and "
                          "prints the fleet-objective ranking + a "
                          "mid-run replica-kill failover demo")
+    ap.add_argument("--processes", action="store_true",
+                    help="with --replicas > 1: real replica worker "
+                         "processes (ProcessFleet over the tiny shared "
+                         "worker engine) — with --telemetry-dir the "
+                         "per-process telemetry shards are stitched "
+                         "into ONE distributed trace.json")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CI preset: shrink everything and assert "
                          "the serve loop end to end")

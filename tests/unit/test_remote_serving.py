@@ -7,11 +7,13 @@ declared dead by the heartbeat sweep, replaced under the replacement
 budget, and its in-flight requests re-dispatched to the same tokens,
 with every worker pool settling to zero block residency.
 """
+import json
 import os
 import time
 
 import pytest
 
+from autodist_tpu import telemetry
 from autodist_tpu.serving import (ContinuousBatcher, FleetConfig,
                                   ProcessFleet, Router,
                                   tiny_engine_factory)
@@ -93,6 +95,65 @@ def test_worker_sigkill_mid_stream_fails_over_and_replaces(clean_env,
         assert ("replica-0", 1) in names, names
         assert ("replica-1", 0) in names, names
         settle_zero_residency(fleet)
+
+
+@pytest.mark.slow
+def test_sigkill_run_stitches_one_trace_across_processes(clean_env,
+                                                         golden,
+                                                         tmp_path):
+    """The distributed-tracing acceptance path (ISSUE 19): a 2-replica
+    ProcessFleet run with a mid-stream SIGKILL stitches every process's
+    telemetry shard into ONE chrome trace — spans from >= 2 real pids,
+    the fault visible, the failover re-dispatch visible, and every
+    completion's trace id resolvable to stitched events — while the
+    token streams still match the run-alone golden."""
+    telemetry.reset()
+    telemetry.configure(out_dir=str(tmp_path))
+    try:
+        fleet = ProcessFleet(
+            {"factory": FACTORY},
+            config=FleetConfig(replicas=2, heartbeat_interval_s=0.1,
+                               heartbeat_timeout_s=2.0,
+                               heartbeat_startup_grace_s=30.0,
+                               max_replacements=1),
+            telemetry_dir=str(tmp_path))
+        with fleet:
+            router = Router(fleet)
+            rids = [router.submit(p, max_new_tokens=MAX_NEW)
+                    for p in PROMPTS]
+            router.step()
+            fleet.inject("replica-0", "crash")
+            done = router.run()
+            for i, rid in enumerate(rids):
+                assert done[rid].tokens == golden[i], (i, done[rid])
+            assert all(done[rid].trace_id for rid in rids)
+            telemetry.flush()
+        # close() waited for the graceful stop-op exits: every
+        # surviving worker's shard is on disk before the stitch.
+        trace = telemetry.stitch_trace(str(tmp_path))
+        pids = trace["stitched"]["pids"]
+        assert len([p for p in pids if p > 0]) >= 2, trace["stitched"]
+        names = [ev["name"] for ev in trace["traceEvents"]]
+        # a chief-side SIGKILL records detection + replacement (the
+        # "injected" phase belongs to the chaos injector's records)
+        assert "fault/detected" in names, sorted(set(names))
+        assert "fault/recovered" in names, sorted(set(names))
+        assert "dispatch/failover" in names, sorted(set(names))
+        for rid in rids:
+            tl = telemetry.request_timeline(trace, done[rid].trace_id)
+            assert tl, (rid, done[rid].trace_id)
+        # the stitched artifact round-trips: on-disk trace.json IS the
+        # stitched trace and the schema/causal gates stay green
+        with open(tmp_path / "trace.json") as f:
+            assert json.load(f)["stitched"] == trace["stitched"]
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "tools"))
+        import telemetry_report as tr
+        assert tr.check_schema(str(tmp_path)) == []
+    finally:
+        telemetry.reset()
 
 
 @pytest.mark.slow

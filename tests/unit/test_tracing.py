@@ -1,0 +1,434 @@
+"""Distributed request tracing + the live telemetry plane (ISSUE 19).
+
+The bar, in-process first (the cross-process half lives in
+``test_remote_serving.py``): every request minted a ``trace_id`` at the
+fleet edge carries it through dispatch records, replica prefill/decode
+spans, disaggregated handoff records, and its completion; the stitcher
+folds record shards into ONE chrome trace whose per-request timelines
+read causally (fault → failover dispatch → re-prefill); the Router's
+hedge calibration and the Autoscaler's TTFT trigger are VIEWS over the
+same aggregator windows (identical percentile reads on identical
+streams); the online drift monitor breaches edge-triggered in both
+directions; and the report's new causal-chain gates fire on doctored
+artifacts while staying silent on honest ones — including trace-id-less
+pre-tracing records (back-compat).
+"""
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from autodist_tpu import telemetry
+from autodist_tpu.models.pipeline_lm import make_pipeline_lm_trainable
+from autodist_tpu.models.transformer import TransformerConfig
+from autodist_tpu.serving import Router, ServingFleet, ServingEngine
+from autodist_tpu.serving.autoscale import Autoscaler, AutoscaleConfig
+from autodist_tpu.serving.disagg import DisaggServer
+from autodist_tpu.serving.remote import tiny_engine_factory
+from autodist_tpu.telemetry import (DriftMonitor, RollingWindow,
+                                    TelemetryAggregator)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import telemetry_report as tr  # noqa: E402
+
+V, MAX_LEN, MAX_NEW = 33, 24, 6
+PROMPTS = [[1, 2, 3], [4, 5], [6], [7, 8, 9, 10]]
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+@pytest.fixture(scope="module")
+def factory():
+    cfg = TransformerConfig(
+        vocab_size=V, hidden_size=16, num_layers=2, num_heads=2,
+        mlp_dim=32, max_len=MAX_LEN, dtype=jnp.float32,
+        dropout_rate=0.0, attention_dropout_rate=0.0)
+    params = make_pipeline_lm_trainable(
+        cfg, optax.sgd(0.1), jax.random.PRNGKey(0)).params
+
+    def make():
+        return ServingEngine(cfg, params, tensor_parallel=1,
+                             num_slots=2, max_len=MAX_LEN,
+                             prefill_len=16, decode_steps=3,
+                             kv_layout="paged", kv_block_len=5)
+    return make
+
+
+# --------------------------------------------------------------------- #
+# trace ids + ambient context
+# --------------------------------------------------------------------- #
+def test_mint_is_unique_and_context_tags_spans_and_records():
+    a, b = telemetry.mint_trace_id(), telemetry.mint_trace_id()
+    assert a != b and a.startswith("tr-")
+    assert telemetry.current_trace_id() is None
+    with telemetry.trace_context() as tid:
+        assert telemetry.current_trace_id() == tid
+        with telemetry.span("work"):
+            pass
+        telemetry.record_event("dispatch", request="r0", replica="x",
+                               reason="route", re_emitted=0)
+    assert telemetry.current_trace_id() is None
+    ev = telemetry.get().chrome_trace()["traceEvents"][-1]
+    assert ev["args"]["trace_id"] == tid
+    rec = telemetry.get().step_records()[-1]
+    assert rec["trace_id"] == tid
+    assert isinstance(rec["ts_us"], float)   # the wall-anchored stamp
+
+
+def test_explicit_trace_id_wins_over_ambient():
+    with telemetry.trace_context("tr-ambient"):
+        telemetry.record_event("serve", request="r", trace_id="tr-mine")
+    assert telemetry.get().step_records()[-1]["trace_id"] == "tr-mine"
+
+
+# --------------------------------------------------------------------- #
+# stitching synthetic shards
+# --------------------------------------------------------------------- #
+def _write_shard(d, pid, spans=(), records=()):
+    os.makedirs(d, exist_ok=True)
+    evs = [{"name": n, "ph": "X", "ts": ts, "dur": 5.0, "pid": pid,
+            "tid": 0, "args": args} for n, ts, args in spans]
+    with open(os.path.join(d, "trace.json"), "w") as f:
+        json.dump({"traceEvents": evs, "displayTimeUnit": "ms"}, f)
+    with open(os.path.join(d, "metrics.jsonl"), "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_stitch_merges_shards_folds_records_and_is_idempotent(tmp_path):
+    run = str(tmp_path)
+    _write_shard(run, 100,
+                 spans=[("route", 10.0, {"trace_id": "t1"})],
+                 records=[{"kind": "dispatch", "request": "r", "ts_us":
+                           12.0, "reason": "failover", "re_emitted": 0,
+                           "replica": "replica-0", "trace_id": "t1"}])
+    _write_shard(os.path.join(run, "replica-0-i0"), 200,
+                 spans=[("serve/prefill", 20.0, {"trace_ids": ["t1"]})],
+                 records=[{"kind": "fault", "fault": "replica_crash",
+                           "target": "replica-0", "phase": "injected",
+                           "ts_us": 11.0}])
+    trace = telemetry.stitch_trace(run)
+    assert sorted(trace["stitched"]["pids"]) == [100, 200]
+    names = [e["name"] for e in trace["traceEvents"]]
+    # per-pid process_name metadata + spans + folded record instants
+    assert names.count("process_name") == 2
+    assert "dispatch/failover" in names and "fault/injected" in names
+    meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert all({"name", "ph", "ts"} <= set(e) for e in meta)
+    # the timeline of t1 is causally ordered: fault -> failover -> span
+    tl = telemetry.request_timeline(trace, "t1")
+    assert [e["name"] for e in tl] == ["route", "dispatch/failover",
+                                      "serve/prefill"]
+    # idempotent: a re-stitch must not duplicate metadata or instants
+    again = telemetry.stitch_trace(run)
+    assert len(again["traceEvents"]) == len(trace["traceEvents"])
+
+
+def test_stitch_skips_records_without_ts_stamp(tmp_path):
+    _write_shard(str(tmp_path), 1, records=[
+        {"kind": "dispatch", "request": "r", "reason": "route",
+         "re_emitted": 0, "replica": "x"}])   # pre-tracing record
+    trace = telemetry.stitch_trace(str(tmp_path))
+    assert all(e["ph"] == "M" for e in trace["traceEvents"])
+
+
+# --------------------------------------------------------------------- #
+# in-process propagation: Router / fleet / disagg
+# --------------------------------------------------------------------- #
+def test_fleet_failover_trace_propagates_and_check_passes(factory,
+                                                          tmp_path):
+    telemetry.configure(out_dir=str(tmp_path))
+    fleet = ServingFleet(factory, replicas=2)
+    router = Router(fleet)
+    rids = [router.submit(p, max_new_tokens=MAX_NEW) for p in PROMPTS]
+    router.step()
+    fleet.inject("replica-0", "crash")
+    done = router.run()
+    tids = {rid: done[rid].trace_id for rid in rids}
+    assert all(tids.values()) and len(set(tids.values())) == len(rids)
+    recs = telemetry.get().step_records()
+    dispatches = [r for r in recs if r.get("kind") == "dispatch"]
+    serves = [r for r in recs if r.get("kind") == "serve"]
+    # every dispatch AND serve record is trace-tagged with a known id
+    assert dispatches and serves
+    assert {r["trace_id"] for r in dispatches} <= set(tids.values())
+    assert {r["trace_id"] for r in serves} <= set(tids.values())
+    # the failover causal chain is in the records: the failed-over
+    # trace has a prior dispatch onto the replica it fled
+    fo = next(r for r in dispatches if r["reason"] == "failover")
+    assert any(r["trace_id"] == fo["trace_id"]
+               and r["replica"] == fo["from_replica"]
+               for r in dispatches if r is not fo)
+    telemetry.flush()
+    assert tr.check_schema(str(tmp_path)) == []
+    # the flushed trace resolves every completion's id to real spans
+    with open(tmp_path / "trace.json") as f:
+        trace = json.load(f)
+    for rid in rids:
+        assert telemetry.request_timeline(trace, tids[rid])
+
+
+def test_disagg_handoff_carries_trace_and_gateB_passes(tmp_path):
+    telemetry.configure(out_dir=str(tmp_path))
+    srv = DisaggServer(tiny_engine_factory, prefill_replicas=1,
+                       decode_replicas=1)
+    rid = srv.submit([1, 2, 3], max_new_tokens=4, rid="r0")
+    done = srv.run()
+    tid = done[rid].trace_id
+    assert tid
+    handoff = next(r for r in telemetry.get().step_records()
+                   if r.get("kind") == "handoff")
+    assert handoff["trace_id"] == tid
+    telemetry.flush()
+    assert tr.check_schema(str(tmp_path)) == []
+    with open(tmp_path / "trace.json") as f:
+        trace = json.load(f)
+    names = {e["name"] for e in
+             telemetry.request_timeline(trace, tid)}
+    assert "disagg/prefill" in names and "disagg/decode" in names
+
+
+# --------------------------------------------------------------------- #
+# the shared rolling window + aggregator
+# --------------------------------------------------------------------- #
+def test_rolling_window_empty_single_eviction_resize():
+    w = RollingWindow(maxlen=3)
+    assert w.percentile(99) is None and w.mean() is None and len(w) == 0
+    w.push(5.0)
+    assert w.percentile(50) == 5.0 and w.percentile(99) == 5.0
+    for v in (1.0, 2.0, 3.0):
+        w.push(v)           # 5.0 evicted: window holds [1, 2, 3]
+    assert w.percentile(50) == 2.0 and len(w) == 3
+    w.resize(2)             # keeps the most RECENT values
+    assert list(w.values()) == [2.0, 3.0]
+    with pytest.raises(ValueError):
+        RollingWindow(maxlen=0)
+
+
+def test_aggregator_slo_gauges_and_error_rate():
+    agg = TelemetryAggregator(slo_ttft_p99_ms=10.0)
+    out = agg.emit_slo_gauges()          # empty windows gauge 0.0
+    assert out["slo/ttft_p99_ms"] == 0.0 and out["slo/error_rate"] == 0.0
+    agg.observe_completion(ttft_s=0.02, e2e_s=0.1, finish_reason="eos")
+    agg.observe_completion(ttft_s=0.04, e2e_s=0.2, finish_reason="shed")
+    out = agg.emit_slo_gauges()
+    assert out["slo/error_rate"] == 0.5
+    assert out["slo/ttft_burn"] == pytest.approx(
+        out["slo/ttft_p99_ms"] / 10.0)
+    snap = {g["name"]: g["value"]
+            for g in telemetry.get().registry.snapshot()
+            if g["kind"] == "gauge"}
+    assert snap["slo/ttft_p99_ms"] == out["slo/ttft_p99_ms"]
+
+
+def test_aggregator_tails_worker_shards_incrementally(tmp_path):
+    shard = tmp_path / "replica-0-i0"
+    shard.mkdir()
+    path = shard / "metrics.jsonl"
+    rec = {"kind": "serve", "request": "a", "ttft_ms": 7.0,
+           "inter_token_p99_ms": 2.0, "finish": "eos"}
+    path.write_text(json.dumps(rec) + "\n")
+    agg = TelemetryAggregator()
+    assert agg.tail_shards(str(tmp_path)) == 1
+    assert agg.tail_shards(str(tmp_path)) == 0    # offset remembered
+    with open(path, "a") as f:
+        f.write(json.dumps(dict(rec, request="b", finish="shed")) + "\n")
+    assert agg.tail_shards(str(tmp_path)) == 1    # only the new record
+    assert agg.requests == 2 and agg.errors == 1
+    # a replacement incarnation REWRITES its shard: offset resets
+    path.write_text(json.dumps(dict(rec, request="c")) + "\n")
+    assert agg.tail_shards(str(tmp_path)) == 1
+
+
+def test_router_and_autoscaler_read_identical_percentiles(factory):
+    """The dedup pin: the hedge calibration and the TTFT trigger are
+    views over ONE aggregator window — identical percentile reads on
+    the identical completion stream, no private copies left."""
+    fleet = ServingFleet(factory, replicas=2)
+    router = Router(fleet)
+    scaler = Autoscaler(router, config=AutoscaleConfig(ttft_window=64))
+    rids = [router.submit(p, max_new_tokens=MAX_NEW) for p in PROMPTS]
+    router.run()
+    assert not hasattr(router, "_latencies")     # private deque deleted
+    assert not hasattr(scaler, "_ttfts")
+    win = router.aggregator.window("ttft_ms")
+    assert len(win) == len(rids)
+    assert scaler.ttft_p99_ms() == win.percentile(99)
+    # the hedge deadline reads the same aggregator's e2e window
+    router.config.hedge_percentile, router.config.hedge_factor = 50, 2.0
+    router.config.hedge_min_samples = 1
+    e2e = router.aggregator.window("e2e_s")
+    assert router._hedge_deadline() == pytest.approx(
+        e2e.percentile(50) * 2.0)
+    # and the SLO gauge agrees with both views
+    snap = {g["name"]: g["value"]
+            for g in telemetry.get().registry.snapshot()
+            if g["kind"] == "gauge"}
+    assert snap["slo/ttft_p99_ms"] == win.percentile(99)
+
+
+# --------------------------------------------------------------------- #
+# online drift monitor
+# --------------------------------------------------------------------- #
+def test_drift_monitor_edge_triggers_both_directions():
+    mon = DriftMonitor({"step_time": 0.1}, every_n_steps=2,
+                       threshold=0.25, window=4)
+    for s in range(2):
+        mon.observe_step(s, 0.1)          # ratio 1.0: inside the band
+    assert mon.events == []
+    for s in range(2, 4):
+        mon.observe_step(s, 0.2)          # ratio -> 2.0: over
+    assert [e["direction"] for e in mon.events] == ["over"]
+    over = mon.events[-1]
+    assert over["term"] == "step_time" and over["ratio"] > 1.25
+    for s in range(4, 8):
+        mon.observe_step(s, 0.2)          # still over: NO re-emission
+    assert len(mon.events) == 1
+    for s in range(8, 14):
+        mon.observe_step(s, 0.02)         # ratio -> 0.2: under
+    assert [e["direction"] for e in mon.events] == ["over", "under"]
+    recs = [r for r in telemetry.get().step_records()
+            if r.get("kind") == "drift"]
+    assert len(recs) == 2
+    snap = {g["name"]: g["value"]
+            for g in telemetry.get().registry.snapshot()
+            if g["kind"] == "gauge"}
+    assert "drift/step_time_ratio" in snap
+
+
+def test_runner_run_feeds_drift_monitor(monkeypatch):
+    """The opt-in hook: DistributedRunner.run(drift_monitor=...) feeds
+    every step's wall time — asserted through a stub runner so the
+    hook's contract (observe_step per step) is pinned without a mesh."""
+    from autodist_tpu import runner as runner_mod
+
+    calls = []
+
+    class _Mon:
+        def observe_step(self, step, duration_s):
+            calls.append((step, duration_s))
+
+    class _Stub(runner_mod.DistributedRunner):
+        def __init__(self):   # bypass mesh/compile machinery
+            self._step_times = []
+            self._run_steps_seen = 0
+            self._run_seconds = 0.0
+            self._run_examples = 0
+            self._host_step = 1
+
+        def step(self, batch):
+            self._host_step += 1
+            return {"loss": jnp.asarray(0.0)}
+
+    stub = _Stub()
+    stub.run(iter([{"x": jnp.zeros((2, 2))}] * 3), num_steps=3,
+             drift_monitor=_Mon())
+    assert len(calls) == 3
+    assert all(d > 0 for _, d in calls)
+
+
+# --------------------------------------------------------------------- #
+# report: drift records, causal gates (mutation-tested both ways),
+# back-compat on trace-id-less artifacts
+# --------------------------------------------------------------------- #
+def _run_dir(tmp_path, metrics, trace=None):
+    d = tmp_path / "run"
+    d.mkdir(exist_ok=True)
+    with open(d / "metrics.jsonl", "w") as f:
+        for r in metrics:
+            f.write(json.dumps(r) + "\n")
+    if trace is not None:
+        with open(d / "trace.json", "w") as f:
+            json.dump({"traceEvents": trace, "displayTimeUnit": "ms"}, f)
+    return str(d)
+
+
+_FAULT = {"kind": "fault", "fault": "replica_crash",
+          "target": "replica-0", "phase": "injected"}
+_FAULT_R = dict(_FAULT, phase="recovered")
+_FO = {"kind": "dispatch", "request": "r0", "replica": "replica-1",
+       "reason": "failover", "re_emitted": 0,
+       "from_replica": "replica-0", "trace_id": "tr-x"}
+_ROUTE0 = {"kind": "dispatch", "request": "r0", "replica": "replica-0",
+           "reason": "route", "re_emitted": 0, "trace_id": "tr-x"}
+
+
+def test_gateA_failover_causal_chain_fires_and_stays_silent(tmp_path):
+    # doctored: the trace never dispatched onto the replica it fled
+    bad = tr.check_schema(_run_dir(tmp_path, [_FAULT, _FAULT_R, _FO]))
+    assert any("causal chain" in p for p in bad)
+    # honest: prior same-trace dispatch onto replica-0 exists
+    ok = tr.check_schema(
+        _run_dir(tmp_path, [_FAULT, _FAULT_R, _ROUTE0, _FO]))
+    assert ok == []
+    # back-compat: a trace-id-less failover passes on the old pairing
+    legacy = {k: v for k, v in _FO.items() if k != "trace_id"}
+    assert tr.check_schema(
+        _run_dir(tmp_path, [_FAULT, _FAULT_R, legacy])) == []
+
+
+_HANDOFF = {"kind": "handoff", "route": "ici", "blocks": 2,
+            "bytes_moved": 10, "duration_ms": 1.0,
+            "prefill_replica": "p0", "decode_replica": "d0",
+            "trace_id": "tr-y"}
+
+
+def _span(name, tid):
+    return {"name": name, "ph": "X", "ts": 1.0, "dur": 2.0, "pid": 1,
+            "tid": 0, "args": {"trace_ids": [tid]}}
+
+
+def test_gateB_handoff_needs_both_spans(tmp_path):
+    # doctored: tagged handoff, no tagged prefill/decode span at all
+    bad = tr.check_schema(_run_dir(tmp_path, [_HANDOFF], trace=[]))
+    assert any("causal chain" in p for p in bad)
+    # doctored: prefill alone is NOT enough
+    half = tr.check_schema(_run_dir(
+        tmp_path, [_HANDOFF], trace=[_span("disagg/prefill", "tr-y")]))
+    assert any("decode" in p for p in half)
+    # honest: both halves tagged
+    assert tr.check_schema(_run_dir(
+        tmp_path, [_HANDOFF],
+        trace=[_span("disagg/prefill", "tr-y"),
+               _span("disagg/decode", "tr-y")])) == []
+    # back-compat: an untagged handoff skips the gate
+    legacy = {k: v for k, v in _HANDOFF.items() if k != "trace_id"}
+    assert tr.check_schema(_run_dir(tmp_path, [legacy], trace=[])) == []
+
+
+def test_drift_record_schema_gated_both_ways(tmp_path):
+    rec = {"kind": "drift", "term": "step_time", "ratio": 1.6,
+           "threshold": 0.25, "step": 4, "predicted": 0.1,
+           "measured": 0.16, "direction": "over"}
+    assert tr.check_schema(_run_dir(tmp_path, [rec])) == []
+    inside = tr.check_schema(_run_dir(tmp_path, [dict(rec, ratio=1.1)]))
+    assert any("never breached" in p for p in inside)
+    missing = tr.check_schema(_run_dir(
+        tmp_path, [{k: v for k, v in rec.items() if k != "ratio"}]))
+    assert any("drift record missing" in p for p in missing)
+
+
+def test_report_renders_trace_timeline_and_filter(tmp_path, capsys):
+    d = _run_dir(tmp_path, [_ROUTE0],
+                 trace=[_span("serve/prefill", "tr-x"),
+                        _span("serve/decode", "tr-x")])
+    assert tr.main([d]) == 0
+    out = capsys.readouterr().out
+    assert "## request traces" in out and "tr-x" in out
+    assert tr.main([d, "--trace", "tr-x"]) == 0
+    out = capsys.readouterr().out
+    assert "### timeline — tr-x" in out and "serve/decode" in out
+    assert tr.main([d, "--trace", "tr-nope"]) == 0
+    assert "not found" in capsys.readouterr().out

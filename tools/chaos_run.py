@@ -499,6 +499,10 @@ def run_serving_scenario(kind: str, tel_dir: str, out_path: str,
     if processes:
         _await_worker_fault_records(kind, tel_dir)
         _merge_worker_metrics(tel_dir)
+    # One stitched chrome-trace per scenario (chief shard + any worker
+    # shards): the injected fault must be VISIBLE in it — asserted in
+    # the outcome check below.
+    telemetry.stitch_trace(tel_dir)
     problems = _check_serving_outcome(kind, tel_dir, fleet, router, rids)
     if processes:
         fleet.close()
@@ -545,6 +549,30 @@ def _check_serving_outcome(kind, tel_dir, fleet, router, rids) -> list:
         return any(r.get("phase") == phase
                    and all(r.get(k) == v for k, v in kv.items())
                    for r in faults)
+
+    # Every injected fault must be VISIBLE in the stitched trace: the
+    # scenario stitches the chief + worker span shards into one
+    # chrome-trace, and an injection whose ``fault/injected`` instant
+    # never landed on any process's track is a trace that cannot
+    # explain its own failover.
+    try:
+        with open(os.path.join(tel_dir, "trace.json")) as f:
+            trace_events = json.load(f).get("traceEvents", [])
+    except (OSError, ValueError):
+        trace_events = []
+    fault_instants = {((e.get("args") or {}).get("fault"),
+                      (e.get("args") or {}).get("target"))
+                      for e in trace_events
+                      if str(e.get("name", "")).startswith(
+                          "fault/injected")}
+    for rec in faults:
+        if rec.get("phase") != "injected":
+            continue
+        if (rec.get("fault"), rec.get("target")) not in fault_instants:
+            problems.append(
+                f"injected fault {rec.get('fault')}@{rec.get('target')} "
+                "has no fault/injected instant in the stitched "
+                "trace.json — the injection is invisible to the trace")
 
     reasons = {r.get("reason") for r in dispatches}
     if kind == "none":

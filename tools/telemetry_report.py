@@ -96,8 +96,27 @@ _SCALE_KEYS = {"kind", "direction", "trigger", "value", "threshold",
                "replicas_before", "replicas_after"}
 _SCALE_TRIGGERS = {"queue_depth": "autoscale/queue_depth",
                    "ttft_p99": "autoscale/ttft_p99_ms"}
+# Online drift-breach records (autodist_tpu/telemetry/drift.py
+# DriftMonitor): one per threshold CROSSING (edge-triggered), naming the
+# cost-model term, the measured/predicted ratio that crossed, and which
+# side of the band it left — the live sibling of the post-hoc
+# drift.json report.
+_DRIFT_KEYS = {"kind", "term", "ratio", "threshold", "step",
+               "predicted", "measured", "direction"}
 _KINDS = ("step", "serve", "reshard", "fault", "dispatch", "handoff",
-          "scale", "counter", "gauge", "histogram")
+          "scale", "drift", "counter", "gauge", "histogram")
+
+
+def _event_trace_ids(ev: dict):
+    """Trace ids a chrome-trace event is tagged with (``args.trace_id``
+    for a single-request span/instant, ``args.trace_ids`` for a fused
+    batch span covering several requests)."""
+    args = ev.get("args") or {}
+    ids = []
+    if args.get("trace_id"):
+        ids.append(args["trace_id"])
+    ids.extend(t for t in (args.get("trace_ids") or []) if t)
+    return ids
 
 
 def load_jsonl(path: str) -> list[dict]:
@@ -249,6 +268,23 @@ def check_schema(run_dir: str) -> list[str]:
                         f"claims {rec['replicas_before']} -> "
                         f"{rec['replicas_after']} replicas — a scale "
                         "step moves the count by exactly one")
+        elif kind == "drift":
+            missing = _DRIFT_KEYS - set(rec)
+            if missing:
+                problems.append(
+                    f"metrics.jsonl:{i + 1}: drift record missing "
+                    f"{sorted(missing)}")
+            else:
+                if rec["direction"] not in ("over", "under"):
+                    problems.append(
+                        f"metrics.jsonl:{i + 1}: unknown drift "
+                        f"direction {rec['direction']!r}")
+                elif abs(rec["ratio"] - 1.0) <= rec["threshold"]:
+                    problems.append(
+                        f"metrics.jsonl:{i + 1}: drift record for "
+                        f"{rec['term']!r} with ratio {rec['ratio']} "
+                        f"INSIDE its ±{rec['threshold']} band — a "
+                        "breach record that never breached")
         elif "name" not in rec:
             problems.append(f"metrics.jsonl:{i + 1}: {kind} without name")
         elif kind == "histogram" and "count" not in rec:
@@ -293,8 +329,29 @@ def check_schema(run_dir: str) -> list[str]:
                 f"{rec.get('request')} names from_replica={src!r} with "
                 "no paired fault/health record for that replica — an "
                 "unaudited failover")
+            continue
+        # The PR-19 causal-chain gate, keyed on the distributed trace
+        # id (absent on pre-tracing runs, which keep passing on the
+        # pairing gate above alone): the failed-over trace must show a
+        # PRIOR dispatch onto the replica it claims to flee — a
+        # failover whose own trace never touched that replica is a
+        # router re-homing work it never lost.
+        tid = rec.get("trace_id")
+        if tid is not None:
+            on_src = any(o is not rec and o.get("trace_id") == tid
+                         and o.get("replica") == src
+                         for o in dispatches)
+            if not on_src:
+                problems.append(
+                    f"metrics.jsonl: failover dispatch for trace "
+                    f"{tid} claims from_replica={src!r} but the trace "
+                    "has no dispatch record onto that replica — the "
+                    "causal chain (dispatch → fault → failover) is "
+                    "broken")
 
     trace = os.path.join(run_dir, "trace.json")
+    trace_events: list = []
+    trace_ok = False
     if os.path.exists(trace):
         try:
             with open(trace) as f:
@@ -304,8 +361,43 @@ def check_schema(run_dir: str) -> list[str]:
                 if not {"name", "ph", "ts"} <= set(ev):
                     problems.append(f"trace.json: event {j} malformed")
                     break
+            else:
+                trace_events = events
+                trace_ok = True
         except (ValueError, KeyError, TypeError) as e:
             problems.append(f"trace.json: invalid chrome trace ({e})")
+
+    # The PR-19 handoff causal gate, keyed the same trace-id way: a
+    # ``kind="handoff"`` record tagged with a trace id claims "this
+    # request prefilled on one pool and decoded on another" — the
+    # stitched trace must actually contain BOTH halves (a prefill span
+    # and a decode span tagged with the same id), or the KV transfer
+    # moved a prefix no traced prefill produced / no traced decode
+    # consumed.  Untagged (pre-tracing) handoffs keep passing.
+    if trace_ok:
+        tagged = {}
+        for ev in trace_events:
+            name = str(ev.get("name", ""))
+            for t in _event_trace_ids(ev):
+                got = tagged.setdefault(t, set())
+                if "prefill" in name:
+                    got.add("prefill")
+                if "decode" in name:
+                    got.add("decode")
+        for rec in records:
+            if rec.get("kind") != "handoff":
+                continue
+            tid = rec.get("trace_id")
+            if tid is None:
+                continue
+            got = tagged.get(tid, set())
+            missing = {"prefill", "decode"} - got
+            if missing:
+                problems.append(
+                    f"metrics.jsonl: handoff record for trace {tid} "
+                    f"has no {'/'.join(sorted(missing))} span tagged "
+                    "with that trace id in trace.json — a KV transfer "
+                    "outside its request's causal chain")
 
     # Any precision gauge must carry a legal wire width.
     gauges = {r.get("name"): r for r in records if r.get("kind") == "gauge"}
@@ -479,7 +571,79 @@ def _fmt(v, nd=3) -> str:
     return str(v)
 
 
-def render(run_dir: str) -> str:
+def _trace_sections(run_dir: str, records: list,
+                    trace_filter=None) -> list:
+    """The per-request trace timeline section: every trace id seen in
+    the (possibly stitched) ``trace.json`` summarized with its span /
+    record counts and the replicas (pids) it crossed; ``trace_filter``
+    narrows to one request and expands it into the full ts-ordered
+    timeline — the span tree with replica/pool attribution."""
+    path = os.path.join(run_dir, "trace.json")
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as f:
+            events = json.load(f)["traceEvents"]
+    except (ValueError, KeyError, TypeError):
+        return []
+    by_trace: dict = {}
+    for ev in events:
+        for t in _event_trace_ids(ev):
+            by_trace.setdefault(t, []).append(ev)
+    if not by_trace:
+        return []
+    if trace_filter is not None and trace_filter not in by_trace:
+        return ["## request traces", "",
+                f"(trace {trace_filter!r} not found; run has "
+                f"{len(by_trace)} traced request(s))", ""]
+    lines = ["## request traces", "",
+             "| trace | spans | records | pids | replicas |",
+             "|---|---|---|---|---|"]
+    wanted = [trace_filter] if trace_filter is not None \
+        else sorted(by_trace)
+    rec_by_trace: dict = {}
+    for r in records:
+        if r.get("trace_id"):
+            rec_by_trace.setdefault(r["trace_id"], []).append(r)
+    for t in wanted:
+        evs = by_trace[t]
+        spans = [e for e in evs if e.get("ph") == "X"
+                 and not (e.get("args") or {}).get("folded")]
+        insts = [e for e in evs if (e.get("args") or {}).get("folded")
+                 or e.get("ph") == "i"]
+        pids = sorted({e.get("pid") for e in evs})
+        replicas = sorted(
+            {str((e.get("args") or {}).get("replica"))
+             for e in evs if (e.get("args") or {}).get("replica")})
+        lines.append(
+            f"| {t} | {len(spans)} | {len(insts)} "
+            f"| {'/'.join(str(p) for p in pids)} "
+            f"| {'/'.join(replicas) or '—'} |")
+    lines.append("")
+    if trace_filter is not None:
+        lines += [f"### timeline — {trace_filter}", "",
+                  "| ts (ms) | event | dur (ms) | pid | replica | "
+                  "detail |",
+                  "|---|---|---|---|---|---|"]
+        evs = sorted(by_trace[trace_filter],
+                     key=lambda e: float(e.get("ts", 0.0)))
+        t0 = float(evs[0].get("ts", 0.0)) if evs else 0.0
+        for ev in evs:
+            args = ev.get("args") or {}
+            detail = args.get("reason") or args.get("route") \
+                or args.get("finish") or args.get("phase") or "—"
+            dur = ev.get("dur")
+            lines.append(
+                f"| {_fmt((float(ev.get('ts', 0.0)) - t0) / 1e3)} "
+                f"| {ev.get('name')} "
+                f"| {_fmt(float(dur) / 1e3 if dur is not None else None)} "
+                f"| {ev.get('pid')} "
+                f"| {args.get('replica') or '—'} | {detail} |")
+        lines.append("")
+    return lines
+
+
+def render(run_dir: str, trace_filter=None) -> str:
     """The markdown report for one flushed run directory."""
     records = load_jsonl(os.path.join(run_dir, "metrics.jsonl"))
     steps = [r for r in records if r.get("kind") == "step"]
@@ -489,6 +653,7 @@ def render(run_dir: str) -> str:
     faults = [r for r in records if r.get("kind") == "fault"]
     handoffs = [r for r in records if r.get("kind") == "handoff"]
     scales = [r for r in records if r.get("kind") == "scale"]
+    drifts = [r for r in records if r.get("kind") == "drift"]
     counters = [r for r in records if r.get("kind") == "counter"]
     gauges = [r for r in records if r.get("kind") == "gauge"]
     hists = [r for r in records if r.get("kind") == "histogram"]
@@ -677,6 +842,23 @@ def render(run_dir: str) -> str:
                 f"ttft p99 {_fmt(final.get('autoscale/ttft_p99_ms'))} ms")
             lines.append("")
 
+    if drifts:
+        # The ONLINE drift monitor's breach records (edge-triggered:
+        # one row per crossing, in either direction) — the live
+        # sibling of the post-hoc drift.json section below.
+        lines += ["## online drift breaches", "",
+                  "| step | term | ratio | band | direction |",
+                  "|---|---|---|---|---|"]
+        for r in drifts:
+            lines.append(
+                f"| {r.get('step')} | {r.get('term')} "
+                f"| {_fmt(r.get('ratio'))} "
+                f"| ±{_fmt(r.get('threshold'))} "
+                f"| {r.get('direction')} |")
+        lines.append("")
+
+    lines += _trace_sections(run_dir, records, trace_filter)
+
     if reshards:
         lines += ["## reshards", "",
                   "| route | leaves | MB moved | peak host MB | ms |",
@@ -761,6 +943,10 @@ def main(argv=None) -> int:
     ap.add_argument("--check", action="store_true",
                     help="validate the artifact schema; non-zero exit on "
                          "a break (CI smoke)")
+    ap.add_argument("--trace", default=None, metavar="ID",
+                    help="expand one request's distributed trace into "
+                         "its full timeline (span tree with replica "
+                         "attribution)")
     args = ap.parse_args(argv)
     if args.check:
         problems = check_schema(args.run_dir)
@@ -771,7 +957,7 @@ def main(argv=None) -> int:
         print(f"schema OK: {args.run_dir}")
         return 0
     try:
-        print(render(args.run_dir))
+        print(render(args.run_dir, trace_filter=args.trace))
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
